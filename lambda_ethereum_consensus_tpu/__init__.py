@@ -1,0 +1,27 @@
+"""lambda_ethereum_consensus_tpu — a TPU-native Ethereum consensus-layer client framework.
+
+A from-scratch re-design of the capabilities of the reference Elixir/OTP client
+(lambda_ethereum_consensus): an Ethereum beacon-chain node whose numeric hot
+paths — SSZ Merkleization (SHA-256 tree hashing) and BLS12-381 signature
+verification — run as batched, data-parallel JAX/Pallas programs on TPU, while
+the latency-sensitive, branchy consensus logic (fork choice, networking, the
+node runtime) stays host-side in Python/C++.
+
+Package map (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``config``          chain presets & runtime configs  (ref: lib/chain_spec/, config/*.yaml)
+- ``ssz``             SSZ type system, codec, Merkleization engine (ref: native/ssz_nif, lib/ssz.ex)
+- ``types``           beacon-chain / p2p / validator containers (ref: lib/ssz_types/)
+- ``crypto``          BLS12-381 + hashing backends (ref: native/bls_nif, lib/bls.ex)
+- ``ops``             JAX/Pallas device kernels: SHA-256, Merkle levels, shuffling
+- ``parallel``        device meshes, shardings, multi-chip batched verification
+- ``statetransition`` the pure consensus core (ref: lib/lambda_ethereum_consensus/state_transition/)
+- ``forkchoice``      LMD-GHOST store/handlers/helpers (ref: lib/lambda_ethereum_consensus/fork_choice/)
+- ``store``           persistence: KV store + block/state stores (ref: lib/lambda_ethereum_consensus/store/)
+- ``p2p``             network sidecar boundary, gossip pipeline, req/resp, sync
+- ``node``            host runtime: supervision, tickers, pending blocks
+- ``api``             Beacon REST API, Engine API client, checkpoint sync
+- ``telemetry``       metrics registry + Prometheus exporter
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,34 @@
+"""Test configuration.
+
+Multi-chip sharding tests run on a virtual 8-device CPU mesh — real TPU
+hardware is single-chip in CI, so `--xla_force_host_platform_device_count=8`
+provides the device mesh (the driver's `dryrun_multichip` does the same).
+Setting JAX_PLATFORMS / XLA_FLAGS must happen before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from lambda_ethereum_consensus_tpu.config import (  # noqa: E402
+    mainnet_spec,
+    minimal_spec,
+    use_chain_spec,
+)
+
+
+@pytest.fixture
+def mainnet():
+    with use_chain_spec(mainnet_spec()) as spec:
+        yield spec
+
+
+@pytest.fixture
+def minimal():
+    with use_chain_spec(minimal_spec()) as spec:
+        yield spec

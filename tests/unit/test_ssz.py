@@ -1,0 +1,351 @@
+"""SSZ codec + Merkleization tests (model: test/unit/ssz_test.exs and the
+ssz_static spec-test format — decode/encode/hash_tree_root round-trips plus
+independently-computed known answers)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from lambda_ethereum_consensus_tpu import ssz
+from lambda_ethereum_consensus_tpu import types as T
+from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    List,
+    SSZError,
+    Vector,
+    boolean,
+    merkleize_chunks,
+    uint8,
+    uint16,
+    uint64,
+    uint256,
+)
+
+
+def h(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+# --- basic types ---------------------------------------------------------------
+
+
+def test_uint_roundtrip():
+    assert uint64.serialize(0x0102030405060708) == bytes.fromhex("0807060504030201")
+    assert uint64.deserialize(bytes.fromhex("0807060504030201")) == 0x0102030405060708
+    assert uint16.serialize(0xABCD) == bytes.fromhex("cdab")
+    assert uint256.deserialize(uint256.serialize(2**255 + 17)) == 2**255 + 17
+
+
+def test_uint_bounds():
+    with pytest.raises(SSZError):
+        uint8.serialize(256)
+    with pytest.raises(SSZError):
+        uint64.serialize(-1)
+    with pytest.raises(SSZError):
+        uint64.deserialize(b"\x00" * 7)
+
+
+def test_boolean():
+    assert boolean.serialize(True) == b"\x01"
+    assert boolean.deserialize(b"\x00") is False
+    with pytest.raises(SSZError):
+        boolean.deserialize(b"\x02")
+
+
+def test_uint_htr_padding():
+    assert uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + b"\x00" * 24
+
+
+# --- merkleization vs an independent mini-oracle -------------------------------
+
+
+def naive_merkle(chunks: list[bytes], limit: int) -> bytes:
+    """Straightforward recursive Merkle root, independent of the engine."""
+    padded = 1 if limit == 0 else 1 << (limit - 1).bit_length()
+    nodes = list(chunks) + [b"\x00" * 32] * (padded - len(chunks))
+
+    def root(lo, hi):
+        if hi - lo == 1:
+            return nodes[lo]
+        mid = (lo + hi) // 2
+        return h(root(lo, mid) + root(mid, hi))
+
+    return root(0, len(nodes))
+
+
+@given(st.integers(0, 20), st.integers(0, 40))
+@settings(max_examples=30, deadline=None)
+def test_merkleize_matches_naive(count, extra_limit):
+    limit = count + extra_limit
+    rng = np.random.default_rng(count * 100 + extra_limit)
+    chunks = rng.integers(0, 256, (count, 32), dtype=np.uint8)
+    got = merkleize_chunks(chunks, limit or None)
+    want = naive_merkle([chunks[i].tobytes() for i in range(count)], limit or count)
+    assert got == want
+
+
+def test_merkleize_huge_limit_is_lazy():
+    # 2**40-chunk limit must not allocate the virtual tree
+    chunks = np.ones((3, 32), np.uint8)
+    out = merkleize_chunks(chunks, 2**40)
+    assert len(out) == 32
+
+
+# --- containers: known answers computable by hand ------------------------------
+
+
+def test_checkpoint_known_root():
+    cp = T.Checkpoint(epoch=5, root=b"\x11" * 32)
+    expect = h((5).to_bytes(32, "little") + b"\x11" * 32)
+    assert cp.hash_tree_root() == expect
+
+
+def test_fork_known_root():
+    f = T.Fork(previous_version=b"\x01\x00\x00\x00", current_version=b"\x02\x00\x00\x00", epoch=9)
+    leaves = [
+        b"\x01\x00\x00\x00".ljust(32, b"\x00"),
+        b"\x02\x00\x00\x00".ljust(32, b"\x00"),
+        (9).to_bytes(32, "little"),
+    ]
+    expect = h(h(leaves[0] + leaves[1]) + h(leaves[2] + b"\x00" * 32))
+    assert f.hash_tree_root() == expect
+
+
+def test_list_uint64_known_root():
+    # List[uint64, 4] of [1,2] -> one chunk (1,2 packed) merkleized at limit 1, mixed with len
+    typ = List(uint64, 4)
+    chunk = (1).to_bytes(8, "little") + (2).to_bytes(8, "little") + b"\x00" * 16
+    expect = h(chunk + (2).to_bytes(32, "little"))
+    assert typ.hash_tree_root([1, 2]) == expect
+
+
+def test_bitlist_known_root():
+    # Bitlist[8] of [1,0,1] -> byte 0b101 in one chunk, mix_in_length 3
+    typ = Bitlist(8)
+    bits = ssz.BitlistValue.from_bools([1, 0, 1])
+    expect = h(bytes([0b101]).ljust(32, b"\x00") + (3).to_bytes(32, "little"))
+    assert typ.hash_tree_root(bits) == expect
+    assert typ.serialize(bits) == bytes([0b1101])  # sentinel at bit 3
+
+
+def test_bitvector_roundtrip_and_root():
+    typ = Bitvector(10)
+    v = ssz.BitvectorValue.from_bools([1, 1, 0, 0, 1, 0, 0, 0, 1, 1])
+    enc = typ.serialize(v)
+    assert len(enc) == 2
+    assert typ.deserialize(enc) == v
+    # fits in one chunk: root is just the padded chunk (no length mixin)
+    assert typ.hash_tree_root(v) == enc.ljust(32, b"\x00")
+
+
+def test_bitlist_sentinel_validation():
+    typ = Bitlist(16)
+    with pytest.raises(SSZError):
+        typ.deserialize(b"")
+    with pytest.raises(SSZError):
+        typ.deserialize(b"\x00")  # missing sentinel
+    with pytest.raises(SSZError):
+        typ.deserialize(b"\x05\x00")  # trailing zero byte
+
+
+# --- container codec round-trips ----------------------------------------------
+
+
+def random_validator(rng):
+    return T.Validator(
+        pubkey=bytes(rng.integers(0, 256, 48, dtype=np.uint8)),
+        withdrawal_credentials=bytes(rng.integers(0, 256, 32, dtype=np.uint8)),
+        effective_balance=int(rng.integers(0, 2**40)),
+        slashed=bool(rng.integers(0, 2)),
+        activation_eligibility_epoch=int(rng.integers(0, 2**20)),
+        activation_epoch=int(rng.integers(0, 2**20)),
+        exit_epoch=2**64 - 1,
+        withdrawable_epoch=2**64 - 1,
+    )
+
+
+def test_validator_fixed_size(mainnet):
+    assert T.Validator.is_fixed_size(mainnet)
+    assert T.Validator.fixed_length(mainnet) == 121
+
+
+def test_attestation_roundtrip():
+    cp = T.Checkpoint(epoch=1, root=b"\x07" * 32)
+    att = T.Attestation(
+        aggregation_bits=ssz.BitlistValue.from_bools([1, 0, 1, 1, 0]),
+        data=T.AttestationData(slot=3, index=1, beacon_block_root=b"\x22" * 32, source=cp, target=cp),
+        signature=b"\x99" * 96,
+    )
+    assert T.Attestation.decode(att.encode()) == att
+
+
+def test_indexed_attestation_roundtrip():
+    cp = T.Checkpoint()
+    ia = T.IndexedAttestation(
+        attesting_indices=[1, 5, 9],
+        data=T.AttestationData(slot=1, index=0, beacon_block_root=b"\x00" * 32, source=cp, target=cp),
+        signature=b"\x11" * 96,
+    )
+    assert T.IndexedAttestation.decode(ia.encode()) == ia
+
+
+def test_beacon_state_roundtrip_minimal(minimal):
+    rng = np.random.default_rng(42)
+    state = T.BeaconState(
+        slot=17,
+        validators=[random_validator(rng) for _ in range(8)],
+        balances=[32 * 10**9] * 8,
+        previous_epoch_participation=[0] * 8,
+        current_epoch_participation=[7] * 8,
+        inactivity_scores=[0] * 8,
+    )
+    enc = state.encode()
+    state2 = T.BeaconState.decode(enc)
+    assert state2 == state
+    assert state2.hash_tree_root() == state.hash_tree_root()
+
+
+def test_beacon_block_roundtrip(minimal):
+    body = T.BeaconBlockBody(
+        execution_payload=T.ExecutionPayload(
+            transactions=[b"\x01\x02", b""],
+            withdrawals=[T.Withdrawal(index=1, validator_index=2, address=b"\x03" * 20, amount=4)],
+        ),
+    )
+    blk = T.SignedBeaconBlock(
+        message=T.BeaconBlock(slot=7, proposer_index=1, parent_root=b"\x01" * 32,
+                              state_root=b"\x02" * 32, body=body),
+        signature=b"\x55" * 96,
+    )
+    assert T.SignedBeaconBlock.decode(blk.encode()) == blk
+
+
+def test_deserialize_rejects_bad_offsets(minimal):
+    enc = bytearray(T.IndexedAttestation(
+        attesting_indices=[1], data=T.AttestationData(), signature=b"\x00" * 96).encode())
+    enc[0] = 0xFF  # corrupt first offset
+    with pytest.raises(SSZError):
+        T.IndexedAttestation.decode(bytes(enc))
+
+
+def test_config_dependent_sizes():
+    with use_chain_spec(minimal_spec()):
+        assert len(T.BeaconState().block_roots) == 64
+        sc = T.SyncCommittee()
+        assert len(sc.pubkeys) == 32
+    assert len(T.BeaconState().block_roots) == 8192
+
+
+def test_immutability_and_copy():
+    cp = T.Checkpoint(epoch=1, root=b"\x00" * 32)
+    with pytest.raises(AttributeError):
+        cp.epoch = 2
+    cp2 = cp.copy(epoch=2)
+    assert cp2.epoch == 2 and cp.epoch == 1
+
+
+# --- p2p / validator containers -----------------------------------------------
+
+
+def test_status_message_roundtrip():
+    sm = T.StatusMessage(fork_digest=b"\xba\xa4\xda\x96", finalized_root=b"\x01" * 32,
+                         finalized_epoch=3, head_root=b"\x02" * 32, head_slot=99)
+    assert T.StatusMessage.decode(sm.encode()) == sm
+    assert T.StatusMessage.is_fixed_size()
+
+
+def test_metadata_roundtrip():
+    md = T.Metadata(seq_number=7, attnets=ssz.BitvectorValue.from_bools([0] * 63 + [1]),
+                    syncnets=ssz.BitvectorValue.from_bools([1, 0, 0, 0]))
+    assert T.Metadata.decode(md.encode()) == md
+
+
+def test_aggregate_and_proof_roundtrip():
+    ap = T.SignedAggregateAndProof(
+        message=T.AggregateAndProof(
+            aggregator_index=11,
+            aggregate=T.Attestation(aggregation_bits=ssz.BitlistValue.from_bools([1])),
+            selection_proof=b"\x01" * 96,
+        ),
+        signature=b"\x02" * 96,
+    )
+    assert T.SignedAggregateAndProof.decode(ap.encode()) == ap
+
+
+# --- property-based round-trips ------------------------------------------------
+
+
+@given(st.lists(st.integers(0, 2**64 - 1), max_size=50))
+@settings(max_examples=50, deadline=None)
+def test_uint64_list_roundtrip(xs):
+    typ = List(uint64, 128)
+    assert typ.deserialize(typ.serialize(xs)) == xs
+
+
+@given(st.lists(st.booleans(), min_size=0, max_size=70))
+@settings(max_examples=50, deadline=None)
+def test_bitlist_roundtrip(bools):
+    typ = Bitlist(128)
+    v = ssz.BitlistValue.from_bools(bools)
+    assert typ.deserialize(typ.serialize(v)) == v
+
+
+@given(st.binary(max_size=64))
+@settings(max_examples=50, deadline=None)
+def test_bytelist_roundtrip(b):
+    typ = ByteList(64)
+    assert typ.deserialize(typ.serialize(b)) == b
+
+
+# --- regressions from review --------------------------------------------------
+
+
+def test_variable_list_rejects_zero_first_offset():
+    typ = List(ByteList(100), 100)
+    with pytest.raises(SSZError):
+        typ.deserialize(b"\x00\x00\x00\x00GARBAGE")
+
+
+def test_uint_list_htr_raises_sszerror_not_overflow():
+    typ = List(uint64, 10)
+    with pytest.raises(SSZError):
+        typ.hash_tree_root([2**64])
+    with pytest.raises(SSZError):
+        typ.hash_tree_root([-1])
+
+
+def test_bitvector_deserialize_bad_padding_is_sszerror():
+    with pytest.raises(SSZError):
+        Bitvector(4).deserialize(b"\xff")
+
+
+def test_bits_set_bounds_checked():
+    v = ssz.BitvectorValue(4)
+    with pytest.raises(IndexError):
+        v.set(6)
+    assert v.set(3)[3] is True
+
+
+def test_load_config_file_hex_fields(tmp_path):
+    from lambda_ethereum_consensus_tpu.config import load_config_file
+
+    p = tmp_path / "conf.yaml"
+    p.write_text(
+        "PRESET_BASE: 'mainnet'\n"
+        "CONFIG_NAME: 'testnet'\n"
+        "GENESIS_FORK_VERSION: 0x00000001  # unquoted hex\n"
+        "DEPOSIT_CONTRACT_ADDRESS: 0x1234567890123456789012345678901234567890\n"
+        "SECONDS_PER_SLOT: 3\n"
+    )
+    spec = load_config_file(str(p))
+    assert spec.GENESIS_FORK_VERSION == bytes.fromhex("00000001")
+    assert spec.DEPOSIT_CONTRACT_ADDRESS == bytes.fromhex("1234567890123456789012345678901234567890")
+    assert spec.SECONDS_PER_SLOT == 3
+    assert spec.SLOTS_PER_EPOCH == 32  # inherited from mainnet preset
