@@ -1,0 +1,10 @@
+"""TPU compute kernels (JAX / Pallas).
+
+This package is the device-side substrate of the framework: batched,
+data-parallel implementations of the numeric hot paths that the reference
+client delegates to Rust NIFs (SHA-256 Merkleization — ref:
+native/ssz_nif/src/lib.rs:26-153; BLS12-381 verification — ref:
+native/bls_nif/src/lib.rs:14-158).  Everything here is importable without a
+TPU attached: each op has a pure ``jax.numpy`` path that runs on CPU, with
+Pallas TPU kernels layered on top for the hot shapes.
+"""

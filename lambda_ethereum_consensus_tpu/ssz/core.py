@@ -104,6 +104,18 @@ def merkleize_chunks(
     if count == 0:
         return ZERO_HASHES[depth]
     level = np.ascontiguousarray(chunks, dtype=np.uint8)
+    # Large trees: reduce the populated subtree in one fused device call,
+    # then extend to the limit depth with precomputed zero-subtree roots.
+    subtree = getattr(backend, "merkle_subtree_root", None)
+    if (
+        subtree is not None
+        and depth > 0
+        and count >= getattr(backend, "tree_threshold", 1 << 62)
+    ):
+        root, sub_depth = subtree(level)
+        for d in range(sub_depth, depth):
+            root = sha256(root + ZERO_HASHES[d])
+        return root
     for d in range(depth):
         if level.shape[0] % 2:
             zrow = np.frombuffer(ZERO_HASHES[d], np.uint8).reshape(1, 32)
@@ -301,6 +313,8 @@ def _pack_basics(elem: Uint | Boolean, values: Sequence, spec: ChainSpec) -> np.
             raise SSZError(f"value out of range for {elem!r}")
         data = arr.astype(f"<u{elem.size}").tobytes()
     elif isinstance(elem, Boolean):
+        if any(v not in (True, False, 0, 1) for v in values):
+            raise SSZError("invalid boolean in sequence")
         data = bytes(1 if v else 0 for v in values)
     else:  # uint128/uint256
         data = b"".join(elem.serialize(v, spec) for v in values)
@@ -565,6 +579,14 @@ class ContainerMeta(type):
         for fname, ftype in ns.get("__annotations__", {}).items():
             if isinstance(ftype, SSZType) or (isinstance(ftype, type) and issubclass(ftype, Container)):
                 schema[fname] = ftype
+            elif not fname.startswith("_"):
+                # A dropped field would silently change the wire layout and
+                # every Merkle root — fail at class definition instead.
+                raise TypeError(
+                    f"{name}.{fname}: annotation {ftype!r} is not an SSZ type "
+                    "(string annotations — e.g. from `from __future__ import "
+                    "annotations` — are not supported in container modules)"
+                )
         cls.__ssz_schema__ = schema
         return cls
 
@@ -613,7 +635,14 @@ class Container(SSZType, metaclass=ContainerMeta):
         )
 
     def __hash__(self):
-        return hash(self.hash_tree_root())
+        # Cached per (spec, instance): containers are immutable by contract
+        # (in-place mutation of nested lists is unsupported; use .copy()).
+        spec = get_chain_spec()
+        cache = self.__dict__.setdefault("_root_cache", {})
+        root = cache.get(spec.name)
+        if root is None:
+            root = cache[spec.name] = self.hash_tree_root(spec)
+        return hash(root)
 
     def __repr__(self):
         inner = ", ".join(f"{f}={getattr(self, f)!r}" for f in type(self).__ssz_schema__)

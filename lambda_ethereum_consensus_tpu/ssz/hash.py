@@ -60,19 +60,24 @@ class HashBackend(Protocol):
         ...
 
 
+def hashlib_level(blocks: np.ndarray) -> np.ndarray:
+    """Hash one Merkle level on host: ``(N, 64) uint8`` → ``(N, 32) uint8``."""
+    n = blocks.shape[0]
+    out = np.empty((n, 32), dtype=np.uint8)
+    buf = blocks.tobytes()
+    digest = hashlib.sha256
+    for i in range(n):
+        out[i] = np.frombuffer(digest(buf[i * 64 : i * 64 + 64]).digest(), np.uint8)
+    return out
+
+
 class HashlibBackend:
     """Host backend: per-node hashlib.sha256. Correctness oracle."""
 
     name = "hashlib"
 
     def hash_level(self, blocks: np.ndarray) -> np.ndarray:
-        n = blocks.shape[0]
-        out = np.empty((n, 32), dtype=np.uint8)
-        buf = blocks.tobytes()
-        digest = hashlib.sha256
-        for i in range(n):
-            out[i] = np.frombuffer(digest(buf[i * 64 : i * 64 + 64]).digest(), np.uint8)
-        return out
+        return hashlib_level(blocks)
 
 
 _backend: HashBackend = HashlibBackend()

@@ -1,0 +1,62 @@
+"""Batched SHA-256 kernels vs the hashlib oracle."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lambda_ethereum_consensus_tpu.ops import sha256 as ops
+from lambda_ethereum_consensus_tpu.ssz import merkleize_chunks
+from lambda_ethereum_consensus_tpu.ssz.hash import HashlibBackend
+
+
+def _oracle(blocks: np.ndarray) -> np.ndarray:
+    return np.stack(
+        [
+            np.frombuffer(hashlib.sha256(row.tobytes()).digest(), np.uint8)
+            for row in blocks
+        ]
+    )
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 128, 1000])
+def test_hash_blocks_matches_hashlib(n):
+    rng = np.random.default_rng(n)
+    blocks = rng.integers(0, 256, size=(n, 64), dtype=np.uint8)
+    assert np.array_equal(ops.hash_blocks(blocks), _oracle(blocks))
+
+
+def test_pad_schedule_constant():
+    # The constant-folded second block must reproduce hashlib exactly for a
+    # block of zeros (catches any error in the padding-block schedule).
+    blocks = np.zeros((4, 64), np.uint8)
+    assert np.array_equal(ops.hash_blocks(blocks), _oracle(blocks))
+
+
+@pytest.mark.parametrize("n", [1, 3, 8, 515])
+def test_device_backend_hash_level(n):
+    rng = np.random.default_rng(n)
+    blocks = rng.integers(0, 256, size=(n, 64), dtype=np.uint8)
+    backend = ops.DeviceHashBackend(threshold=0)
+    assert np.array_equal(backend.hash_level(blocks), _oracle(blocks))
+
+
+@pytest.mark.parametrize("count,limit", [(1, 1), (2, 4), (5, 8), (600, 1024), (1000, 1 << 40)])
+def test_device_merkle_tree_matches_host(count, limit):
+    rng = np.random.default_rng(count)
+    chunks = rng.integers(0, 256, size=(count, 32), dtype=np.uint8)
+    host = merkleize_chunks(chunks, limit, backend=HashlibBackend())
+    device = merkleize_chunks(
+        chunks, limit, backend=ops.DeviceHashBackend(threshold=0, tree_threshold=0)
+    )
+    assert device == host
+
+
+def test_pallas_kernel_interpret_mode():
+    rng = np.random.default_rng(0)
+    n = 64
+    blocks = rng.integers(0, 256, size=(n, 64), dtype=np.uint8)
+    planes = ops._to_word_planes(blocks, ops._SUBLANES)
+    digests = ops.hash_blocks_pallas(planes, interpret=True)
+    got = ops._from_digest_planes(np.asarray(digests), n)
+    assert np.array_equal(got, _oracle(blocks))
