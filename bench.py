@@ -1,0 +1,83 @@
+"""Round benchmark: batched SSZ Merkleization node hashing on device.
+
+Prints ONE JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+
+Metric: SHA-256 Merkle-node hashes/sec (64-byte nodes), the primitive under
+``Ssz.hash_tree_root`` (ref: native/ssz_nif tree_hash crate).  Baseline is
+single-thread host hashlib — the closest stand-in for the reference's native
+CPU path on this machine.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bench_device(blocks: np.ndarray, iters: int = 20) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from lambda_ethereum_consensus_tpu.ops.sha256 import (
+        hash_blocks_jnp,
+        hash_blocks_pallas,
+        _to_word_planes,
+    )
+
+    n = blocks.shape[0]
+    if jax.default_backend() == "tpu":
+        rows = n // 128
+        planes = jnp.asarray(_to_word_planes(blocks, rows))
+        fn = lambda: hash_blocks_pallas(planes)
+    else:
+        words = jnp.asarray(np.ascontiguousarray(blocks).view(">u4").astype(np.uint32))
+        fn = lambda: hash_blocks_jnp(words)
+
+    fn().block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return n * iters / dt
+
+
+def _bench_host(blocks: np.ndarray, budget_s: float = 2.0) -> float:
+    import hashlib
+
+    n = blocks.shape[0]
+    raw = [bytes(b) for b in blocks]
+    done = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        for b in raw[: min(n, 4096)]:
+            hashlib.sha256(b).digest()
+        done += min(n, 4096)
+    dt = time.perf_counter() - t0
+    return done / dt
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    n = 1 << 17  # 131072 64-byte nodes per dispatch
+    blocks = rng.integers(0, 256, size=(n, 64), dtype=np.uint8)
+
+    device_hps = _bench_device(blocks)
+    host_hps = _bench_host(blocks)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ssz_merkle_node_hashes_per_sec",
+                "value": round(device_hps, 1),
+                "unit": "hashes/s",
+                "vs_baseline": round(device_hps / host_hps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -7,11 +7,22 @@ Setting JAX_PLATFORMS / XLA_FLAGS must happen before jax initializes.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Keep subprocesses spawned by tests on the CPU backend too.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# On axon machines sitecustomize imports jax at interpreter startup, which
+# snapshots JAX_PLATFORMS before this file runs — env mutation alone is a
+# no-op there.  jax.config.update works until the backend initializes.
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
