@@ -1,0 +1,1 @@
+"""Cryptographic backends: BLS12-381 signatures (ref: native/bls_nif)."""

@@ -1,0 +1,37 @@
+"""BLS12-381 signatures for the beacon chain (ref: native/bls_nif, lib/bls.ex).
+
+From-scratch implementation: extension-field tower (:mod:`.fields`), curve
+groups + ZCash serialization (:mod:`.curve`), optimal ate pairing
+(:mod:`.pairing`), RFC 9380 hash-to-G2 (:mod:`.hash_to_curve`) and the eth2
+signature scheme surface (:mod:`.api`).
+"""
+
+from .api import (
+    BlsError,
+    G2_POINT_AT_INFINITY,
+    aggregate,
+    aggregate_verify,
+    eth_aggregate_pubkeys,
+    eth_fast_aggregate_verify,
+    fast_aggregate_verify,
+    key_validate,
+    keygen,
+    sign,
+    sk_to_pk,
+    verify,
+)
+
+__all__ = [
+    "BlsError",
+    "G2_POINT_AT_INFINITY",
+    "aggregate",
+    "aggregate_verify",
+    "eth_aggregate_pubkeys",
+    "eth_fast_aggregate_verify",
+    "fast_aggregate_verify",
+    "key_validate",
+    "keygen",
+    "sign",
+    "sk_to_pk",
+    "verify",
+]

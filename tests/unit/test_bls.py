@@ -1,0 +1,211 @@
+"""BLS12-381 host backend: field tower, curve, pairing, signature scheme."""
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
+from lambda_ethereum_consensus_tpu.crypto.bls import fields as F
+from lambda_ethereum_consensus_tpu.crypto.bls import pairing as PR
+from lambda_ethereum_consensus_tpu.crypto.bls.fields import P, R
+from lambda_ethereum_consensus_tpu.crypto.bls.hash_to_curve import hash_to_g2
+
+
+# ------------------------------------------------------------------ fields
+
+def test_fq2_inverse_roundtrip():
+    a = (12345678901234567890, 98765432109876543210)
+    assert F.fq2_mul(a, F.fq2_inv(a)) == F.FQ2_ONE
+
+
+def test_fq6_inverse_roundtrip():
+    a = ((1, 2), (3, 4), (5, 6))
+    assert F.fq6_mul(a, F.fq6_inv(a)) == F.FQ6_ONE
+
+
+def test_fq12_inverse_roundtrip():
+    a = (((1, 2), (3, 4), (5, 6)), ((7, 8), (9, 10), (11, 12)))
+    assert F.fq12_mul(a, F.fq12_inv(a)) == F.FQ12_ONE
+
+
+def test_frobenius_is_pth_power():
+    a = (((1, 2), (3, 4), (5, 6)), ((7, 8), (9, 10), (11, 12)))
+    assert F.fq12_frobenius(a) == F.fq12_pow(a, P)
+
+
+def test_fq2_sqrt():
+    a = (1234567, 7654321)
+    sq = F.fq2_sq(a)
+    root = F.fq2_sqrt(sq)
+    assert root in (a, F.fq2_neg(a))
+
+
+def test_fq2_sqrt_nonresidue_returns_none():
+    # (u) * a^2 is a non-residue when u is (quadratic character is preserved)
+    found_none = False
+    for k in range(2, 10):
+        if F.fq2_sqrt((k, 1)) is None:
+            found_none = True
+            break
+    assert found_none
+
+
+# ------------------------------------------------------------------- curve
+
+def test_generator_subgroup():
+    assert C.g1.in_subgroup(C.G1_GENERATOR)
+    assert C.g2.in_subgroup(C.G2_GENERATOR)
+
+
+def test_g1_serialization_roundtrip():
+    for k in (1, 2, 3, 0xDEADBEEF, R - 1):
+        pt = C.g1.multiply(C.G1_GENERATOR, k)
+        assert C.g1_from_bytes(C.g1_to_bytes(pt)) == pt
+
+
+def test_g2_serialization_roundtrip():
+    for k in (1, 2, 3, 0xDEADBEEF, R - 1):
+        pt = C.g2.multiply(C.G2_GENERATOR, k)
+        assert C.g2_from_bytes(C.g2_to_bytes(pt)) == pt
+
+
+def test_infinity_serialization():
+    assert C.g1_to_bytes(None)[0] == 0xC0
+    assert C.g1_from_bytes(C.g1_to_bytes(None)) is None
+    assert C.g2_from_bytes(C.g2_to_bytes(None)) is None
+
+
+def test_scalar_mul_matches_affine_adds():
+    acc = None
+    for i in range(1, 6):
+        acc = C.g1.affine_add(acc, C.G1_GENERATOR)
+        assert acc == C.g1.multiply(C.G1_GENERATOR, i)
+
+
+def test_bad_encodings_rejected():
+    with pytest.raises(C.DeserializationError):
+        C.g1_from_bytes(b"\x00" * 48)  # no compression bit
+    with pytest.raises(C.DeserializationError):
+        C.g1_from_bytes(bytes([0x80]) + b"\xff" * 47)  # x >= p
+    with pytest.raises(C.DeserializationError):
+        C.g1_from_bytes(bytes([0xC0]) + b"\x01" + b"\x00" * 46)  # dirty infinity
+
+
+# ----------------------------------------------------------------- pairing
+
+def test_pairing_bilinearity():
+    p2 = C.g1.multiply(C.G1_GENERATOR, 2)
+    q2 = C.g2.multiply(C.G2_GENERATOR, 2)
+    e_p2_q = PR.pairing(p2, C.G2_GENERATOR)
+    e_p_q2 = PR.pairing(C.G1_GENERATOR, q2)
+    e_sq = F.fq12_mul(
+        PR.pairing(C.G1_GENERATOR, C.G2_GENERATOR),
+        PR.pairing(C.G1_GENERATOR, C.G2_GENERATOR),
+    )
+    assert e_p2_q == e_p_q2 == e_sq
+
+
+def test_pairing_nondegenerate():
+    assert PR.pairing(C.G1_GENERATOR, C.G2_GENERATOR) != F.FQ12_ONE
+
+
+def test_pairing_inverse_cancels():
+    neg_p = C.g1.affine_neg(C.G1_GENERATOR)
+    assert PR.pairing_check(
+        [(C.G1_GENERATOR, C.G2_GENERATOR), (neg_p, C.G2_GENERATOR)]
+    )
+
+
+def test_fast_final_exp_matches_naive_cubed():
+    # The addition-chain hard part computes the exponent *3; compare against
+    # the naive exponentiation cubed.
+    f = PR.miller_loop(C.G1_GENERATOR, C.G2_GENERATOR)
+    fast = PR.final_exponentiation(f)
+    naive = PR.final_exponentiation_naive(f)
+    assert fast == F.fq12_mul(F.fq12_mul(naive, naive), naive)
+
+
+# ----------------------------------------------------------- hash-to-curve
+
+def test_hash_to_g2_in_subgroup():
+    pt = hash_to_g2(b"some message")
+    assert pt is not None
+    assert C.g2.in_subgroup(pt)
+
+
+def test_hash_to_g2_deterministic_and_injective_ish():
+    assert hash_to_g2(b"a") == hash_to_g2(b"a")
+    assert hash_to_g2(b"a") != hash_to_g2(b"b")
+
+
+# --------------------------------------------------------------- signature
+
+SK1 = (1).to_bytes(32, "big")
+SK3 = (3).to_bytes(32, "big")
+MSG = b"beacon block root"
+
+
+def test_pk_of_one_is_generator():
+    assert bls.sk_to_pk(SK1).hex() == (
+        "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+
+
+def test_sign_verify_roundtrip():
+    pk = bls.sk_to_pk(SK3)
+    sig = bls.sign(SK3, MSG)
+    assert len(sig) == 96
+    assert bls.verify(pk, MSG, sig)
+    assert not bls.verify(pk, b"other message", sig)
+    assert not bls.verify(bls.sk_to_pk(SK1), MSG, sig)
+
+
+def test_aggregate_and_fast_aggregate_verify():
+    sks = [(i + 10).to_bytes(32, "big") for i in range(3)]
+    pks = [bls.sk_to_pk(sk) for sk in sks]
+    sigs = [bls.sign(sk, MSG) for sk in sks]
+    agg = bls.aggregate(sigs)
+    assert bls.fast_aggregate_verify(pks, MSG, agg)
+    assert not bls.fast_aggregate_verify(pks, b"wrong", agg)
+    assert not bls.fast_aggregate_verify(pks[:2], MSG, agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = [(i + 20).to_bytes(32, "big") for i in range(2)]
+    pks = [bls.sk_to_pk(sk) for sk in sks]
+    msgs = [b"message one", b"message two"]
+    sigs = [bls.sign(sk, m) for sk, m in zip(sks, msgs)]
+    agg = bls.aggregate(sigs)
+    assert bls.aggregate_verify(pks, msgs, agg)
+    assert not bls.aggregate_verify(pks, msgs[::-1], agg)
+
+
+def test_eth_fast_aggregate_verify_empty():
+    assert bls.eth_fast_aggregate_verify([], MSG, bls.G2_POINT_AT_INFINITY)
+    assert not bls.eth_fast_aggregate_verify([], MSG, bls.sign(SK1, MSG))
+
+
+def test_eth_aggregate_pubkeys():
+    pks = [bls.sk_to_pk((i + 1).to_bytes(32, "big")) for i in range(3)]
+    agg = bls.eth_aggregate_pubkeys(pks)
+    # sum of sk 1+2+3 = 6
+    assert agg == bls.sk_to_pk((6).to_bytes(32, "big"))
+    with pytest.raises(bls.BlsError):
+        bls.eth_aggregate_pubkeys([])
+
+
+def test_aggregate_empty_errors():
+    with pytest.raises(bls.BlsError):
+        bls.aggregate([])
+
+
+def test_key_validate():
+    assert bls.key_validate(bls.sk_to_pk(SK3))
+    assert not bls.key_validate(b"\x00" * 48)
+    infinity_pk = bytes([0xC0]) + b"\x00" * 47
+    assert not bls.key_validate(infinity_pk)
+
+
+def test_keygen_produces_valid_key():
+    sk = bls.keygen(b"\x42" * 32)
+    assert bls.key_validate(bls.sk_to_pk(sk))
