@@ -131,18 +131,8 @@ class GroupOps:
         return (x3, y3, z3)
 
     def multiply(self, pt: AffinePoint, k: int) -> AffinePoint:
-        """Scalar multiplication (double-and-add over Jacobian coordinates)."""
-        k = k % R if 0 <= k else k % R
-        if pt is None or k == 0:
-            return None
-        acc = (self.one, self.one, self.zero)
-        base = self.to_jacobian(pt)
-        while k:
-            if k & 1:
-                acc = self.jac_add(acc, base)
-            base = self.jac_double(base)
-            k >>= 1
-        return self.from_jacobian(acc)
+        """Scalar multiplication with the scalar reduced mod R."""
+        return self.multiply_raw(pt, k % R)
 
     def multiply_raw(self, pt: AffinePoint, k: int) -> AffinePoint:
         """Scalar multiplication WITHOUT reducing k mod R (cofactor clearing)."""
@@ -269,7 +259,12 @@ def _split_flags(data: bytes, size: int) -> tuple[int, bool, bool]:
     byte0 = data[0]
     if not byte0 & _C_FLAG:
         raise DeserializationError("uncompressed encodings not supported")
-    return byte0 & 0x1F, bool(byte0 & _I_FLAG), bool(byte0 & _S_FLAG)
+    infinity = bool(byte0 & _I_FLAG)
+    sign = bool(byte0 & _S_FLAG)
+    if infinity and sign:
+        # non-canonical: the ZCash format forbids S with I (blst rejects too)
+        raise DeserializationError("sign flag set on infinity encoding")
+    return byte0 & 0x1F, infinity, sign
 
 
 def g1_from_bytes(data: bytes, subgroup_check: bool = True) -> AffinePoint:

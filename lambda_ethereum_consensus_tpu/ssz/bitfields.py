@@ -101,6 +101,10 @@ class Bits:
         n = self._len if first_n is None else first_n
         return all(self[i] for i in range(n))
 
+    def all_set_range(self, start: int, stop: int) -> bool:
+        """True iff bits [start, stop) are all set (justification-bit windows)."""
+        return all(self[i] for i in range(start, stop))
+
     def indices(self) -> list[int]:
         """Indices of set bits, ascending."""
         return [i for i in range(self._len) if self[i]]

@@ -1,0 +1,27 @@
+"""Pure consensus core: the beacon-chain state transition (capella).
+
+Replaces the reference's ``StateTransition`` layer (ref: lib/lambda_ethereum_
+consensus/state_transition/*, 2321 LoC) with a complete implementation —
+including the pieces the reference stubs out (justification/finalization,
+block header, randao, eth1 data, deposits, execution payload; ref:
+state_transition/state_transition.ex:116-126, epoch_processing.ex:346-349).
+
+Design: pure functions over immutable SSZ containers, with a mutable working
+state (:class:`~.mutable.BeaconStateMut`) inside a transition and numpy
+vectorization for every O(n_validators) pass — the data-parallel shape that
+dispatches to the TPU backend for the hashing/signature hot paths.
+"""
+
+from .core import (
+    StateTransitionError,
+    process_slot,
+    process_slots,
+    state_transition,
+)
+
+__all__ = [
+    "StateTransitionError",
+    "process_slot",
+    "process_slots",
+    "state_transition",
+]

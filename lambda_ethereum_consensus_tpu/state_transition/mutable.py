@@ -1,0 +1,124 @@
+"""Mutable working state for the duration of one state transition.
+
+SSZ containers are immutable (``Container.__setattr__`` raises); spec code is
+mutation-heavy.  ``BeaconStateMut`` unwraps a ``BeaconState`` into plain
+attributes with shallow-copied lists, lets the transition mutate freely, and
+freezes back into a container at the end.  It also maintains *columnar* numpy
+views of the validator registry (effective balances, activation/exit epochs,
+slashed flags) so epoch passes run vectorized instead of per-validator Python
+loops — the reference walks Elixir lists per validator (ref:
+state_transition/epoch_processing.ex:11-378); here the registry is the
+data-parallel axis.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+from ..types.beacon import BeaconState, Validator
+
+_LIST_FIELDS = (
+    "block_roots",
+    "state_roots",
+    "historical_roots",
+    "eth1_data_votes",
+    "validators",
+    "balances",
+    "randao_mixes",
+    "slashings",
+    "previous_epoch_participation",
+    "current_epoch_participation",
+    "inactivity_scores",
+    "historical_summaries",
+)
+
+
+class BeaconStateMut:
+    """Working copy of a BeaconState; mutate freely, then :meth:`freeze`."""
+
+    def __init__(self, state: BeaconState):
+        for name in BeaconState.fields():
+            value = getattr(state, name)
+            if name in _LIST_FIELDS:
+                value = list(value)
+            object.__setattr__(self, name, value)
+        self._registry_cache: dict | None = None
+        self._pubkey_index: dict[bytes, int] | None = None
+
+    # -- freeze back to the immutable container
+    def freeze(self) -> BeaconState:
+        fields = {name: getattr(self, name) for name in BeaconState.fields()}
+        out = object.__new__(BeaconState)
+        for k, v in fields.items():
+            object.__setattr__(out, k, v)
+        return out
+
+    # -- registry columns (numpy views over the validators list)
+    def registry(self) -> dict:
+        """Columnar registry arrays; invalidated by :meth:`touch_registry`."""
+        if self._registry_cache is None:
+            vals = self.validators
+            n = len(vals)
+            cols = {
+                "effective_balance": np.fromiter(
+                    (v.effective_balance for v in vals), np.uint64, n
+                ),
+                "slashed": np.fromiter((bool(v.slashed) for v in vals), np.bool_, n),
+                "activation_eligibility_epoch": np.fromiter(
+                    (v.activation_eligibility_epoch for v in vals), np.uint64, n
+                ),
+                "activation_epoch": np.fromiter(
+                    (v.activation_epoch for v in vals), np.uint64, n
+                ),
+                "exit_epoch": np.fromiter((v.exit_epoch for v in vals), np.uint64, n),
+                "withdrawable_epoch": np.fromiter(
+                    (v.withdrawable_epoch for v in vals), np.uint64, n
+                ),
+            }
+            self._registry_cache = cols
+        return self._registry_cache
+
+    def touch_registry(self) -> None:
+        """Invalidate registry columns after mutating ``validators``."""
+        self._registry_cache = None
+
+    def update_validator(self, index: int, **changes) -> None:
+        self.validators[index] = self.validators[index].copy(**changes)
+        self.touch_registry()
+
+    def pubkey_index(self) -> dict[bytes, int]:
+        """pubkey -> validator index map (pubkeys never change once added)."""
+        if self._pubkey_index is None:
+            self._pubkey_index = {
+                bytes(v.pubkey): i for i, v in enumerate(self.validators)
+            }
+        return self._pubkey_index
+
+    def append_validator(self, validator, balance: int) -> None:
+        """Registry append (deposits): keeps the pubkey map incremental."""
+        index = len(self.validators)
+        self.validators.append(validator)
+        self.balances.append(balance)
+        self.previous_epoch_participation.append(0)
+        self.current_epoch_participation.append(0)
+        self.inactivity_scores.append(0)
+        if self._pubkey_index is not None:
+            self._pubkey_index[bytes(validator.pubkey)] = index
+        self.touch_registry()
+
+    def balances_array(self) -> np.ndarray:
+        return np.asarray(self.balances, dtype=np.uint64)
+
+    def set_balances(self, arr: Iterable[int]) -> None:
+        self.balances = [int(b) for b in arr]
+
+    def participation_array(self, which: str) -> np.ndarray:
+        return np.asarray(getattr(self, f"{which}_epoch_participation"), np.uint8)
+
+    def active_indices(self, epoch: int) -> np.ndarray:
+        """Indices active at ``epoch`` (vectorized is_active_validator)."""
+        reg = self.registry()
+        mask = (reg["activation_epoch"] <= epoch) & (epoch < reg["exit_epoch"])
+        return np.nonzero(mask)[0]

@@ -88,6 +88,10 @@ def test_bad_encodings_rejected():
         C.g1_from_bytes(bytes([0x80]) + b"\xff" * 47)  # x >= p
     with pytest.raises(C.DeserializationError):
         C.g1_from_bytes(bytes([0xC0]) + b"\x01" + b"\x00" * 46)  # dirty infinity
+    with pytest.raises(C.DeserializationError):
+        C.g1_from_bytes(bytes([0xE0]) + b"\x00" * 47)  # S flag on infinity
+    with pytest.raises(C.DeserializationError):
+        C.g2_from_bytes(bytes([0xE0]) + b"\x00" * 95)  # S flag on infinity
 
 
 # ----------------------------------------------------------------- pairing
