@@ -1,0 +1,29 @@
+"""Fork choice: LMD-GHOST + Casper FFG store and handlers.
+
+Replaces the reference's fork-choice layer (ref: lib/lambda_ethereum_consensus/
+fork_choice/{handlers.ex,helpers.ex}, lib/ssz_types/store.ex) with the full
+spec v1.3 behavior — including the state-transition call the reference stubs
+out on ``on_block`` (ref: fork_choice/handlers.ex:80-88) and the unrealized-
+checkpoint (pulled-up tip) machinery.
+
+Layout: :mod:`.store` (the Store object + constructor), :mod:`.handlers`
+(``on_tick`` / ``on_block`` / ``on_attestation`` / ``on_attester_slashing``),
+:mod:`.head` (``get_head`` with batched vote-weight accumulation).
+"""
+
+from .handlers import on_attestation, on_attester_slashing, on_block, on_tick
+from .head import get_head, get_weight
+from .store import ForkChoiceError, LatestMessage, Store, get_forkchoice_store
+
+__all__ = [
+    "ForkChoiceError",
+    "LatestMessage",
+    "Store",
+    "get_forkchoice_store",
+    "get_head",
+    "get_weight",
+    "on_attestation",
+    "on_attester_slashing",
+    "on_block",
+    "on_tick",
+]

@@ -1,0 +1,255 @@
+"""Fork-choice handlers (ref: lib/.../fork_choice/handlers.ex:28-350).
+
+``on_block`` runs the *full* state transition (the reference copies the parent
+state instead — ref: handlers.ex:80-88 — with the real path parked at
+:157-189); unrealized-checkpoint pull-ups follow spec v1.3.
+"""
+
+from __future__ import annotations
+
+from ..config import ChainSpec, constants, get_chain_spec
+from ..state_transition import accessors, misc
+from ..state_transition.core import state_transition
+from ..state_transition.epoch import process_justification_and_finalization
+from ..state_transition.errors import SpecError
+from ..state_transition.mutable import BeaconStateMut
+from ..state_transition.predicates import (
+    is_slashable_attestation_data,
+    is_valid_indexed_attestation,
+)
+from ..types.beacon import Attestation, AttesterSlashing, Checkpoint, SignedBeaconBlock
+from .store import ForkChoiceError, LatestMessage, Store, checkpoint_key
+
+
+def expect(cond: bool, reason: str) -> None:
+    if not cond:
+        raise ForkChoiceError(reason)
+
+
+# -------------------------------------------------------------------- tick
+
+def on_tick(store: Store, time: int, spec: ChainSpec | None = None) -> None:
+    """Advance wall-clock time slot by slot (ref: handlers.ex:28-42)."""
+    spec = spec or get_chain_spec()
+    tick_slot = (time - store.genesis_time) // spec.SECONDS_PER_SLOT
+    while store.current_slot(spec) < tick_slot:
+        previous_time = store.genesis_time + (store.current_slot(spec) + 1) * spec.SECONDS_PER_SLOT
+        _on_tick_per_slot(store, previous_time, spec)
+    _on_tick_per_slot(store, time, spec)
+
+
+def _on_tick_per_slot(store: Store, time: int, spec: ChainSpec) -> None:
+    previous_slot = store.current_slot(spec)
+    store.time = time
+    current_slot = store.current_slot(spec)
+    if current_slot > previous_slot:
+        store.proposer_boost_root = b"\x00" * 32
+        if store.slots_since_epoch_start(spec) == 0:
+            update_checkpoints(
+                store,
+                store.unrealized_justified_checkpoint,
+                store.unrealized_finalized_checkpoint,
+            )
+
+
+def update_checkpoints(
+    store: Store, justified: Checkpoint, finalized: Checkpoint
+) -> None:
+    if justified.epoch > store.justified_checkpoint.epoch:
+        store.justified_checkpoint = justified
+    if finalized.epoch > store.finalized_checkpoint.epoch:
+        store.finalized_checkpoint = finalized
+
+
+def update_unrealized_checkpoints(
+    store: Store, justified: Checkpoint, finalized: Checkpoint
+) -> None:
+    if justified.epoch > store.unrealized_justified_checkpoint.epoch:
+        store.unrealized_justified_checkpoint = justified
+    if finalized.epoch > store.unrealized_finalized_checkpoint.epoch:
+        store.unrealized_finalized_checkpoint = finalized
+
+
+# ------------------------------------------------------------------- block
+
+def on_block(
+    store: Store,
+    signed_block: SignedBeaconBlock,
+    execution_engine=None,
+    spec: ChainSpec | None = None,
+) -> bytes:
+    """Validate + apply a block; returns its root (ref: handlers.ex:51-90)."""
+    spec = spec or get_chain_spec()
+    block = signed_block.message
+    parent_root = bytes(block.parent_root)
+    expect(parent_root in store.block_states, "unknown parent block")
+    pre_state = store.block_states[parent_root]
+    expect(store.current_slot(spec) >= block.slot, "block is from the future")
+    finalized_slot = misc.compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch, spec
+    )
+    expect(block.slot > finalized_slot, "block slot not after finalized slot")
+    expect(
+        store.get_checkpoint_block(
+            parent_root, store.finalized_checkpoint.epoch, spec
+        )
+        == bytes(store.finalized_checkpoint.root),
+        "block does not descend from finalized checkpoint",
+    )
+
+    # The real compute: full state transition with validation on.
+    state = state_transition(
+        pre_state, signed_block, validate_result=True,
+        execution_engine=execution_engine, spec=spec,
+    )
+    root = block.hash_tree_root(spec)
+    store.add_block(root, block, state)
+
+    # proposer boost for timely blocks (first 1/INTERVALS_PER_SLOT of the slot)
+    time_into_slot = (store.time - store.genesis_time) % spec.SECONDS_PER_SLOT
+    is_before_attesting_interval = time_into_slot < (
+        spec.SECONDS_PER_SLOT // constants.INTERVALS_PER_SLOT
+    )
+    if store.current_slot(spec) == block.slot and is_before_attesting_interval:
+        store.proposer_boost_root = root
+
+    update_checkpoints(
+        store, state.current_justified_checkpoint, state.finalized_checkpoint
+    )
+    compute_pulled_up_tip(store, root, state, spec)
+    return root
+
+
+def compute_pulled_up_tip(
+    store: Store, block_root: bytes, state, spec: ChainSpec
+) -> None:
+    """Unrealized justification: run the FFG pass one epoch early
+    (ref: handlers.ex compute_pulled_up_tip / spec v1.3)."""
+    ws = BeaconStateMut(state)
+    process_justification_and_finalization(ws, spec)
+    unrealized_justified = ws.current_justified_checkpoint
+    unrealized_finalized = ws.finalized_checkpoint
+    store.unrealized_justifications[block_root] = unrealized_justified
+    update_unrealized_checkpoints(store, unrealized_justified, unrealized_finalized)
+
+    block = store.blocks[block_root]
+    block_epoch = misc.compute_epoch_at_slot(block.slot, spec)
+    current_epoch = misc.compute_epoch_at_slot(store.current_slot(spec), spec)
+    if block_epoch < current_epoch:
+        update_checkpoints(store, unrealized_justified, unrealized_finalized)
+
+
+# ------------------------------------------------------------- attestation
+
+def validate_target_epoch_against_current_time(
+    store: Store, attestation: Attestation, spec: ChainSpec
+) -> None:
+    target = attestation.data.target
+    current_epoch = misc.compute_epoch_at_slot(store.current_slot(spec), spec)
+    previous_epoch = max(current_epoch - 1, constants.GENESIS_EPOCH)
+    expect(
+        target.epoch in (current_epoch, previous_epoch),
+        "attestation target epoch not current or previous",
+    )
+
+
+def validate_on_attestation(
+    store: Store, attestation: Attestation, is_from_block: bool, spec: ChainSpec
+) -> None:
+    target = attestation.data.target
+    if not is_from_block:
+        validate_target_epoch_against_current_time(store, attestation, spec)
+    expect(
+        target.epoch == misc.compute_epoch_at_slot(attestation.data.slot, spec),
+        "attestation target epoch does not match slot",
+    )
+    expect(bytes(target.root) in store.blocks, "unknown attestation target block")
+    beacon_block_root = bytes(attestation.data.beacon_block_root)
+    expect(beacon_block_root in store.blocks, "unknown attestation head block")
+    expect(
+        store.blocks[beacon_block_root].slot <= attestation.data.slot,
+        "attestation head block is newer than attestation",
+    )
+    expect(
+        store.get_checkpoint_block(beacon_block_root, target.epoch, spec)
+        == bytes(target.root),
+        "attestation target does not match head block's checkpoint",
+    )
+    expect(
+        store.current_slot(spec) >= attestation.data.slot + 1,
+        "attestation is for a future slot",
+    )
+
+
+def store_target_checkpoint_state(
+    store: Store, target: Checkpoint, spec: ChainSpec
+) -> None:
+    from ..state_transition.core import process_slots
+
+    key = checkpoint_key(target)
+    if key not in store.checkpoint_states:
+        base = store.block_states[bytes(target.root)]
+        start_slot = misc.compute_start_slot_at_epoch(target.epoch, spec)
+        if base.slot < start_slot:
+            base = process_slots(base, start_slot, spec)
+        store.checkpoint_states[key] = base
+
+
+def update_latest_messages(
+    store: Store, attesting_indices, attestation: Attestation
+) -> None:
+    target = attestation.data.target
+    beacon_block_root = bytes(attestation.data.beacon_block_root)
+    non_equivocating = [
+        i for i in attesting_indices if i not in store.equivocating_indices
+    ]
+    for i in non_equivocating:
+        prev = store.latest_messages.get(i)
+        if prev is None or target.epoch > prev.epoch:
+            store.latest_messages[i] = LatestMessage(
+                epoch=int(target.epoch), root=beacon_block_root
+            )
+
+
+def on_attestation(
+    store: Store,
+    attestation: Attestation,
+    is_from_block: bool = False,
+    spec: ChainSpec | None = None,
+) -> None:
+    """Validate and record an attestation's LMD vote
+    (ref: handlers.ex:100-119)."""
+    spec = spec or get_chain_spec()
+    validate_on_attestation(store, attestation, is_from_block, spec)
+    store_target_checkpoint_state(store, attestation.data.target, spec)
+    target_state = store.checkpoint_states[checkpoint_key(attestation.data.target)]
+    try:
+        indexed = accessors.get_indexed_attestation(target_state, attestation, spec)
+        expect(
+            is_valid_indexed_attestation(target_state, indexed, spec),
+            "invalid attestation signature",
+        )
+    except SpecError as e:
+        raise ForkChoiceError(str(e)) from None
+    update_latest_messages(store, indexed.attesting_indices, attestation)
+
+
+# -------------------------------------------------------- attester slashing
+
+def on_attester_slashing(
+    store: Store, attester_slashing: AttesterSlashing, spec: ChainSpec | None = None
+) -> None:
+    """Track equivocating validators (ref: handlers.ex:127-154)."""
+    spec = spec or get_chain_spec()
+    att1 = attester_slashing.attestation_1
+    att2 = attester_slashing.attestation_2
+    expect(
+        is_slashable_attestation_data(att1.data, att2.data),
+        "attestations are not slashable",
+    )
+    state = store.block_states[bytes(store.justified_checkpoint.root)]
+    expect(is_valid_indexed_attestation(state, att1, spec), "attestation 1 invalid")
+    expect(is_valid_indexed_attestation(state, att2, spec), "attestation 2 invalid")
+    store.equivocating_indices.update(
+        set(att1.attesting_indices) & set(att2.attesting_indices)
+    )

@@ -1,0 +1,116 @@
+"""The fork-choice Store (ref: lib/ssz_types/store.ex:1-61).
+
+A host-side mutable object — fork choice is branchy, latency-sensitive
+control flow that stays on CPU (SURVEY.md §2.3); only the vote-weight
+reductions in :mod:`.head` are batched array math.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..config import ChainSpec, constants, get_chain_spec
+from ..state_transition import accessors, misc
+from ..state_transition.errors import SpecError
+from ..types.beacon import BeaconBlock, BeaconState, Checkpoint
+
+
+class ForkChoiceError(SpecError):
+    """Message rejected by fork-choice validation."""
+
+
+@dataclass(frozen=True)
+class LatestMessage:
+    epoch: int
+    root: bytes
+
+
+@dataclass
+class Store:
+    time: int
+    genesis_time: int
+    justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    unrealized_justified_checkpoint: Checkpoint
+    unrealized_finalized_checkpoint: Checkpoint
+    proposer_boost_root: bytes = b"\x00" * 32
+    equivocating_indices: set[int] = field(default_factory=set)
+    blocks: dict[bytes, BeaconBlock] = field(default_factory=dict)
+    block_states: dict[bytes, BeaconState] = field(default_factory=dict)
+    checkpoint_states: dict[tuple[int, bytes], BeaconState] = field(default_factory=dict)
+    latest_messages: dict[int, LatestMessage] = field(default_factory=dict)
+    unrealized_justifications: dict[bytes, Checkpoint] = field(default_factory=dict)
+    # children index maintained on insert so head walks are O(tree) not O(blocks^2)
+    children: dict[bytes, list[bytes]] = field(default_factory=dict)
+
+    # ---------------------------------------------------------- time helpers
+    def current_slot(self, spec: ChainSpec | None = None) -> int:
+        spec = spec or get_chain_spec()
+        return constants.GENESIS_SLOT + (self.time - self.genesis_time) // spec.SECONDS_PER_SLOT
+
+    def slots_since_epoch_start(self, spec: ChainSpec | None = None) -> int:
+        spec = spec or get_chain_spec()
+        return self.current_slot(spec) - misc.compute_start_slot_at_epoch(
+            misc.compute_epoch_at_slot(self.current_slot(spec), spec), spec
+        )
+
+    # ---------------------------------------------------------- tree helpers
+    def get_ancestor(self, root: bytes, slot: int) -> bytes:
+        """Ancestor of ``root`` at or before ``slot``
+        (ref: lib/ssz_types/store.ex:44-55).
+
+        The walk clamps at the anchor: when a parent is not in the store
+        (pruned history below the weak-subjectivity anchor), the oldest known
+        ancestor is returned — which is how a mid-epoch anchor still answers
+        checkpoint-block queries for its own epoch.
+        """
+        block = self.blocks[root]
+        while block.slot > slot:
+            parent = bytes(block.parent_root)
+            if parent not in self.blocks:
+                return root
+            root = parent
+            block = self.blocks[root]
+        return root
+
+    def get_checkpoint_block(self, root: bytes, epoch: int, spec: ChainSpec | None = None) -> bytes:
+        """Checkpoint block of ``root`` for ``epoch``
+        (ref: lib/ssz_types/store.ex:57-61)."""
+        return self.get_ancestor(root, misc.compute_start_slot_at_epoch(epoch, spec))
+
+    def add_block(self, root: bytes, block: BeaconBlock, state: BeaconState) -> None:
+        self.blocks[root] = block
+        self.block_states[root] = state
+        self.children.setdefault(bytes(block.parent_root), []).append(root)
+
+
+def checkpoint_key(checkpoint: Checkpoint) -> tuple[int, bytes]:
+    return (int(checkpoint.epoch), bytes(checkpoint.root))
+
+
+def get_forkchoice_store(
+    anchor_state: BeaconState,
+    anchor_block: BeaconBlock,
+    spec: ChainSpec | None = None,
+) -> Store:
+    """Fresh store from an anchor (ref: fork_choice/helpers.ex:12-50)."""
+    spec = spec or get_chain_spec()
+    if bytes(anchor_block.state_root) != anchor_state.hash_tree_root(spec):
+        raise ForkChoiceError("anchor block state root does not match anchor state")
+    anchor_root = anchor_block.hash_tree_root(spec)
+    anchor_epoch = accessors.get_current_epoch(anchor_state, spec)
+    justified = Checkpoint(epoch=anchor_epoch, root=anchor_root)
+    finalized = Checkpoint(epoch=anchor_epoch, root=anchor_root)
+    store = Store(
+        time=anchor_state.genesis_time + spec.SECONDS_PER_SLOT * anchor_state.slot,
+        genesis_time=anchor_state.genesis_time,
+        justified_checkpoint=justified,
+        finalized_checkpoint=finalized,
+        unrealized_justified_checkpoint=justified,
+        unrealized_finalized_checkpoint=finalized,
+    )
+    store.blocks[anchor_root] = anchor_block
+    store.block_states[anchor_root] = anchor_state
+    store.checkpoint_states[checkpoint_key(justified)] = anchor_state
+    store.unrealized_justifications[anchor_root] = justified
+    return store

@@ -58,6 +58,8 @@ def verify_block_signature(
     state: BeaconStateMut, signed_block: SignedBeaconBlock, spec: ChainSpec
 ) -> bool:
     block = signed_block.message
+    if block.proposer_index >= len(state.validators):
+        return False  # attacker-controlled index: reject, don't crash
     proposer = state.validators[block.proposer_index]
     domain = accessors.get_domain(state, constants.DOMAIN_BEACON_PROPOSER, spec=spec)
     signing_root = misc.compute_signing_root(block, domain)

@@ -170,8 +170,6 @@ def process_rewards_and_penalties(
     if accessors.get_current_epoch(state, spec) == constants.GENESIS_EPOCH:
         return
     reg = state.registry()
-    n = len(state.validators)
-    rewards = np.zeros(n, np.int64)
 
     prev = accessors.get_previous_epoch(state, spec)
     eligible = _eligible_mask(state, spec)
@@ -186,12 +184,24 @@ def process_rewards_and_penalties(
     ) * base_reward_per_increment
     in_leak = accessors.is_in_inactivity_leak(state, spec)
 
+    # Spec applies each (rewards, penalties) delta pair sequentially with
+    # decrease_balance saturating at zero *per pair* — netting everything and
+    # flooring once diverges for near-zero balances, so keep one vector
+    # increase+saturating-decrease per pair.
+    balances = state.balances_array().astype(np.int64)
+
+    def apply(rewards: np.ndarray, penalties: np.ndarray) -> None:
+        nonlocal balances
+        balances = np.maximum(0, balances + rewards - penalties)
+
     for flag_index, weight in enumerate(constants.PARTICIPATION_FLAG_WEIGHTS):
         participating = _unslashed_participating_mask(state, flag_index, prev, spec)
         participating_balance = int(reg["effective_balance"][participating].sum())
         participating_increments = (
             max(spec.EFFECTIVE_BALANCE_INCREMENT, participating_balance) // increment
         )
+        rewards = np.zeros_like(balances)
+        penalties = np.zeros_like(balances)
         if not in_leak:
             flag_rewards = (
                 base_rewards
@@ -199,10 +209,14 @@ def process_rewards_and_penalties(
                 * participating_increments
                 // (active_increments * constants.WEIGHT_DENOMINATOR)
             )
-            rewards += np.where(eligible & participating, flag_rewards, 0)
+            rewards = np.where(eligible & participating, flag_rewards, 0)
         if flag_index != constants.TIMELY_HEAD_FLAG_INDEX:
-            penalties = base_rewards * weight // constants.WEIGHT_DENOMINATOR
-            rewards -= np.where(eligible & ~participating, penalties, 0)
+            penalties = np.where(
+                eligible & ~participating,
+                base_rewards * weight // constants.WEIGHT_DENOMINATOR,
+                0,
+            )
+        apply(rewards, penalties)
 
     # inactivity penalties (target non-participants pay score-scaled penalty)
     target_participating = _unslashed_participating_mask(
@@ -210,13 +224,14 @@ def process_rewards_and_penalties(
     )
     scores = np.asarray(state.inactivity_scores, dtype=np.uint64).astype(np.int64)
     denom = spec.INACTIVITY_SCORE_BIAS * spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
-    inactivity_penalties = (
-        reg["effective_balance"].astype(np.int64) * scores // denom
+    inactivity_penalties = np.where(
+        eligible & ~target_participating,
+        reg["effective_balance"].astype(np.int64) * scores // denom,
+        0,
     )
-    rewards -= np.where(eligible & ~target_participating, inactivity_penalties, 0)
+    apply(np.zeros_like(balances), inactivity_penalties)
 
-    balances = state.balances_array().astype(np.int64)
-    state.set_balances(np.maximum(0, balances + rewards).astype(np.uint64))
+    state.set_balances(balances.astype(np.uint64))
 
 
 # ------------------------------------------------------- registry updates

@@ -96,8 +96,8 @@ def build_genesis_state(
     ws = BeaconStateMut(state)
     ws.genesis_validators_root = registry_root
 
-    # genesis sync committees: current and next both sampled from epoch 1 seed
+    # genesis sync committees: current and next are the same epoch-1 sample
     committee = accessors.get_next_sync_committee(ws, spec)
     ws.current_sync_committee = committee
-    ws.next_sync_committee = accessors.get_next_sync_committee(ws, spec)
+    ws.next_sync_committee = committee
     return ws.freeze()

@@ -62,12 +62,18 @@ def _round_source_bits(seed: bytes, rnd: int, index_count: int) -> np.ndarray:
     return bits[:index_count]
 
 
-@functools.lru_cache(maxsize=64)
-def compute_shuffled_indices(index_count: int, seed: bytes, round_count: int) -> tuple:
+@functools.lru_cache(maxsize=16)
+def compute_shuffled_indices(
+    index_count: int, seed: bytes, round_count: int
+) -> np.ndarray:
     """``compute_shuffled_index`` applied to every index at once:
-    ``out[i] == compute_shuffled_index(i, index_count, seed)``."""
+    ``out[i] == compute_shuffled_index(i, index_count, seed)``.
+
+    Returns a cached read-only int64 array (8 bytes/entry — a tuple of boxed
+    ints would pin ~30x that per mainnet-sized registry in the LRU).
+    """
     if index_count == 0:
-        return ()
+        return np.empty(0, dtype=np.int64)
     indices = np.arange(index_count, dtype=np.int64)
     for rnd in range(round_count):
         pivot = _round_pivot(seed, rnd, index_count)
@@ -75,7 +81,8 @@ def compute_shuffled_indices(index_count: int, seed: bytes, round_count: int) ->
         positions = np.maximum(indices, flip)
         bits = _round_source_bits(seed, rnd, index_count)
         indices = np.where(bits[positions] == 1, flip, indices)
-    return tuple(int(x) for x in indices)
+    indices.setflags(write=False)
+    return indices
 
 
 def compute_shuffled_index(

@@ -1,0 +1,14 @@
+"""Persistence: native KV store + typed block/state stores.
+
+Replaces the reference's LevelDB layer (ref: lib/lambda_ethereum_consensus/
+store/{db.ex,block_store.ex,state_store.ex}) with a C++ ordered KV engine
+(``native/kvstore``) bound via ctypes, plus the same key schemes:
+``block|root``, ``blockslot|slot -> root``, ``beacon_state|root``,
+``stateslot|slot -> root`` and the highest-slot resume seek.
+"""
+
+from .block_store import BlockStore
+from .kv import KvStore
+from .state_store import StateStore
+
+__all__ = ["KvStore", "BlockStore", "StateStore"]

@@ -1,0 +1,286 @@
+"""Ordered key-value store: ctypes binding over ``native/libkvstore.so``.
+
+The native engine (``native/kvstore/kvstore.cpp``) is an in-memory ordered
+map + write-ahead log — the role Exleveldb/LevelDB plays for the reference
+(ref: lib/.../store/db.ex:16-41).  When the shared library has not been
+built, a pure-Python engine with the *same WAL format* takes over, so data
+files are interchangeable between backends.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import threading
+from typing import Iterator
+
+_SO_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "build",
+    "libkvstore.so",
+)
+
+
+def _load_native():
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.kv_open.restype = ctypes.c_void_p
+    lib.kv_open.argtypes = [ctypes.c_char_p]
+    lib.kv_put.restype = ctypes.c_int
+    lib.kv_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32,
+    ]
+    lib.kv_delete.restype = ctypes.c_int
+    lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.kv_get.restype = ctypes.c_void_p
+    lib.kv_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.kv_free.argtypes = [ctypes.c_void_p]
+    lib.kv_flush.argtypes = [ctypes.c_void_p]
+    lib.kv_count.restype = ctypes.c_uint64
+    lib.kv_count.argtypes = [ctypes.c_void_p]
+    lib.kv_compact.restype = ctypes.c_int
+    lib.kv_compact.argtypes = [ctypes.c_void_p]
+    lib.kv_close.argtypes = [ctypes.c_void_p]
+    lib.kv_iter_range.restype = ctypes.c_void_p
+    lib.kv_iter_range.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int,
+    ]
+    lib.kv_iter_next.restype = ctypes.c_int
+    lib.kv_iter_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint32),
+    ]
+    lib.kv_iter_free.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_NATIVE = _load_native()
+
+
+class KvError(RuntimeError):
+    pass
+
+
+class _NativeEngine:
+    def __init__(self, path: str):
+        self._lib = _NATIVE
+        self._h = self._lib.kv_open(path.encode())
+        if not self._h:
+            raise KvError(f"cannot open kv store at {path}")
+
+    def put(self, key: bytes, val: bytes) -> None:
+        if self._lib.kv_put(self._h, key, len(key), val, len(val)) != 0:
+            raise KvError("put failed")
+
+    def get(self, key: bytes) -> bytes | None:
+        vlen = ctypes.c_uint32()
+        ptr = self._lib.kv_get(self._h, key, len(key), ctypes.byref(vlen))
+        if not ptr:
+            return None
+        try:
+            return ctypes.string_at(ptr, vlen.value)
+        finally:
+            self._lib.kv_free(ptr)
+
+    def delete(self, key: bytes) -> None:
+        if self._lib.kv_delete(self._h, key, len(key)) != 0:
+            raise KvError("delete failed")
+
+    def iterate(
+        self, start: bytes, end: bytes, descending: bool
+    ) -> Iterator[tuple[bytes, bytes]]:
+        it = self._lib.kv_iter_range(
+            self._h, start, len(start), end, len(end), int(descending)
+        )
+        try:
+            kp = ctypes.c_void_p()
+            kl = ctypes.c_uint32()
+            vp = ctypes.c_void_p()
+            vl = ctypes.c_uint32()
+            while self._lib.kv_iter_next(
+                it, ctypes.byref(kp), ctypes.byref(kl), ctypes.byref(vp), ctypes.byref(vl)
+            ):
+                yield (
+                    ctypes.string_at(kp.value, kl.value),
+                    ctypes.string_at(vp.value, vl.value),
+                )
+        finally:
+            self._lib.kv_iter_free(it)
+
+    def flush(self) -> None:
+        self._lib.kv_flush(self._h)
+
+    def compact(self) -> None:
+        if self._lib.kv_compact(self._h) != 0:
+            raise KvError("compact failed")
+
+    def count(self) -> int:
+        return int(self._lib.kv_count(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+
+class _PyEngine:
+    """Pure-Python fallback speaking the same WAL format as the C++ engine."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._table: dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        if os.path.exists(path):
+            self._replay()
+        self._log = open(path, "ab")
+
+    def _replay(self) -> None:
+        with open(self._path, "rb") as f:
+            while True:
+                head = f.read(9)
+                if len(head) < 9:
+                    break
+                op = head[0]
+                klen, vlen = struct.unpack("<II", head[1:9])
+                key = f.read(klen)
+                val = f.read(vlen)
+                if len(key) < klen or len(val) < vlen:
+                    break  # torn tail
+                if op == 1:
+                    self._table[key] = val
+                elif op == 2:
+                    self._table.pop(key, None)
+                else:
+                    break
+
+    def _append(self, op: int, key: bytes, val: bytes) -> None:
+        self._log.write(bytes([op]) + struct.pack("<II", len(key), len(val)) + key + val)
+
+    def put(self, key: bytes, val: bytes) -> None:
+        with self._lock:
+            self._append(1, key, val)
+            self._table[key] = val
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            return self._table.get(key)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._append(2, key, b"")
+            self._table.pop(key, None)
+
+    def iterate(self, start: bytes, end: bytes, descending: bool):
+        with self._lock:
+            keys = sorted(
+                k for k in self._table if k >= start and (not end or k < end)
+            )
+        if descending:
+            keys.reverse()
+        for k in keys:
+            v = self._table.get(k)
+            if v is not None:
+                yield k, v
+
+    def flush(self) -> None:
+        with self._lock:
+            self._log.flush()
+
+    def compact(self) -> None:
+        with self._lock:
+            tmp = self._path + ".compact"
+            with open(tmp, "wb") as f:
+                for k in sorted(self._table):
+                    v = self._table[k]
+                    f.write(b"\x01" + struct.pack("<II", len(k), len(v)) + k + v)
+            self._log.close()
+            os.replace(tmp, self._path)
+            self._log = open(self._path, "ab")
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+    def close(self) -> None:
+        self._log.close()
+
+
+class KvStore:
+    """The store handle used across the framework (ref: store/db.ex API:
+    put/get/iterate, plus range cursors)."""
+
+    def __init__(self, path: str, native: bool | None = None):
+        use_native = _NATIVE is not None if native is None else native
+        if use_native and _NATIVE is None:
+            raise KvError("native kvstore library not built (make -C native)")
+        self._engine = _NativeEngine(path) if use_native else _PyEngine(path)
+        self.native = use_native
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._engine.put(key, value)
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._engine.get(key)
+
+    def delete(self, key: bytes) -> None:
+        self._engine.delete(key)
+
+    def iterate(
+        self,
+        start: bytes = b"",
+        end: bytes = b"",
+        descending: bool = False,
+    ) -> Iterator[tuple[bytes, bytes]]:
+        """Snapshot cursor over [start, end); empty end = to the end."""
+        return self._engine.iterate(start, end, descending)
+
+    def iterate_prefix(self, prefix: bytes, descending: bool = False):
+        end = _prefix_end(prefix)
+        return self._engine.iterate(prefix, end, descending)
+
+    def last_under_prefix(self, prefix: bytes) -> tuple[bytes, bytes] | None:
+        """Highest key with ``prefix`` (the resume seek — state_store.ex:36)."""
+        for kv in self.iterate_prefix(prefix, descending=True):
+            return kv
+        return None
+
+    def flush(self) -> None:
+        self._engine.flush()
+
+    def compact(self) -> None:
+        self._engine.compact()
+
+    def count(self) -> int:
+        return self._engine.count()
+
+    def close(self) -> None:
+        self._engine.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _prefix_end(prefix: bytes) -> bytes:
+    """Smallest byte string greater than every string with ``prefix``."""
+    out = bytearray(prefix)
+    while out:
+        if out[-1] != 0xFF:
+            out[-1] += 1
+            return bytes(out)
+        out.pop()
+    return b""  # prefix of all 0xff: no upper bound

@@ -1,0 +1,60 @@
+"""Typed state persistence (ref: lib/.../store/state_store.ex).
+
+Key scheme: ``beacon_state|block_root -> SSZ(BeaconState)`` plus
+``stateslot|<slot be64> -> block_root``; ``get_latest_state`` seeks the
+highest slot key to resume after restart (ref: state_store.ex:36-49,
+fork_choice/supervisor.ex:16-28).
+"""
+
+from __future__ import annotations
+
+from ..config import ChainSpec, get_chain_spec
+from ..types.beacon import BeaconState
+from .kv import KvStore
+
+_STATE = b"beacon_state|"
+_SLOT = b"stateslot|"
+
+
+def _slot_key(slot: int) -> bytes:
+    return _SLOT + int(slot).to_bytes(8, "big")
+
+
+class StateStore:
+    def __init__(self, kv: KvStore):
+        self._kv = kv
+
+    def store_state(
+        self,
+        block_root: bytes,
+        state: BeaconState,
+        spec: ChainSpec | None = None,
+    ) -> None:
+        spec = spec or get_chain_spec()
+        self._kv.put(_STATE + block_root, state.encode(spec))
+        self._kv.put(_slot_key(state.slot), block_root)
+
+    def get_state(
+        self, block_root: bytes, spec: ChainSpec | None = None
+    ) -> BeaconState | None:
+        raw = self._kv.get(_STATE + block_root)
+        if raw is None:
+            return None
+        return BeaconState.decode(raw, spec or get_chain_spec())
+
+    def get_state_by_slot(
+        self, slot: int, spec: ChainSpec | None = None
+    ) -> BeaconState | None:
+        root = self._kv.get(_slot_key(slot))
+        return None if root is None else self.get_state(root, spec)
+
+    def get_latest_state(
+        self, spec: ChainSpec | None = None
+    ) -> tuple[bytes, BeaconState] | None:
+        """Highest-slot stored state, for restart resume."""
+        kv = self._kv.last_under_prefix(_SLOT)
+        if kv is None:
+            return None
+        root = kv[1]
+        state = self.get_state(root, spec)
+        return None if state is None else (root, state)

@@ -1,0 +1,204 @@
+// kvstore: ordered key-value store with write-ahead log persistence.
+//
+// The native storage engine behind the framework's block/state stores —
+// the role LevelDB-via-NIF plays in the reference client (ref:
+// lib/lambda_ethereum_consensus/store/db.ex wrapping Exleveldb).  Design:
+// an in-memory ordered map (std::map) for reads/scans + an append-only log
+// for durability; open() replays the log, compact() rewrites it.  Ordered
+// iteration gives the prefix scans and reverse seeks the stores need
+// (e.g. get_latest_state seeks the highest slot key — ref:
+// lib/.../store/state_store.ex:36-49).
+//
+// C ABI for ctypes consumption; all buffers are copied at the boundary.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Record {
+    uint8_t op;  // 1 = put, 2 = del
+    std::string key;
+    std::string val;
+};
+
+struct KvStore {
+    std::map<std::string, std::string> table;
+    FILE* log = nullptr;
+    std::string path;
+    std::mutex mu;
+    uint64_t log_records = 0;
+};
+
+bool read_exact(FILE* f, void* buf, size_t n) {
+    return fread(buf, 1, n, f) == n;
+}
+
+bool write_record(FILE* f, uint8_t op, const char* key, uint32_t klen,
+                  const char* val, uint32_t vlen) {
+    if (fputc(op, f) == EOF) return false;
+    if (fwrite(&klen, 4, 1, f) != 1) return false;
+    if (fwrite(&vlen, 4, 1, f) != 1) return false;
+    if (klen && fwrite(key, 1, klen, f) != klen) return false;
+    if (vlen && fwrite(val, 1, vlen, f) != vlen) return false;
+    return true;
+}
+
+bool replay_log(KvStore* kv, FILE* f) {
+    for (;;) {
+        int op = fgetc(f);
+        if (op == EOF) return true;  // clean end
+        uint32_t klen = 0, vlen = 0;
+        if (!read_exact(f, &klen, 4) || !read_exact(f, &vlen, 4)) return false;
+        std::string key(klen, '\0'), val(vlen, '\0');
+        if (klen && !read_exact(f, key.data(), klen)) return false;
+        if (vlen && !read_exact(f, val.data(), vlen)) return false;
+        if (op == 1) {
+            kv->table[std::move(key)] = std::move(val);
+        } else if (op == 2) {
+            kv->table.erase(key);
+        } else {
+            return false;  // corrupt opcode
+        }
+        kv->log_records++;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+KvStore* kv_open(const char* path) {
+    auto* kv = new KvStore();
+    kv->path = path;
+    if (FILE* f = fopen(path, "rb")) {
+        // A torn tail (crash mid-write) stops replay at the damage point;
+        // everything before it is kept.
+        replay_log(kv, f);
+        fclose(f);
+    }
+    kv->log = fopen(path, "ab");
+    if (!kv->log) {
+        delete kv;
+        return nullptr;
+    }
+    return kv;
+}
+
+int kv_put(KvStore* kv, const char* key, uint32_t klen, const char* val,
+           uint32_t vlen) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    if (!write_record(kv->log, 1, key, klen, val, vlen)) return -1;
+    kv->table[std::string(key, klen)] = std::string(val, vlen);
+    kv->log_records++;
+    return 0;
+}
+
+int kv_delete(KvStore* kv, const char* key, uint32_t klen) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    if (!write_record(kv->log, 2, key, klen, nullptr, 0)) return -1;
+    kv->table.erase(std::string(key, klen));
+    kv->log_records++;
+    return 0;
+}
+
+// Returns a malloc'd copy the caller frees with kv_free (NULL if missing).
+char* kv_get(KvStore* kv, const char* key, uint32_t klen, uint32_t* vlen) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    auto it = kv->table.find(std::string(key, klen));
+    if (it == kv->table.end()) return nullptr;
+    *vlen = (uint32_t)it->second.size();
+    char* out = (char*)malloc(it->second.size() ? it->second.size() : 1);
+    memcpy(out, it->second.data(), it->second.size());
+    return out;
+}
+
+void kv_free(char* buf) { free(buf); }
+
+int kv_flush(KvStore* kv) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    return fflush(kv->log) == 0 ? 0 : -1;
+}
+
+uint64_t kv_count(KvStore* kv) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    return kv->table.size();
+}
+
+// Rewrite the log as a snapshot of live entries (drops tombstones/overwrites).
+int kv_compact(KvStore* kv) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    std::string tmp = kv->path + ".compact";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    for (const auto& [key, val] : kv->table) {
+        if (!write_record(f, 1, key.data(), (uint32_t)key.size(), val.data(),
+                          (uint32_t)val.size())) {
+            fclose(f);
+            remove(tmp.c_str());
+            return -1;
+        }
+    }
+    fclose(f);
+    fclose(kv->log);
+    if (rename(tmp.c_str(), kv->path.c_str()) != 0) {
+        kv->log = fopen(kv->path.c_str(), "ab");
+        return -1;
+    }
+    kv->log = fopen(kv->path.c_str(), "ab");
+    kv->log_records = kv->table.size();
+    return kv->log ? 0 : -1;
+}
+
+void kv_close(KvStore* kv) {
+    if (kv->log) fclose(kv->log);
+    delete kv;
+}
+
+// ------------------------------------------------------------ iteration
+//
+// Snapshot cursor over a key range [start, end) in ascending or descending
+// order.  The snapshot is taken at cursor creation (copied), so callers may
+// mutate the store while iterating.
+
+struct KvIter {
+    std::vector<std::pair<std::string, std::string>> items;
+    size_t pos = 0;
+};
+
+KvIter* kv_iter_range(KvStore* kv, const char* start, uint32_t startlen,
+                      const char* end, uint32_t endlen, int descending) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    auto* it = new KvIter();
+    std::string s(start, startlen);
+    auto lo = kv->table.lower_bound(s);
+    auto hi = endlen ? kv->table.lower_bound(std::string(end, endlen))
+                     : kv->table.end();
+    for (auto cur = lo; cur != hi; ++cur) it->items.push_back(*cur);
+    if (descending) {
+        std::reverse(it->items.begin(), it->items.end());
+    }
+    return it;
+}
+
+int kv_iter_next(KvIter* it, const char** key, uint32_t* klen,
+                 const char** val, uint32_t* vlen) {
+    if (it->pos >= it->items.size()) return 0;
+    const auto& [k, v] = it->items[it->pos++];
+    *key = k.data();
+    *klen = (uint32_t)k.size();
+    *val = v.data();
+    *vlen = (uint32_t)v.size();
+    return 1;
+}
+
+void kv_iter_free(KvIter* it) { delete it; }
+
+}  // extern "C"

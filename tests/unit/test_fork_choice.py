@@ -1,0 +1,196 @@
+"""Fork choice: store construction, on_block/on_tick/on_attestation, head."""
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.config import constants, minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.fork_choice import (
+    ForkChoiceError,
+    get_forkchoice_store,
+    get_head,
+    get_weight,
+    on_attestation,
+    on_block,
+    on_tick,
+)
+from lambda_ethereum_consensus_tpu.state_transition import accessors, misc, process_slots
+from lambda_ethereum_consensus_tpu.state_transition.core import state_transition
+from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
+from lambda_ethereum_consensus_tpu.state_transition.mutable import BeaconStateMut
+from lambda_ethereum_consensus_tpu.types.beacon import (
+    Attestation,
+    AttestationData,
+    BeaconBlock,
+    BeaconBlockBody,
+    Checkpoint,
+    ExecutionPayload,
+    SignedBeaconBlock,
+    SyncAggregate,
+)
+
+N = 64
+SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
+
+
+def build_block(state, spec, slot, graffiti=b"\x00" * 32):
+    """Produce a valid signed block for ``slot`` on top of ``state``."""
+    pre = process_slots(state, slot, spec) if state.slot < slot else state
+    ws = BeaconStateMut(pre)
+    proposer = accessors.get_beacon_proposer_index(ws, spec)
+    epoch = accessors.get_current_epoch(ws, spec)
+    randao_domain = accessors.get_domain(ws, constants.DOMAIN_RANDAO, epoch, spec)
+    body = BeaconBlockBody(
+        randao_reveal=bls.sign(
+            SKS[proposer], misc.compute_signing_root_epoch(epoch, randao_domain)
+        ),
+        eth1_data=pre.eth1_data,
+        graffiti=graffiti,
+        sync_aggregate=SyncAggregate(sync_committee_signature=bls.G2_POINT_AT_INFINITY),
+        execution_payload=ExecutionPayload(
+            parent_hash=bytes(pre.latest_execution_payload_header.block_hash),
+            prev_randao=accessors.get_randao_mix(ws, epoch, spec),
+            timestamp=misc.compute_timestamp_at_slot(ws, slot, spec),
+            block_number=slot,
+            block_hash=misc.hash_bytes(
+                bytes(pre.latest_execution_payload_header.block_hash) + graffiti
+            ),
+        ),
+    )
+    header = pre.latest_block_header
+    if bytes(header.state_root) == b"\x00" * 32:
+        header = header.copy(state_root=pre.hash_tree_root(spec))
+    block = BeaconBlock(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=header.hash_tree_root(spec),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    post = state_transition(
+        state, SignedBeaconBlock(message=block), validate_result=False, spec=spec
+    )
+    block = block.copy(state_root=post.hash_tree_root(spec))
+    domain = accessors.get_domain(ws, constants.DOMAIN_BEACON_PROPOSER, spec=spec)
+    sig = bls.sign(SKS[proposer], misc.compute_signing_root(block, domain))
+    return SignedBeaconBlock(message=block, signature=sig), post
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """Genesis store + two blocks at slots 1 and 2."""
+    with use_chain_spec(minimal_spec()) as spec:
+        genesis = build_genesis_state([bls.sk_to_pk(sk) for sk in SKS], spec=spec)
+        anchor_header = genesis.latest_block_header.copy(
+            state_root=genesis.hash_tree_root(spec)
+        )
+        anchor_block = BeaconBlock(
+            slot=0,
+            proposer_index=0,
+            parent_root=bytes(anchor_header.parent_root),
+            state_root=genesis.hash_tree_root(spec),
+            body=BeaconBlockBody(),
+        )
+        yield genesis, anchor_block, spec
+
+
+def make_store(genesis, anchor_block, spec):
+    store = get_forkchoice_store(genesis, anchor_block, spec)
+    return store, anchor_block.hash_tree_root(spec)
+
+
+def test_store_init_and_head(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = make_store(genesis, anchor_block, spec)
+        assert get_head(store, spec) == anchor_root
+        assert store.current_slot(spec) == 0
+
+
+def test_on_block_advances_head(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = make_store(genesis, anchor_block, spec)
+        signed1, post1 = build_block(genesis, spec, 1)
+        # too early: block from the future must be rejected
+        with pytest.raises(ForkChoiceError, match="future"):
+            on_block(store, signed1, spec=spec)
+        on_tick(store, store.genesis_time + spec.SECONDS_PER_SLOT, spec)
+        root1 = on_block(store, signed1, spec=spec)
+        assert get_head(store, spec) == root1
+        # a child keeps extending the canonical chain
+        signed2, _ = build_block(post1, spec, 2)
+        on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+        root2 = on_block(store, signed2, spec=spec)
+        assert get_head(store, spec) == root2
+        assert store.get_ancestor(root2, 1) == root1
+
+
+def test_attestations_steer_fork_choice(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = make_store(genesis, anchor_block, spec)
+        # two competing blocks at slot 1 (different graffiti)
+        signed_a, _ = build_block(genesis, spec, 1, graffiti=b"\xaa" * 32)
+        signed_b, _ = build_block(genesis, spec, 1, graffiti=b"\xbb" * 32)
+        # tick to slot 2 so neither gets proposer boost
+        on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+        root_a = on_block(store, signed_a, spec=spec)
+        root_b = on_block(store, signed_b, spec=spec)
+        baseline = get_head(store, spec)  # lexicographic tiebreak, zero weight
+
+        # attest for the *other* block; its weight must now win
+        target = max(root_a, root_b)
+        loser = min(root_a, root_b)
+        assert baseline == target
+        committee = accessors.get_beacon_committee(
+            store.block_states[loser], 1, 0, spec
+        )
+        data = AttestationData(
+            slot=1,
+            index=0,
+            beacon_block_root=loser,
+            source=store.justified_checkpoint,
+            target=Checkpoint(epoch=0, root=anchor_root),
+        )
+        domain = accessors.get_domain(
+            store.block_states[loser], constants.DOMAIN_BEACON_ATTESTER, 0, spec
+        )
+        signing_root = misc.compute_signing_root(data, domain)
+        sigs = [bls.sign(SKS[i], signing_root) for i in committee]
+        att = Attestation(
+            aggregation_bits=[True] * len(committee),
+            data=data,
+            signature=bls.aggregate(sigs),
+        )
+        on_attestation(store, att, spec=spec)
+        assert get_weight(store, loser, spec) > 0
+        assert get_head(store, spec) == loser
+
+
+def test_attestation_for_unknown_block_rejected(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = make_store(genesis, anchor_block, spec)
+        on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+        data = AttestationData(
+            slot=1,
+            index=0,
+            beacon_block_root=b"\x13" * 32,
+            source=store.justified_checkpoint,
+            target=Checkpoint(epoch=0, root=anchor_root),
+        )
+        att = Attestation(aggregation_bits=[True], data=data)
+        with pytest.raises(ForkChoiceError):
+            on_attestation(store, att, spec=spec)
+
+
+def test_on_tick_pulls_up_checkpoints(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, _ = make_store(genesis, anchor_block, spec)
+        # ticking across epochs without blocks must not crash or regress
+        on_tick(
+            store, store.genesis_time + 3 * spec.SLOTS_PER_EPOCH * spec.SECONDS_PER_SLOT, spec
+        )
+        assert store.current_slot(spec) == 3 * spec.SLOTS_PER_EPOCH
+        assert store.justified_checkpoint.epoch == 0

@@ -1,0 +1,141 @@
+"""Persistence: native + Python KV engines, typed stores, crash resume."""
+
+import os
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
+from lambda_ethereum_consensus_tpu.store import BlockStore, KvStore, StateStore
+from lambda_ethereum_consensus_tpu.store.kv import _NATIVE
+from lambda_ethereum_consensus_tpu.types.beacon import (
+    BeaconBlock,
+    BeaconBlockBody,
+    SignedBeaconBlock,
+)
+
+ENGINES = [False] + ([True] if _NATIVE is not None else [])
+
+
+@pytest.fixture(params=ENGINES, ids=["python", "native"][: len(ENGINES)])
+def kv(request, tmp_path):
+    store = KvStore(str(tmp_path / "db.wal"), native=request.param)
+    yield store
+    store.close()
+
+
+def test_put_get_delete(kv):
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    assert kv.get(b"a") == b"1"
+    kv.put(b"a", b"updated")
+    assert kv.get(b"a") == b"updated"
+    kv.delete(b"a")
+    assert kv.get(b"a") is None
+    assert kv.count() == 1
+
+
+def test_iteration_ordered_and_prefix(kv):
+    for i in [3, 1, 2]:
+        kv.put(b"x|" + bytes([i]), bytes([i]))
+    kv.put(b"y|\x01", b"other")
+    asc = [k for k, _ in kv.iterate_prefix(b"x|")]
+    assert asc == [b"x|\x01", b"x|\x02", b"x|\x03"]
+    desc = [k for k, _ in kv.iterate_prefix(b"x|", descending=True)]
+    assert desc == asc[::-1]
+    assert kv.last_under_prefix(b"x|") == (b"x|\x03", b"\x03")
+
+
+def test_persistence_across_reopen(tmp_path):
+    for native in ENGINES:
+        path = str(tmp_path / f"reopen-{native}.wal")
+        s = KvStore(path, native=native)
+        s.put(b"k1", b"v1")
+        s.put(b"k2", b"v2")
+        s.delete(b"k1")
+        s.flush()
+        s.close()
+        s2 = KvStore(path, native=native)
+        assert s2.get(b"k1") is None
+        assert s2.get(b"k2") == b"v2"
+        s2.close()
+
+
+def test_torn_tail_recovers(tmp_path):
+    path = str(tmp_path / "torn.wal")
+    s = KvStore(path, native=False)
+    s.put(b"good", b"value")
+    s.flush()
+    s.close()
+    with open(path, "ab") as f:
+        f.write(b"\x01\xff\xff")  # truncated record header
+    s2 = KvStore(path, native=False)
+    assert s2.get(b"good") == b"value"
+    s2.close()
+
+
+def test_compaction_shrinks_log(tmp_path):
+    path = str(tmp_path / "compact.wal")
+    s = KvStore(path, native=False)
+    for i in range(50):
+        s.put(b"churn", str(i).encode())
+    s.flush()
+    before = os.path.getsize(path)
+    s.compact()
+    after = os.path.getsize(path)
+    assert after < before
+    assert s.get(b"churn") == b"49"
+    s.close()
+
+
+def test_engines_share_wal_format(tmp_path):
+    if _NATIVE is None:
+        pytest.skip("native engine not built")
+    path = str(tmp_path / "shared.wal")
+    a = KvStore(path, native=True)
+    a.put(b"from", b"native")
+    a.flush()
+    a.close()
+    b = KvStore(path, native=False)
+    assert b.get(b"from") == b"native"
+    b.put(b"and", b"python")
+    b.flush()
+    b.close()
+    c = KvStore(path, native=True)
+    assert c.get(b"and") == b"python"
+    c.close()
+
+
+# ------------------------------------------------------------ typed stores
+
+def test_block_and_state_store_roundtrip(tmp_path):
+    with use_chain_spec(minimal_spec()) as spec:
+        sks = [(i + 1).to_bytes(32, "big") for i in range(16)]
+        state = build_genesis_state([bls.sk_to_pk(sk) for sk in sks], spec=spec)
+        kv = KvStore(str(tmp_path / "chain.wal"))
+        blocks = BlockStore(kv)
+        states = StateStore(kv)
+
+        signed = SignedBeaconBlock(
+            message=BeaconBlock(
+                slot=5, state_root=state.hash_tree_root(spec), body=BeaconBlockBody()
+            )
+        )
+        root = blocks.store_block(signed, spec)
+        states.store_state(root, state, spec)
+        kv.flush()
+
+        assert blocks.has_block(root)
+        got = blocks.get_block(root, spec)
+        assert got.message.hash_tree_root(spec) == root
+        assert blocks.get_block_by_slot(5, spec) is not None
+        assert blocks.highest_slot() == 5
+        assert blocks.missing_slots(3, 8) == [3, 4, 6, 7]
+
+        latest = states.get_latest_state(spec)
+        assert latest is not None
+        latest_root, latest_state = latest
+        assert latest_root == root
+        assert latest_state.hash_tree_root(spec) == state.hash_tree_root(spec)
+        kv.close()
