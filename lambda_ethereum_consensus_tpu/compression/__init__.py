@@ -1,0 +1,25 @@
+"""Snappy compression, implemented from scratch (ref: native/snappy_nif).
+
+The reference links Rust's ``snap`` crate for the req/resp *frame* format and
+erlang ``:snappyer`` for the gossip *raw* format (two variants coexist — ref:
+native/snappy_nif/src/lib.rs:13-33 and lib/.../p2p/gossip_consumer.ex:36).
+Both formats are implemented here in pure Python: :mod:`.snappy` provides
+``compress``/``decompress`` (raw block format) and ``frame_compress``/
+``frame_decompress`` (framed format with masked CRC32C).
+"""
+
+from .snappy import (
+    SnappyError,
+    compress,
+    decompress,
+    frame_compress,
+    frame_decompress,
+)
+
+__all__ = [
+    "SnappyError",
+    "compress",
+    "decompress",
+    "frame_compress",
+    "frame_decompress",
+]

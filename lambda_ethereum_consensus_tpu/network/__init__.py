@@ -1,0 +1,14 @@
+"""Networking: sidecar process, host port, gossip pipeline, req/resp.
+
+The internet p2p plane (SURVEY.md §5.8): a separate sidecar process speaking
+a length-framed protobuf control protocol over stdio — the same process
+boundary the reference draws around its Go libp2p binary (ref:
+lib/libp2p_port.ex:203, native/libp2p_port/internal/port/port.go:20-85) —
+plus the host-side pipeline that batches gossip decode/verify for device
+dispatch instead of the reference's one-at-a-time Broadway consumers
+(ref: p2p/gossip_consumer.ex:10-21, max_demand: 1).
+"""
+
+from .port import Port, PortError
+
+__all__ = ["Port", "PortError"]

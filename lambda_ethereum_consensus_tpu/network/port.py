@@ -1,0 +1,251 @@
+"""Host-side manager of the network sidecar (ref: lib/libp2p_port.ex).
+
+Spawns the sidecar subprocess, frames ``Command`` protobufs over its stdin,
+and routes ``Notification`` frames back: command results resolve awaiting
+futures (the reference serializes caller pids into the protobuf instead —
+libp2p_port.ex:199-234); gossip/request/peer events invoke registered
+handlers.  Sidecar death fails all pending futures and fires ``on_exit`` so a
+supervisor can restart it (parity with the ``:exit_status`` handling at
+libp2p_port.ex:232-234).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+import sys
+from typing import Awaitable, Callable
+
+from .proto import port_pb2
+
+VERDICT_ACCEPT = port_pb2.ValidateMessage.ACCEPT
+VERDICT_REJECT = port_pb2.ValidateMessage.REJECT
+VERDICT_IGNORE = port_pb2.ValidateMessage.IGNORE
+
+Handler = Callable[..., Awaitable[None] | None]
+
+
+class PortError(RuntimeError):
+    pass
+
+
+class Port:
+    """One sidecar process + its control channel."""
+
+    def __init__(self):
+        self._proc: asyncio.subprocess.Process | None = None
+        self._pending: dict[bytes, asyncio.Future] = {}
+        self._counter = 0
+        self._dead = False
+        self._closed = False
+        self._reader_task: asyncio.Task | None = None
+        self.listen_port: int | None = None
+        self.node_id: bytes | None = None
+        # handler registries
+        self.gossip_handlers: dict[str, Handler] = {}
+        self.request_handlers: dict[str, Handler] = {}
+        self.on_new_peer: Handler | None = None
+        self.on_peer_gone: Handler | None = None
+        self.on_exit: Handler | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    @classmethod
+    async def start(
+        cls,
+        listen_addr: str = "127.0.0.1:0",
+        bootnodes: list[str] | None = None,
+        fork_digest: bytes = b"",
+        enable_peer_exchange: bool = True,
+    ) -> "Port":
+        self = cls()
+        env = dict(os.environ)
+        # the sidecar is pure-asyncio; keep accelerators out of it
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self._proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-m",
+            "lambda_ethereum_consensus_tpu.network.sidecar",
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL,
+            env=env,
+        )
+        self._reader_task = asyncio.ensure_future(self._read_loop())
+        cmd = port_pb2.Command()
+        cmd.init.listen_addr = listen_addr
+        cmd.init.bootnodes.extend(bootnodes or [])
+        cmd.init.enable_peer_exchange = enable_peer_exchange
+        cmd.init.fork_digest = fork_digest.hex()
+        result = await self._command(cmd)
+        self.listen_port = int(result.payload.decode())
+        ident = port_pb2.Command()
+        ident.get_node_identity.SetInParent()
+        self.node_id = (await self._command(ident)).payload
+        return self
+
+    async def close(self) -> None:
+        self._dead = True
+        self._closed = True  # deliberate shutdown: suppress on_exit
+        if self._proc is not None:
+            if self._proc.stdin is not None:
+                self._proc.stdin.close()
+            if self._proc.returncode is None:
+                self._proc.kill()
+            await self._proc.wait()
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+
+    @property
+    def alive(self) -> bool:
+        return (
+            not self._dead
+            and self._proc is not None
+            and self._proc.returncode is None
+        )
+
+    # ------------------------------------------------------------- commands
+
+    async def _command(self, cmd: port_pb2.Command, timeout: float = 30) -> port_pb2.Result:
+        if not self.alive:
+            raise PortError("sidecar is not running")
+        self._counter += 1
+        cmd_id = self._counter.to_bytes(8, "big")
+        cmd.id = cmd_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[cmd_id] = fut
+        raw = cmd.SerializeToString()
+        assert self._proc is not None and self._proc.stdin is not None
+        self._proc.stdin.write(struct.pack(">I", len(raw)) + raw)
+        await self._proc.stdin.drain()
+        try:
+            result: port_pb2.Result = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(cmd_id, None)
+        if not result.ok:
+            raise PortError(result.error or "sidecar command failed")
+        return result
+
+    async def add_peer(self, addr: str) -> None:
+        cmd = port_pb2.Command()
+        cmd.add_peer.addr = addr
+        await self._command(cmd)
+
+    async def subscribe(self, topic: str, handler: Handler) -> None:
+        self.gossip_handlers[topic] = handler
+        cmd = port_pb2.Command()
+        cmd.subscribe.topic = topic
+        await self._command(cmd)
+
+    async def unsubscribe(self, topic: str) -> None:
+        self.gossip_handlers.pop(topic, None)
+        cmd = port_pb2.Command()
+        cmd.unsubscribe.topic = topic
+        await self._command(cmd)
+
+    async def publish(self, topic: str, payload: bytes) -> None:
+        cmd = port_pb2.Command()
+        cmd.publish.topic = topic
+        cmd.publish.payload = payload
+        await self._command(cmd)
+
+    async def validate_message(self, msg_id: bytes, verdict: int) -> None:
+        cmd = port_pb2.Command()
+        cmd.validate_message.msg_id = msg_id
+        cmd.validate_message.verdict = verdict
+        await self._command(cmd)
+
+    async def set_request_handler(self, protocol_id: str, handler: Handler) -> None:
+        self.request_handlers[protocol_id] = handler
+        cmd = port_pb2.Command()
+        cmd.set_request_handler.protocol_id = protocol_id
+        await self._command(cmd)
+
+    async def send_request(
+        self, peer_id: bytes, protocol_id: str, payload: bytes, timeout_ms: int = 15000
+    ) -> bytes:
+        cmd = port_pb2.Command()
+        cmd.send_request.peer_id = peer_id
+        cmd.send_request.protocol_id = protocol_id
+        cmd.send_request.payload = payload
+        cmd.send_request.timeout_ms = timeout_ms
+        result = await self._command(cmd, timeout=timeout_ms / 1000 + 5)
+        return result.payload
+
+    async def send_response(self, request_id: bytes, payload: bytes) -> None:
+        cmd = port_pb2.Command()
+        cmd.send_response.request_id = request_id
+        cmd.send_response.payload = payload
+        await self._command(cmd)
+
+    # -------------------------------------------------------- notifications
+
+    async def _read_loop(self) -> None:
+        assert self._proc is not None and self._proc.stdout is not None
+        try:
+            while True:
+                head = await self._proc.stdout.readexactly(4)
+                (length,) = struct.unpack(">I", head)
+                raw = await self._proc.stdout.readexactly(length)
+                await self._dispatch(port_pb2.Notification.FromString(raw))
+        except (asyncio.IncompleteReadError, asyncio.CancelledError):
+            pass
+        finally:
+            self._dead = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(PortError("sidecar exited"))
+            self._pending.clear()
+            # only an *unexpected* death triggers the restart hook
+            if self.on_exit is not None and not self._closed:
+                await _maybe_await(self.on_exit())
+
+    async def _dispatch(self, n: port_pb2.Notification) -> None:
+        # Results resolve futures inline; everything else runs as a task —
+        # a handler that itself issues commands (e.g. validate_message) would
+        # otherwise deadlock against this read loop.
+        which = n.WhichOneof("n")
+        if which == "result":
+            fut = self._pending.get(n.result.id)
+            if fut is not None and not fut.done():
+                fut.set_result(n.result)
+        elif which == "gossip":
+            handler = self.gossip_handlers.get(n.gossip.topic)
+            if handler is None:
+                self._spawn(self.validate_message(n.gossip.msg_id, VERDICT_IGNORE))
+            else:
+                self._spawn(
+                    handler(
+                        n.gossip.topic, n.gossip.msg_id, n.gossip.payload, n.gossip.peer_id
+                    )
+                )
+        elif which == "request":
+            handler = self.request_handlers.get(n.request.protocol_id)
+            if handler is not None:
+                self._spawn(
+                    handler(
+                        n.request.protocol_id,
+                        n.request.request_id,
+                        n.request.payload,
+                        n.request.peer_id,
+                    )
+                )
+        elif which == "new_peer":
+            if self.on_new_peer is not None:
+                self._spawn(self.on_new_peer(n.new_peer.peer_id, n.new_peer.addr))
+        elif which == "peer_gone":
+            if self.on_peer_gone is not None:
+                self._spawn(self.on_peer_gone(n.peer_gone.peer_id))
+
+    @staticmethod
+    def _spawn(value) -> None:
+        """Run a (possibly sync) handler without blocking the read loop."""
+        if asyncio.iscoroutine(value):
+            asyncio.ensure_future(value)
+
+
+async def _maybe_await(value):
+    if asyncio.iscoroutine(value) or isinstance(value, asyncio.Future):
+        return await value
+    return value

@@ -1,0 +1,415 @@
+"""Network sidecar process: TCP p2p + framed-protobuf stdio control plane.
+
+Run as ``python -m lambda_ethereum_consensus_tpu.network.sidecar``.  Fills the
+role of the reference's Go libp2p binary (ref: native/libp2p_port/main.go):
+
+- stdio: 4-byte big-endian length frames carrying ``Command`` in and
+  ``Notification`` out (the reference's ``{:packet, 4}`` port contract).
+- p2p: TCP with a HELLO handshake (fork-digest filtered — the job discv5 ENR
+  filtering does in the reference), flood gossip with seen-cache dedup and
+  host-gated validation (mirroring the blocking topic validator,
+  subscriptions.go:95-135), correlated req/resp, and peer exchange.
+
+The p2p transport is deliberately contained behind this process boundary so a
+full libp2p implementation can replace it without touching the host runtime.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import struct
+import sys
+from collections import OrderedDict
+
+from .proto import p2p_pb2, port_pb2
+
+MAX_FRAME = 1 << 28
+GOSSIP_SEEN_CAP = 4096
+MAX_DIALED_FROM_EXCHANGE = 32
+
+
+def _msg_id(topic: str, payload: bytes) -> bytes:
+    """Gossip message id (sha256 prefix, like eth2's MsgID —
+    subscriptions.go SHA256-based MsgID)."""
+    return hashlib.sha256(topic.encode() + b"\x00" + payload).digest()[:20]
+
+
+class Peer:
+    def __init__(self, reader, writer, conn_id: int):
+        self.reader = reader
+        self.writer = writer
+        self.conn_id = conn_id
+        self.node_id = b""
+        self.listen_port = 0
+        self.addr = ""
+        self.send_lock = asyncio.Lock()
+
+    async def send_frame(self, frame: p2p_pb2.P2PFrame) -> None:
+        raw = frame.SerializeToString()
+        async with self.send_lock:
+            self.writer.write(struct.pack(">I", len(raw)) + raw)
+            await self.writer.drain()
+
+
+class Sidecar:
+    def __init__(self):
+        self.node_id = os.urandom(32)
+        self.fork_digest = ""
+        self.listen_port = 0
+        self.enable_peer_exchange = True
+        self.peers: dict[bytes, Peer] = {}  # node_id -> peer
+        self.subscriptions: set[str] = set()
+        self.handlers: set[str] = set()  # protocol ids served by the host
+        self.seen: OrderedDict[bytes, None] = OrderedDict()
+        # msg_id -> (topic, payload, source); capped — an evicted entry means
+        # the verdict never came, so the message is simply never forwarded
+        self.pending_validation: OrderedDict[bytes, tuple[str, bytes, bytes]] = OrderedDict()
+        # req_id -> (command id, peer node_id): responses only count from the
+        # peer the request went to (no cross-peer response forgery)
+        self.pending_requests: dict[bytes, tuple[bytes, bytes]] = {}
+        self.incoming_requests: dict[bytes, Peer] = {}  # request_id -> peer
+        self.known_addrs: set[str] = set()
+        self.stdout_lock = asyncio.Lock()
+        self._conn_counter = 0
+        self._req_counter = 0
+
+    # ------------------------------------------------------------- stdio
+
+    async def notify(self, notification: port_pb2.Notification) -> None:
+        raw = notification.SerializeToString()
+        async with self.stdout_lock:
+            sys.stdout.buffer.write(struct.pack(">I", len(raw)) + raw)
+            sys.stdout.buffer.flush()
+
+    async def result(self, cmd_id: bytes, ok: bool, payload: bytes = b"", error: str = "") -> None:
+        n = port_pb2.Notification()
+        n.result.id = cmd_id
+        n.result.ok = ok
+        n.result.payload = payload
+        n.result.error = error
+        await self.notify(n)
+
+    async def command_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        reader = asyncio.StreamReader()
+        await loop.connect_read_pipe(
+            lambda: asyncio.StreamReaderProtocol(reader), sys.stdin.buffer
+        )
+        while True:
+            head = await reader.readexactly(4)
+            (length,) = struct.unpack(">I", head)
+            if length > MAX_FRAME:
+                raise RuntimeError("oversized command frame")
+            raw = await reader.readexactly(length)
+            cmd = port_pb2.Command.FromString(raw)
+            try:
+                await self.handle_command(cmd)
+            except Exception as e:  # command errors must not kill the sidecar
+                await self.result(cmd.id, False, error=f"{type(e).__name__}: {e}")
+
+    async def handle_command(self, cmd: port_pb2.Command) -> None:
+        which = cmd.WhichOneof("c")
+        if which == "init":
+            await self.handle_init(cmd)
+        elif which == "get_node_identity":
+            await self.result(cmd.id, True, payload=self.node_id)
+        elif which == "add_peer":
+            ok, err = await self.dial(cmd.add_peer.addr)
+            await self.result(cmd.id, ok, error=err)
+        elif which == "subscribe":
+            self.subscriptions.add(cmd.subscribe.topic)
+            await self.result(cmd.id, True)
+        elif which == "unsubscribe":
+            self.subscriptions.discard(cmd.unsubscribe.topic)
+            await self.result(cmd.id, True)
+        elif which == "publish":
+            await self.publish(cmd.publish.topic, cmd.publish.payload)
+            await self.result(cmd.id, True)
+        elif which == "validate_message":
+            await self.finish_validation(
+                cmd.validate_message.msg_id, cmd.validate_message.verdict
+            )
+            await self.result(cmd.id, True)
+        elif which == "set_request_handler":
+            self.handlers.add(cmd.set_request_handler.protocol_id)
+            await self.result(cmd.id, True)
+        elif which == "send_request":
+            await self.send_request(cmd)
+        elif which == "send_response":
+            await self.send_response(cmd)
+        else:
+            await self.result(cmd.id, False, error=f"unknown command {which}")
+
+    async def handle_init(self, cmd: port_pb2.Command) -> None:
+        args = cmd.init
+        self.fork_digest = args.fork_digest
+        self.enable_peer_exchange = args.enable_peer_exchange
+        host, _, port = (args.listen_addr or "127.0.0.1:0").rpartition(":")
+        server = await asyncio.start_server(
+            self.accept_connection, host or "127.0.0.1", int(port or 0)
+        )
+        self.listen_port = server.sockets[0].getsockname()[1]
+        for addr in args.bootnodes:
+            asyncio.ensure_future(self.dial(addr))
+        await self.result(
+            cmd.id, True, payload=str(self.listen_port).encode()
+        )
+
+    # ------------------------------------------------------------- peers
+
+    async def accept_connection(self, reader, writer) -> None:
+        self._conn_counter += 1
+        peer = Peer(reader, writer, self._conn_counter)
+        await self.run_peer(peer, dialed_addr=None)
+
+    async def dial(self, addr: str) -> tuple[bool, str]:
+        host, _, port = addr.rpartition(":")
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), timeout=5
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            return False, f"dial {addr}: {e}"
+        self._conn_counter += 1
+        peer = Peer(reader, writer, self._conn_counter)
+        self.known_addrs.add(addr)
+        asyncio.ensure_future(self.run_peer(peer, dialed_addr=addr))
+        return True, ""
+
+    async def run_peer(self, peer: Peer, dialed_addr: str | None) -> None:
+        try:
+            hello = p2p_pb2.P2PFrame()
+            hello.hello.node_id = self.node_id
+            hello.hello.fork_digest = self.fork_digest
+            hello.hello.listen_port = self.listen_port
+            await peer.send_frame(hello)
+            first = await asyncio.wait_for(self.read_frame(peer), timeout=10)
+            if first is None or first.WhichOneof("f") != "hello":
+                return
+            h = first.hello
+            if h.fork_digest != self.fork_digest:
+                return  # wrong fork: drop (the discovery filter's job)
+            if h.node_id == self.node_id or h.node_id in self.peers:
+                return  # self-dial or duplicate connection
+            peer.node_id = h.node_id
+            peer.listen_port = h.listen_port
+            peername = peer.writer.get_extra_info("peername")
+            peer.addr = dialed_addr or (
+                f"{peername[0]}:{h.listen_port}" if h.listen_port else ""
+            )
+            self.peers[peer.node_id] = peer
+            if peer.addr:
+                self.known_addrs.add(peer.addr)
+            n = port_pb2.Notification()
+            n.new_peer.peer_id = peer.node_id
+            n.new_peer.addr = peer.addr
+            await self.notify(n)
+            if self.enable_peer_exchange:
+                exchange = p2p_pb2.P2PFrame()
+                exchange.peer_exchange.addrs.extend(
+                    a for a in self.known_addrs if a != peer.addr
+                )
+                await peer.send_frame(exchange)
+            while True:
+                frame = await self.read_frame(peer)
+                if frame is None:
+                    break
+                await self.handle_frame(peer, frame)
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError, OSError):
+            pass
+        finally:
+            if peer.node_id and self.peers.get(peer.node_id) is peer:
+                del self.peers[peer.node_id]
+                n = port_pb2.Notification()
+                n.peer_gone.peer_id = peer.node_id
+                await self.notify(n)
+            peer.writer.close()
+
+    async def read_frame(self, peer: Peer) -> p2p_pb2.P2PFrame | None:
+        try:
+            head = await peer.reader.readexactly(4)
+        except asyncio.IncompleteReadError:
+            return None
+        (length,) = struct.unpack(">I", head)
+        if length > MAX_FRAME:
+            return None
+        raw = await peer.reader.readexactly(length)
+        return p2p_pb2.P2PFrame.FromString(raw)
+
+    async def handle_frame(self, peer: Peer, frame: p2p_pb2.P2PFrame) -> None:
+        which = frame.WhichOneof("f")
+        if which == "gossip":
+            await self.on_gossip(peer, frame.gossip.topic, frame.gossip.payload)
+        elif which == "req":
+            await self.on_req(peer, frame.req)
+        elif which == "resp":
+            await self.on_resp(peer, frame.resp)
+        elif which == "peer_exchange":
+            await self.on_peer_exchange(frame.peer_exchange.addrs)
+        elif which == "goodbye":
+            peer.writer.close()
+
+    # ------------------------------------------------------------- gossip
+
+    def _mark_seen(self, msg_id: bytes) -> bool:
+        """True if newly seen."""
+        if msg_id in self.seen:
+            return False
+        self.seen[msg_id] = None
+        while len(self.seen) > GOSSIP_SEEN_CAP:
+            self.seen.popitem(last=False)
+        return True
+
+    async def publish(self, topic: str, payload: bytes) -> None:
+        msg_id = _msg_id(topic, payload)
+        self._mark_seen(msg_id)
+        await self._forward(topic, payload, exclude=None)
+
+    async def _forward(self, topic: str, payload: bytes, exclude: bytes | None) -> None:
+        frame = p2p_pb2.P2PFrame()
+        frame.gossip.topic = topic
+        frame.gossip.payload = payload
+        for node_id, peer in list(self.peers.items()):
+            if node_id == exclude:
+                continue
+            try:
+                await peer.send_frame(frame)
+            except (OSError, ConnectionError):
+                pass
+
+    async def on_gossip(self, peer: Peer, topic: str, payload: bytes) -> None:
+        msg_id = _msg_id(topic, payload)
+        if not self._mark_seen(msg_id):
+            return
+        if topic not in self.subscriptions:
+            # not interested, but still forward (flood routing)
+            await self._forward(topic, payload, exclude=peer.node_id)
+            return
+        # host-gated validation before forwarding (reference: blocking topic
+        # validator waiting on the Elixir verdict, subscriptions.go:95-135)
+        self.pending_validation[msg_id] = (topic, payload, peer.node_id)
+        while len(self.pending_validation) > GOSSIP_SEEN_CAP:
+            self.pending_validation.popitem(last=False)
+        n = port_pb2.Notification()
+        n.gossip.topic = topic
+        n.gossip.msg_id = msg_id
+        n.gossip.payload = payload
+        n.gossip.peer_id = peer.node_id
+        await self.notify(n)
+
+    async def finish_validation(self, msg_id: bytes, verdict: int) -> None:
+        entry = self.pending_validation.pop(msg_id, None)
+        if entry is None:
+            return
+        topic, payload, source = entry
+        if verdict == port_pb2.ValidateMessage.ACCEPT:
+            await self._forward(topic, payload, exclude=source)
+
+    # ------------------------------------------------------------ req/resp
+
+    async def send_request(self, cmd: port_pb2.Command) -> None:
+        req = cmd.send_request
+        peer = self.peers.get(req.peer_id)
+        if peer is None:
+            await self.result(cmd.id, False, error="unknown peer")
+            return
+        self._req_counter += 1
+        req_id = self._req_counter.to_bytes(8, "big")
+        self.pending_requests[req_id] = (cmd.id, peer.node_id)
+        frame = p2p_pb2.P2PFrame()
+        frame.req.req_id = req_id
+        frame.req.protocol_id = req.protocol_id
+        frame.req.payload = req.payload
+        try:
+            await peer.send_frame(frame)
+        except (OSError, ConnectionError) as e:
+            self.pending_requests.pop(req_id, None)
+            await self.result(cmd.id, False, error=f"send: {e}")
+            return
+        timeout = (req.timeout_ms or 15000) / 1000
+        asyncio.get_running_loop().call_later(
+            timeout, lambda: asyncio.ensure_future(self._expire_request(req_id))
+        )
+
+    async def _expire_request(self, req_id: bytes) -> None:
+        entry = self.pending_requests.pop(req_id, None)
+        if entry is not None:
+            await self.result(entry[0], False, error="request timed out")
+
+    async def on_req(self, peer: Peer, req: p2p_pb2.Req) -> None:
+        if req.protocol_id not in self.handlers:
+            frame = p2p_pb2.P2PFrame()
+            frame.resp.req_id = req.req_id
+            frame.resp.ok = False
+            frame.resp.error = "unsupported protocol"
+            await peer.send_frame(frame)
+            return
+        request_id = peer.conn_id.to_bytes(8, "big") + req.req_id
+        self.incoming_requests[request_id] = peer
+        n = port_pb2.Notification()
+        n.request.protocol_id = req.protocol_id
+        n.request.request_id = request_id
+        n.request.payload = req.payload
+        n.request.peer_id = peer.node_id
+        await self.notify(n)
+
+    async def send_response(self, cmd: port_pb2.Command) -> None:
+        resp = cmd.send_response
+        peer = self.incoming_requests.pop(resp.request_id, None)
+        if peer is None:
+            await self.result(cmd.id, False, error="unknown request id")
+            return
+        frame = p2p_pb2.P2PFrame()
+        frame.resp.req_id = resp.request_id[8:]
+        frame.resp.payload = resp.payload
+        frame.resp.ok = True
+        try:
+            await peer.send_frame(frame)
+            await self.result(cmd.id, True)
+        except (OSError, ConnectionError) as e:
+            await self.result(cmd.id, False, error=f"send: {e}")
+
+    async def on_resp(self, peer: Peer, resp: p2p_pb2.Resp) -> None:
+        entry = self.pending_requests.get(resp.req_id)
+        if entry is None:
+            return  # expired or unknown
+        cmd_id, expected_peer = entry
+        if peer.node_id != expected_peer:
+            return  # forged response from a different peer: ignore
+        del self.pending_requests[resp.req_id]
+        if resp.ok:
+            await self.result(cmd_id, True, payload=resp.payload)
+        else:
+            await self.result(cmd_id, False, error=resp.error or "remote error")
+
+    # ------------------------------------------------------------ discovery
+
+    async def on_peer_exchange(self, addrs) -> None:
+        if not self.enable_peer_exchange:
+            return
+        budget = MAX_DIALED_FROM_EXCHANGE - len(self.peers)
+        for addr in addrs:
+            if budget <= 0:
+                break
+            if addr not in self.known_addrs:
+                self.known_addrs.add(addr)
+                budget -= 1
+                asyncio.ensure_future(self.dial(addr))
+
+
+async def _main() -> None:
+    sidecar = Sidecar()
+    await sidecar.command_loop()
+
+
+def main() -> None:
+    try:
+        asyncio.run(_main())
+    except (KeyboardInterrupt, asyncio.IncompleteReadError, EOFError):
+        pass
+
+
+if __name__ == "__main__":
+    main()

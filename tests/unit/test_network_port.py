@@ -1,0 +1,161 @@
+"""Two sidecar processes over loopback TCP: req/resp + gossip round-trips
+(mirror of the reference's test/unit/libp2p_port_test.exs:30-50)."""
+
+import asyncio
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.network import Port
+from lambda_ethereum_consensus_tpu.network.port import (
+    VERDICT_ACCEPT,
+    VERDICT_REJECT,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def start_pair(fork_digest=b"\xba\xa4\xda\x96"):
+    recver = await Port.start(fork_digest=fork_digest)
+    sender = await Port.start(fork_digest=fork_digest)
+    new_peer = asyncio.get_running_loop().create_future()
+
+    def on_new_peer(peer_id, addr):
+        if not new_peer.done():
+            new_peer.set_result(peer_id)
+
+    sender.on_new_peer = on_new_peer
+    await sender.add_peer(f"127.0.0.1:{recver.listen_port}")
+    peer_id = await asyncio.wait_for(new_peer, 10)
+    assert peer_id == recver.node_id
+    return sender, recver, peer_id
+
+
+def test_identity_and_connect():
+    async def main():
+        sender, recver, peer_id = await start_pair()
+        assert len(sender.node_id) == 32
+        assert sender.node_id != recver.node_id
+        await sender.close()
+        await recver.close()
+
+    run(main())
+
+
+def test_request_response_roundtrip():
+    async def main():
+        sender, recver, peer_id = await start_pair()
+
+        async def handle(protocol_id, request_id, payload, from_peer):
+            assert payload == b"ping payload"
+            assert from_peer == sender.node_id
+            await recver.send_response(request_id, b"pong:" + payload)
+
+        await recver.set_request_handler("/eth2/beacon_chain/req/ping/1/", handle)
+        reply = await sender.send_request(
+            peer_id, "/eth2/beacon_chain/req/ping/1/", b"ping payload"
+        )
+        assert reply == b"pong:ping payload"
+        await sender.close()
+        await recver.close()
+
+    run(main())
+
+
+def test_request_unsupported_protocol_errors():
+    async def main():
+        sender, recver, peer_id = await start_pair()
+        with pytest.raises(Exception, match="unsupported protocol"):
+            await sender.send_request(peer_id, "/nope/1/", b"x")
+        await sender.close()
+        await recver.close()
+
+    run(main())
+
+
+def test_gossip_roundtrip_with_validation():
+    async def main():
+        sender, recver, peer_id = await start_pair()
+        got = asyncio.get_running_loop().create_future()
+
+        async def on_gossip(topic, msg_id, payload, from_peer):
+            await recver.validate_message(msg_id, VERDICT_ACCEPT)
+            if not got.done():
+                got.set_result((topic, payload))
+
+        await recver.subscribe("/eth2/test/topic/ssz_snappy", on_gossip)
+        await asyncio.sleep(0.2)  # let subscription settle
+        await sender.publish("/eth2/test/topic/ssz_snappy", b"gossip body")
+        topic, payload = await asyncio.wait_for(got, 10)
+        assert topic == "/eth2/test/topic/ssz_snappy"
+        assert payload == b"gossip body"
+        await sender.close()
+        await recver.close()
+
+    run(main())
+
+
+def test_gossip_propagates_through_middle_node():
+    """A -> B -> C flood: C must receive a message published by A only if B
+    accepts it (validation gates forwarding)."""
+
+    async def main():
+        digest = b"\x01\x02\x03\x04"
+        a = await Port.start(fork_digest=digest)
+        b = await Port.start(fork_digest=digest, enable_peer_exchange=False)
+        c = await Port.start(fork_digest=digest, enable_peer_exchange=False)
+        await a.add_peer(f"127.0.0.1:{b.listen_port}")
+        await c.add_peer(f"127.0.0.1:{b.listen_port}")
+        await asyncio.sleep(0.3)
+
+        got_c = asyncio.get_running_loop().create_future()
+
+        async def on_b(topic, msg_id, payload, from_peer):
+            verdict = VERDICT_ACCEPT if payload != b"bad" else VERDICT_REJECT
+            await b.validate_message(msg_id, verdict)
+
+        async def on_c(topic, msg_id, payload, from_peer):
+            await c.validate_message(msg_id, VERDICT_ACCEPT)
+            if not got_c.done():
+                got_c.set_result(payload)
+
+        await b.subscribe("/t", on_b)
+        await c.subscribe("/t", on_c)
+        await asyncio.sleep(0.2)
+        await a.publish("/t", b"bad")  # rejected at B, must not reach C
+        await a.publish("/t", b"good")
+        payload = await asyncio.wait_for(got_c, 10)
+        assert payload == b"good"
+        for port in (a, b, c):
+            await port.close()
+
+    run(main())
+
+
+def test_fork_digest_mismatch_filters_peer():
+    async def main():
+        x = await Port.start(fork_digest=b"\xaa\xaa\xaa\xaa")
+        y = await Port.start(fork_digest=b"\xbb\xbb\xbb\xbb")
+        connected = asyncio.get_running_loop().create_future()
+        x.on_new_peer = lambda *a: connected.done() or connected.set_result(a)
+        await x.add_peer(f"127.0.0.1:{y.listen_port}")
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(asyncio.shield(connected), 1.5)
+        await x.close()
+        await y.close()
+
+    run(main())
+
+
+def test_sidecar_crash_detected():
+    async def main():
+        port = await Port.start()
+        exited = asyncio.get_running_loop().create_future()
+        port.on_exit = lambda: exited.done() or exited.set_result(True)
+        port._proc.kill()
+        assert await asyncio.wait_for(exited, 10)
+        assert not port.alive
+        await port.close()
+
+    run(main())

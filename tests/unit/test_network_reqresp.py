@@ -1,0 +1,181 @@
+"""Req/resp framing + server/downloader + batched gossip over loopback."""
+
+import asyncio
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.network import Port
+from lambda_ethereum_consensus_tpu.network.gossip import (
+    GossipMessage,
+    TopicSubscription,
+    publish_ssz,
+)
+from lambda_ethereum_consensus_tpu.network.peerbook import Peerbook
+from lambda_ethereum_consensus_tpu.network.port import VERDICT_ACCEPT
+from lambda_ethereum_consensus_tpu.network.reqresp import (
+    BlockDownloader,
+    ReqRespError,
+    ReqRespServer,
+    SUCCESS,
+    decode_request,
+    decode_response_chunks,
+    encode_request,
+    encode_response_chunk,
+    ping_peer,
+)
+from lambda_ethereum_consensus_tpu.types.beacon import (
+    BeaconBlock,
+    BeaconBlockBody,
+    SignedBeaconBlock,
+)
+from lambda_ethereum_consensus_tpu.types.p2p import Metadata, StatusMessage
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=120))
+
+
+# ------------------------------------------------------------------ framing
+
+def test_request_framing_roundtrip():
+    data = b"\x01\x02\x03" * 100
+    assert decode_request(encode_request(data)) == data
+
+
+def test_response_chunk_roundtrip():
+    chunks = (
+        encode_response_chunk(SUCCESS, b"first block bytes", context=b"\xaa\xbb\xcc\xdd")
+        + encode_response_chunk(SUCCESS, b"second", context=b"\xaa\xbb\xcc\xdd")
+    )
+    out = decode_response_chunks(chunks, context_bytes=4)
+    assert [(r, c, d) for r, c, d in out] == [
+        (SUCCESS, b"\xaa\xbb\xcc\xdd", b"first block bytes"),
+        (SUCCESS, b"\xaa\xbb\xcc\xdd", b"second"),
+    ]
+
+
+def test_error_chunk_has_no_context():
+    chunks = encode_response_chunk(2, b"server exploded")
+    out = decode_response_chunks(chunks, context_bytes=4)
+    assert out == [(2, b"", b"server exploded")]
+
+
+# ---------------------------------------------------------------- live pair
+
+class FakeChain:
+    """ChainView over a handful of in-memory blocks."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.blocks = {}
+        for slot in (1, 2, 3, 5):
+            signed = SignedBeaconBlock(
+                message=BeaconBlock(slot=slot, body=BeaconBlockBody())
+            )
+            self.blocks[slot] = signed
+
+    def status(self):
+        return StatusMessage(
+            fork_digest=b"\xba\xa4\xda\x96",
+            finalized_root=b"\x11" * 32,
+            finalized_epoch=0,
+            head_root=b"\x22" * 32,
+            head_slot=5,
+        )
+
+    def metadata(self):
+        return Metadata(seq_number=7)
+
+    def block_by_slot(self, slot):
+        return self.blocks.get(slot)
+
+    def block_by_root(self, root):
+        for b in self.blocks.values():
+            if b.message.hash_tree_root(self.spec) == root:
+                return b
+        return None
+
+
+@pytest.fixture(scope="module")
+def spec():
+    with use_chain_spec(minimal_spec()) as s:
+        yield s
+
+
+def test_block_download_roundtrip(spec):
+    async def main():
+        server_port = await Port.start(fork_digest=b"\xba\xa4\xda\x96")
+        client_port = await Port.start(fork_digest=b"\xba\xa4\xda\x96")
+        chain = FakeChain(spec)
+        server = ReqRespServer(server_port, chain, spec)
+        await server.register()
+
+        peerbook = Peerbook()
+        connected = asyncio.get_running_loop().create_future()
+        client_port.on_new_peer = lambda pid, addr: (
+            peerbook.add_peer(pid),
+            connected.done() or connected.set_result(pid),
+        )
+        await client_port.add_peer(f"127.0.0.1:{server_port.listen_port}")
+        await asyncio.wait_for(connected, 10)
+
+        downloader = BlockDownloader(client_port, peerbook, spec)
+        blocks = await downloader.request_blocks_by_range(1, 5)
+        assert [b.message.slot for b in blocks] == [1, 2, 3, 5]
+
+        roots = [chain.blocks[2].message.hash_tree_root(spec)]
+        by_root = await downloader.request_blocks_by_root(roots)
+        assert [b.message.slot for b in by_root] == [2]
+
+        seq = await ping_peer(client_port, server_port.node_id)
+        assert seq == 7
+
+        await client_port.close()
+        await server_port.close()
+
+    run(main())
+
+
+def test_gossip_batch_pipeline(spec):
+    async def main():
+        digest = b"\xba\xa4\xda\x96"
+        a = await Port.start(fork_digest=digest)
+        b = await Port.start(fork_digest=digest)
+        await a.add_peer(f"127.0.0.1:{b.listen_port}")
+        await asyncio.sleep(0.3)
+
+        received: list[list[GossipMessage]] = []
+        done = asyncio.get_running_loop().create_future()
+
+        async def handler(batch):
+            received.append(batch)
+            total = sum(len(x) for x in received)
+            if total >= 3 and not done.done():
+                done.set_result(total)
+            return [VERDICT_ACCEPT] * len(batch)
+
+        sub = TopicSubscription(
+            b, "/eth2/test/beacon_block/ssz_snappy", handler,
+            ssz_type=SignedBeaconBlock, spec=spec,
+        )
+        await sub.start()
+        await asyncio.sleep(0.2)
+
+        for slot in (10, 11, 12):
+            signed = SignedBeaconBlock(
+                message=BeaconBlock(slot=slot, body=BeaconBlockBody())
+            )
+            await publish_ssz(a, "/eth2/test/beacon_block/ssz_snappy", signed, spec)
+        total = await asyncio.wait_for(done, 15)
+        assert total == 3
+        slots = sorted(
+            m.value.message.slot for batch in received for m in batch
+        )
+        assert slots == [10, 11, 12]
+        # decoded containers came through the batch path
+        await sub.stop()
+        await a.close()
+        await b.close()
+
+    run(main())

@@ -1,0 +1,82 @@
+"""Snappy block + frame formats vs known vectors and round-trips."""
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.compression import (
+    SnappyError,
+    compress,
+    decompress,
+    frame_compress,
+    frame_decompress,
+)
+from lambda_ethereum_consensus_tpu.compression.snappy import crc32c
+
+
+CASES = [
+    b"",
+    b"a",
+    b"hello world",
+    b"aaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaaa",  # long overlapping match
+    bytes(range(256)) * 10,
+    b"abcd" * 50000,  # spans fragments
+    b"\x00" * 100000,
+]
+
+
+@pytest.mark.parametrize("data", CASES, ids=range(len(CASES)))
+def test_block_roundtrip(data):
+    assert decompress(compress(data)) == data
+
+
+@pytest.mark.parametrize("data", CASES, ids=range(len(CASES)))
+def test_frame_roundtrip(data):
+    assert frame_decompress(frame_compress(data)) == data
+
+
+def test_compression_actually_compresses():
+    data = b"deadbeef" * 10000
+    assert len(compress(data)) < len(data) // 4
+
+
+def test_crc32c_known_vectors():
+    # Standard CRC32C check values
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0x00000000
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_decompress_handles_all_copy_kinds():
+    # hand-assembled stream: literal "abcd", copy1(len 4, off 4), copy4
+    raw = bytes(
+        [12]  # varint length 12
+        + [(4 - 1) << 2] + list(b"abcd")  # literal abcd
+        + [((4 - 4) << 2 | (0 << 5)) | 1, 4]  # copy1: len 4, offset 4
+        + [((4 - 1) << 2) | 3] + list((8).to_bytes(4, "little"))  # copy4 len 4 off 8
+    )
+    assert decompress(raw) == b"abcdabcdabcd"
+
+
+def test_corrupt_inputs_raise():
+    good = compress(b"some data here")
+    with pytest.raises(SnappyError):
+        decompress(good[:-2])
+    with pytest.raises(SnappyError):
+        decompress(b"\xff\xff\xff\xff\xff\xff")  # varint too long / truncated
+    with pytest.raises(SnappyError):
+        frame_decompress(b"not a snappy frame")
+    framed = bytearray(frame_compress(b"payload payload payload"))
+    framed[15] ^= 0xFF  # corrupt checksum/body
+    with pytest.raises(SnappyError):
+        frame_decompress(bytes(framed))
+
+
+def test_uncompressed_chunk_accepted():
+    payload = b"tiny"
+    from lambda_ethereum_consensus_tpu.compression.snappy import (
+        _STREAM_ID,
+        _masked_crc,
+    )
+
+    body = _masked_crc(payload).to_bytes(4, "little") + payload
+    stream = _STREAM_ID + bytes([0x01]) + len(body).to_bytes(3, "little") + body
+    assert frame_decompress(stream) == payload
