@@ -1,0 +1,7 @@
+"""External HTTP interfaces: Beacon API server, Engine API client,
+checkpoint-sync client (ref: lib/beacon_api/, lib/.../engine/,
+lib/.../fork_choice/checkpoint_sync.ex)."""
+
+from .beacon_api import BeaconApiServer
+
+__all__ = ["BeaconApiServer"]

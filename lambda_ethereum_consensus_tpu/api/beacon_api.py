@@ -1,0 +1,197 @@
+"""Beacon API: the JSON routes the reference serves via Phoenix
+(ref: lib/beacon_api/router.ex:9-28 and the v1/v2 beacon controllers):
+
+- ``GET /eth/v1/beacon/states/{state_id}/root``
+- ``GET /eth/v1/beacon/blocks/{block_id}/root``
+- ``GET /eth/v2/beacon/blocks/{block_id}``
+- plus ``/eth/v1/node/health``, ``/eth/v1/node/identity`` and ``/metrics``
+
+Implemented as a dependency-free asyncio HTTP/1.1 server; the reference's
+v1 state-root route is mostly hardcoded TODOs (v1/beacon_controller.ex:7-60)
+— here every route answers from live chain data.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import Callable
+
+from ..config import ChainSpec
+from ..fork_choice import Store, get_head
+
+
+class BeaconApiServer:
+    def __init__(
+        self,
+        store: Store,
+        spec: ChainSpec,
+        metrics=None,
+        node_id: bytes | None = None,
+        port: int = 0,
+        host: str = "127.0.0.1",
+    ):
+        self.store = store
+        self.spec = spec
+        self.metrics = metrics
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------ plumbing
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10)
+            parts = request_line.decode("latin1").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0], parts[1]
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            status, content_type, body = self._route(method, path)
+            head = (
+                f"HTTP/1.1 {status}\r\n"
+                f"Content-Type: {content_type}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n"
+            )
+            writer.write(head.encode() + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    def _route(self, method: str, path: str) -> tuple[str, str, bytes]:
+        if method != "GET":
+            return self._error(405, "method not allowed")
+        path = path.split("?", 1)[0]
+        for pattern, handler in self._routes():
+            m = re.fullmatch(pattern, path)
+            if m:
+                try:
+                    return handler(*m.groups())
+                except KeyError:
+                    return self._error(404, "not found")
+                except ValueError as e:
+                    return self._error(400, str(e))
+        return self._error(404, "unknown route")
+
+    def _routes(self) -> list[tuple[str, Callable]]:
+        return [
+            (r"/eth/v1/beacon/states/([^/]+)/root", self._state_root),
+            (r"/eth/v1/beacon/blocks/([^/]+)/root", self._block_root),
+            (r"/eth/v2/beacon/blocks/([^/]+)", self._block_v2),
+            (r"/eth/v1/node/health", self._health),
+            (r"/eth/v1/node/identity", self._identity),
+            (r"/metrics", self._metrics),
+        ]
+
+    @staticmethod
+    def _json(payload, status: str = "200 OK") -> tuple[str, str, bytes]:
+        return status, "application/json", json.dumps(payload).encode()
+
+    @staticmethod
+    def _error(code: int, message: str) -> tuple[str, str, bytes]:
+        reasons = {400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed"}
+        return (
+            f"{code} {reasons.get(code, 'Error')}",
+            "application/json",
+            json.dumps({"code": code, "message": message}).encode(),
+        )
+
+    # ------------------------------------------------------------- resolvers
+
+    def _resolve_block_root(self, block_id: str) -> bytes:
+        if block_id == "head":
+            return get_head(self.store, self.spec)
+        if block_id == "finalized":
+            return bytes(self.store.finalized_checkpoint.root)
+        if block_id == "justified":
+            return bytes(self.store.justified_checkpoint.root)
+        if block_id == "genesis":
+            block_id = "0"
+        if block_id.startswith("0x"):
+            root = bytes.fromhex(block_id[2:])
+            if root not in self.store.blocks:
+                raise KeyError(block_id)
+            return root
+        if block_id.isdigit():
+            slot = int(block_id)
+            for root, block in self.store.blocks.items():
+                if block.slot == slot:
+                    return root
+            raise KeyError(block_id)
+        raise ValueError(f"invalid block id {block_id!r}")
+
+    # --------------------------------------------------------------- routes
+
+    def _state_root(self, state_id: str) -> tuple[str, str, bytes]:
+        root = self._resolve_block_root(state_id)
+        state = self.store.block_states[root]
+        return self._json(
+            {"data": {"root": "0x" + state.hash_tree_root(self.spec).hex()}}
+        )
+
+    def _block_root(self, block_id: str) -> tuple[str, str, bytes]:
+        root = self._resolve_block_root(block_id)
+        return self._json({"data": {"root": "0x" + root.hex()}})
+
+    def _block_v2(self, block_id: str) -> tuple[str, str, bytes]:
+        root = self._resolve_block_root(block_id)
+        block = self.store.blocks[root]
+        return self._json(
+            {
+                "version": self.spec.fork_at_epoch(
+                    block.slot // self.spec.SLOTS_PER_EPOCH
+                ),
+                "execution_optimistic": False,
+                "finalized": block.slot
+                <= self.store.finalized_checkpoint.epoch * self.spec.SLOTS_PER_EPOCH,
+                "data": {
+                    "message": {
+                        "slot": str(block.slot),
+                        "proposer_index": str(block.proposer_index),
+                        "parent_root": "0x" + bytes(block.parent_root).hex(),
+                        "state_root": "0x" + bytes(block.state_root).hex(),
+                        "body_root": "0x" + block.body.hash_tree_root(self.spec).hex(),
+                    }
+                },
+            }
+        )
+
+    def _health(self) -> tuple[str, str, bytes]:
+        return "200 OK", "application/json", b"{}"
+
+    def _identity(self) -> tuple[str, str, bytes]:
+        return self._json(
+            {
+                "data": {
+                    "peer_id": (self.node_id or b"").hex(),
+                    "enr": "",
+                    "p2p_addresses": [],
+                }
+            }
+        )
+
+    def _metrics(self) -> tuple[str, str, bytes]:
+        body = (
+            self.metrics.render_prometheus().encode()
+            if self.metrics is not None
+            else b""
+        )
+        return "200 OK", "text/plain; version=0.0.4", body

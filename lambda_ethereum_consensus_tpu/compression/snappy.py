@@ -169,10 +169,12 @@ def decompress(data: bytes) -> bytes:
             pos += 4
         if offset == 0 or offset > len(out):
             raise SnappyError("copy offset out of range")
-        # overlapping copies are byte-at-a-time semantics
         start = len(out) - offset
-        for i in range(length):
-            out.append(out[start + i])
+        if offset >= length:  # non-overlapping: bulk slice copy
+            out += out[start : start + length]
+        else:  # overlapping copies are byte-at-a-time semantics
+            for i in range(length):
+                out.append(out[start + i])
     if len(out) != expected:
         raise SnappyError(
             f"decompressed length {len(out)} != preamble {expected}"
@@ -230,6 +232,42 @@ def frame_compress(data: bytes) -> bytes:
     return bytes(out)
 
 
+def read_frame_chunk(data: bytes, pos: int) -> tuple[bytes | None, int]:
+    """Parse one frame chunk at ``pos``: ``(payload | None, new_pos)``.
+
+    ``None`` payload means the chunk carried no data (repeated stream id or a
+    skippable chunk, types 0x80-0xFE per the framing spec).  The single chunk
+    parser shared by :func:`frame_decompress` and the req/resp stream reader.
+    """
+    n = len(data)
+    if pos + 4 > n:
+        raise SnappyError("truncated chunk header")
+    ctype = data[pos]
+    length = int.from_bytes(data[pos + 1 : pos + 4], "little")
+    pos += 4
+    if pos + length > n:
+        raise SnappyError("truncated chunk body")
+    body = data[pos : pos + length]
+    pos += length
+    if ctype in (_CHUNK_COMPRESSED, _CHUNK_UNCOMPRESSED):
+        if length < 4:
+            raise SnappyError("chunk too short for checksum")
+        want_crc = int.from_bytes(body[:4], "little")
+        payload = (
+            decompress(body[4:]) if ctype == _CHUNK_COMPRESSED else bytes(body[4:])
+        )
+        if _masked_crc(payload) != want_crc:
+            raise SnappyError("chunk checksum mismatch")
+        return payload, pos
+    if ctype == 0xFF:
+        if body != _STREAM_ID[4:]:
+            raise SnappyError("bad repeated stream identifier")
+        return None, pos
+    if 0x80 <= ctype <= 0xFE:
+        return None, pos  # skippable chunk types
+    raise SnappyError(f"unknown chunk type {ctype:#x}")
+
+
 def frame_decompress(data: bytes) -> bytes:
     data = bytes(data)
     if not data.startswith(_STREAM_ID):
@@ -237,32 +275,7 @@ def frame_decompress(data: bytes) -> bytes:
     pos = len(_STREAM_ID)
     out = bytearray()
     while pos < len(data):
-        if pos + 4 > len(data):
-            raise SnappyError("truncated chunk header")
-        ctype = data[pos]
-        length = int.from_bytes(data[pos + 1 : pos + 4], "little")
-        pos += 4
-        if pos + length > len(data):
-            raise SnappyError("truncated chunk body")
-        body = data[pos : pos + length]
-        pos += length
-        if ctype == _CHUNK_COMPRESSED or ctype == _CHUNK_UNCOMPRESSED:
-            if length < 4:
-                raise SnappyError("chunk too short for checksum")
-            want_crc = int.from_bytes(body[:4], "little")
-            payload = (
-                decompress(body[4:])
-                if ctype == _CHUNK_COMPRESSED
-                else bytes(body[4:])
-            )
-            if _masked_crc(payload) != want_crc:
-                raise SnappyError("chunk checksum mismatch")
+        payload, pos = read_frame_chunk(data, pos)
+        if payload is not None:
             out += payload
-        elif ctype == 0xFF:
-            if body != _STREAM_ID[4:]:
-                raise SnappyError("bad repeated stream identifier")
-        elif 0x80 <= ctype <= 0xFD:
-            continue  # skippable chunk types
-        else:
-            raise SnappyError(f"unknown chunk type {ctype:#x}")
     return bytes(out)

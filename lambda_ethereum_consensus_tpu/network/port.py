@@ -12,6 +12,7 @@ libp2p_port.ex:232-234).
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import struct
 import sys
@@ -73,16 +74,21 @@ class Port:
             env=env,
         )
         self._reader_task = asyncio.ensure_future(self._read_loop())
-        cmd = port_pb2.Command()
-        cmd.init.listen_addr = listen_addr
-        cmd.init.bootnodes.extend(bootnodes or [])
-        cmd.init.enable_peer_exchange = enable_peer_exchange
-        cmd.init.fork_digest = fork_digest.hex()
-        result = await self._command(cmd)
-        self.listen_port = int(result.payload.decode())
-        ident = port_pb2.Command()
-        ident.get_node_identity.SetInParent()
-        self.node_id = (await self._command(ident)).payload
+        try:
+            cmd = port_pb2.Command()
+            cmd.init.listen_addr = listen_addr
+            cmd.init.bootnodes.extend(bootnodes or [])
+            cmd.init.enable_peer_exchange = enable_peer_exchange
+            cmd.init.fork_digest = fork_digest.hex()
+            result = await self._command(cmd)
+            self.listen_port = int(result.payload.decode())
+            ident = port_pb2.Command()
+            ident.get_node_identity.SetInParent()
+            self.node_id = (await self._command(ident)).payload
+        except BaseException:
+            # failed handshake must not leak the subprocess / reader task
+            await self.close()
+            raise
         return self
 
     async def close(self) -> None:
@@ -213,36 +219,48 @@ class Port:
         elif which == "gossip":
             handler = self.gossip_handlers.get(n.gossip.topic)
             if handler is None:
-                self._spawn(self.validate_message(n.gossip.msg_id, VERDICT_IGNORE))
+                self._spawn(self.validate_message, n.gossip.msg_id, VERDICT_IGNORE)
             else:
                 self._spawn(
-                    handler(
-                        n.gossip.topic, n.gossip.msg_id, n.gossip.payload, n.gossip.peer_id
-                    )
+                    handler,
+                    n.gossip.topic, n.gossip.msg_id, n.gossip.payload, n.gossip.peer_id,
                 )
         elif which == "request":
             handler = self.request_handlers.get(n.request.protocol_id)
             if handler is not None:
                 self._spawn(
-                    handler(
-                        n.request.protocol_id,
-                        n.request.request_id,
-                        n.request.payload,
-                        n.request.peer_id,
-                    )
+                    handler,
+                    n.request.protocol_id,
+                    n.request.request_id,
+                    n.request.payload,
+                    n.request.peer_id,
                 )
         elif which == "new_peer":
             if self.on_new_peer is not None:
-                self._spawn(self.on_new_peer(n.new_peer.peer_id, n.new_peer.addr))
+                self._spawn(self.on_new_peer, n.new_peer.peer_id, n.new_peer.addr)
         elif which == "peer_gone":
             if self.on_peer_gone is not None:
-                self._spawn(self.on_peer_gone(n.peer_gone.peer_id))
+                self._spawn(self.on_peer_gone, n.peer_gone.peer_id)
 
     @staticmethod
-    def _spawn(value) -> None:
-        """Run a (possibly sync) handler without blocking the read loop."""
+    def _spawn(handler, *args) -> None:
+        """Run a (possibly sync) handler without blocking — or killing — the
+        read loop: a raising callback must not declare the sidecar dead."""
+        try:
+            value = handler(*args)
+        except Exception:
+            logging.getLogger("network.port").exception("notification handler failed")
+            return
         if asyncio.iscoroutine(value):
-            asyncio.ensure_future(value)
+            task = asyncio.ensure_future(value)
+            task.add_done_callback(_log_task_exception)
+
+
+def _log_task_exception(task: asyncio.Task) -> None:
+    if not task.cancelled() and task.exception() is not None:
+        logging.getLogger("network.port").error(
+            "async notification handler failed", exc_info=task.exception()
+        )
 
 
 async def _maybe_await(value):

@@ -43,29 +43,15 @@ class ReqRespError(RuntimeError):
 
 # ------------------------------------------------------------------ framing
 
-def _write_varint(n: int) -> bytes:
-    out = bytearray()
-    while n >= 0x80:
-        out.append((n & 0x7F) | 0x80)
-        n >>= 7
-    out.append(n)
-    return bytes(out)
+from ..compression.snappy import _read_varint as _snappy_read_varint
+from ..compression.snappy import _write_varint
 
 
 def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
-    result = 0
-    shift = 0
-    while True:
-        if pos >= len(data):
-            raise ReqRespError("truncated varint")
-        b = data[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return result, pos
-        shift += 7
-        if shift > 35:
-            raise ReqRespError("varint too long")
+    try:
+        return _snappy_read_varint(data, pos)
+    except SnappyError as e:
+        raise ReqRespError(str(e)) from None
 
 
 def encode_request(ssz_bytes: bytes) -> bytes:
@@ -122,8 +108,7 @@ _STREAM_ID = b"\xff\x06\x00\x00sNaPpY"
 
 def _read_snappy_frames(payload: bytes, pos: int, length: int) -> tuple[bytes, int]:
     """Consume snappy frames until ``length`` decompressed bytes are read."""
-    from ..compression.snappy import decompress as raw_decompress
-    from ..compression.snappy import _masked_crc
+    from ..compression.snappy import read_frame_chunk
 
     if payload[pos : pos + len(_STREAM_ID)] != _STREAM_ID:
         raise ReqRespError("missing snappy stream identifier in chunk")
@@ -136,28 +121,13 @@ def _read_snappy_frames(payload: bytes, pos: int, length: int) -> tuple[bytes, i
     while len(out) < length or not consumed_data_chunk:
         if pos >= n and length == 0:
             break  # tolerate encoders that emit nothing for empty bodies
-        if pos + 4 > n:
-            raise ReqRespError("truncated snappy chunk header")
-        ctype = payload[pos]
-        body_len = int.from_bytes(payload[pos + 1 : pos + 4], "little")
-        pos += 4
-        if pos + body_len > n:
-            raise ReqRespError("truncated snappy chunk body")
-        body = payload[pos : pos + body_len]
-        pos += body_len
-        if ctype in (0x00, 0x01):
-            if body_len < 4:
-                raise ReqRespError("snappy chunk too short")
-            want_crc = int.from_bytes(body[:4], "little")
-            piece = raw_decompress(body[4:]) if ctype == 0x00 else bytes(body[4:])
-            if _masked_crc(piece) != want_crc:
-                raise ReqRespError("snappy chunk checksum mismatch")
+        try:
+            piece, pos = read_frame_chunk(payload, pos)
+        except SnappyError as e:
+            raise ReqRespError(str(e)) from None
+        if piece is not None:
             out += piece
             consumed_data_chunk = True
-        elif ctype == 0xFF or 0x80 <= ctype <= 0xFD:
-            continue  # repeated stream id / skippable
-        else:
-            raise ReqRespError(f"unknown snappy chunk type {ctype:#x}")
     if len(out) != length:
         raise ReqRespError("chunk produced more data than declared")
     return bytes(out), pos

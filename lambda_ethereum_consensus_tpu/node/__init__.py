@@ -1,0 +1,13 @@
+"""Beacon node runtime: orchestration of every subsystem.
+
+The analogue of the reference's OTP supervision tree (ref: lib/lambda_
+ethereum_consensus/application.ex:26-45 — Telemetry, Libp2pPort, Db, Peerbook,
+IncomingRequests, ForkChoice, PendingBlocks, SyncBlocks, GossipSub,
+BeaconApi): a single-controller asyncio application owning the fork-choice
+store, with periodic loops for ticks/pending-blocks/downloads, batched gossip
+pipelines, and sidecar restart-on-crash.
+"""
+
+from .node import BeaconNode, NodeConfig
+
+__all__ = ["BeaconNode", "NodeConfig"]

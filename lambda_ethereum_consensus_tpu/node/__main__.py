@@ -1,0 +1,63 @@
+"""CLI entry: ``python -m lambda_ethereum_consensus_tpu.node``.
+
+Flags extend the reference's single ``--checkpoint-sync`` option
+(ref: application.ex:12-14) with network/preset/db/api selection.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from ..config import load_config_file, set_chain_spec
+from .node import BeaconNode, NodeConfig
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="lambda-ethereum-consensus-tpu")
+    p.add_argument("--network", default="mainnet", help="mainnet | minimal | path to config YAML")
+    p.add_argument("--checkpoint-sync", default=None, metavar="URL",
+                   help="trusted beacon API to fetch the finalized state from")
+    p.add_argument("--db", default="beacon.wal", help="path to the chain database")
+    p.add_argument("--listen", default="127.0.0.1:0", help="p2p listen address")
+    p.add_argument("--bootnodes", default="", help="comma-separated host:port seed peers")
+    p.add_argument("--api-port", type=int, default=4000, help="Beacon API port (ref default)")
+    p.add_argument("--no-sync", action="store_true", help="disable range sync")
+    p.add_argument("--log-level", default="info")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    logging.basicConfig(level=args.log_level.upper(),
+                        format="%(asctime)s [%(name)s] %(message)s")
+    if args.network in ("mainnet", "minimal"):
+        set_chain_spec(args.network)
+    else:
+        set_chain_spec(load_config_file(args.network))
+    config = NodeConfig(
+        db_path=args.db,
+        listen_addr=args.listen,
+        bootnodes=[b for b in args.bootnodes.split(",") if b],
+        api_port=args.api_port,
+        checkpoint_sync_url=args.checkpoint_sync,
+        enable_range_sync=not args.no_sync,
+    )
+    node = BeaconNode(config)
+
+    async def run():
+        await node.start()
+        try:
+            await asyncio.Event().wait()  # run forever
+        finally:
+            await node.stop()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

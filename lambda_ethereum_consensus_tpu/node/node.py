@@ -1,0 +1,293 @@
+"""BeaconNode: the whole client wired together.
+
+Startup order mirrors the reference's supervision tree (ref: application.ex:
+26-45): persistence -> anchor selection (DB resume | checkpoint sync |
+provided genesis, ref: fork_choice/supervisor.ex:16-44) -> fork-choice store
+-> network sidecar (restarted on crash) -> req/resp server -> gossip topics
+-> pending-blocks loops -> range sync -> tick loop -> Beacon API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+
+from ..api.beacon_api import BeaconApiServer
+from ..config import ChainSpec, get_chain_spec
+from ..fork_choice import (
+    Store,
+    get_forkchoice_store,
+    get_head,
+    on_attestation,
+    on_tick,
+)
+from ..network import Port
+from ..network.gossip import TopicSubscription, topic_name
+from ..network.peerbook import Peerbook
+from ..network.port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT
+from ..network.reqresp import BlockDownloader, ReqRespServer
+from ..state_transition.errors import SpecError
+from ..store import BlockStore, KvStore, StateStore
+from ..types.beacon import BeaconBlock, BeaconBlockBody, BeaconState, SignedBeaconBlock
+from ..types.validator import SignedAggregateAndProof
+from .chain import LiveChainView
+from .pending_blocks import PendingBlocks
+from .sync import SyncBlocks
+from .telemetry import Metrics
+
+log = logging.getLogger("node")
+
+
+@dataclass
+class NodeConfig:
+    db_path: str = "beacon.wal"
+    listen_addr: str = "127.0.0.1:0"
+    bootnodes: list[str] = field(default_factory=list)
+    api_port: int = 0
+    checkpoint_sync_url: str | None = None
+    genesis_state: BeaconState | None = None
+    anchor_block: BeaconBlock | None = None
+    enable_range_sync: bool = True
+
+
+class BeaconNode:
+    def __init__(self, config: NodeConfig, spec: ChainSpec | None = None):
+        self.config = config
+        self.spec = spec or get_chain_spec()
+        self.metrics = Metrics()
+        self.kv: KvStore | None = None
+        self.blocks_db: BlockStore | None = None
+        self.states_db: StateStore | None = None
+        self.store: Store | None = None
+        self.port: Port | None = None
+        self.peerbook = Peerbook()
+        self.pending: PendingBlocks | None = None
+        self.api: BeaconApiServer | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._subs: list[TopicSubscription] = []
+        self._stopping = False
+
+    # ------------------------------------------------------------- startup
+
+    async def start(self) -> None:
+        spec = self.spec
+        self.kv = KvStore(self.config.db_path)
+        self.blocks_db = BlockStore(self.kv)
+        self.states_db = StateStore(self.kv)
+
+        anchor_state, anchor_block = await self._select_anchor()
+        self.store = get_forkchoice_store(anchor_state, anchor_block, spec)
+        # catch the store up to wall clock immediately (ref: on_tick_now at
+        # fork_choice/store.ex:65-82) so blocks are acceptable before the
+        # first timer tick
+        on_tick(self.store, int(time.time()), spec)
+        anchor_root = anchor_block.hash_tree_root(spec)
+        self.blocks_db.store_block(
+            SignedBeaconBlock(message=anchor_block), spec
+        )
+        self.states_db.store_state(anchor_root, anchor_state, spec)
+
+        self.chain = LiveChainView(self.store, self.blocks_db, spec)
+        await self._start_network()
+
+        self.pending = PendingBlocks(
+            self.store, spec, downloader=self.downloader, on_applied=self._on_applied
+        )
+        self.pending.start()
+
+        self._tasks.append(asyncio.ensure_future(self._tick_loop()))
+        if self.config.enable_range_sync:
+            self._tasks.append(asyncio.ensure_future(self._range_sync()))
+
+        self.api = BeaconApiServer(
+            self.store,
+            spec,
+            metrics=self.metrics,
+            node_id=self.port.node_id,
+            port=self.config.api_port,
+        )
+        await self.api.start()
+        log.info(
+            "node up: p2p=%s api=%s head=%s",
+            self.port.listen_port,
+            self.api.port,
+            get_head(self.store, spec).hex()[:16],
+        )
+
+    async def _select_anchor(self) -> tuple[BeaconState, BeaconBlock]:
+        """DB resume | checkpoint sync | provided genesis
+        (ref: fork_choice/supervisor.ex:16-44)."""
+        spec = self.spec
+        latest = self.states_db.get_latest_state(spec)
+        if latest is not None:
+            root, state = latest
+            stored = self.blocks_db.get_block(root, spec)
+            if stored is not None:
+                log.info("resuming from stored state at slot %d", state.slot)
+                return state, stored.message
+        if self.config.checkpoint_sync_url:
+            from ..api.checkpoint_sync import sync_from_checkpoint
+
+            state = await sync_from_checkpoint(self.config.checkpoint_sync_url, spec)
+            header = state.latest_block_header.copy(
+                state_root=state.hash_tree_root(spec)
+            )
+            anchor = BeaconBlock(
+                slot=header.slot,
+                proposer_index=header.proposer_index,
+                parent_root=bytes(header.parent_root),
+                state_root=bytes(header.state_root),
+                body=BeaconBlockBody(),
+            )
+            return state, anchor
+        if self.config.genesis_state is not None:
+            state = self.config.genesis_state
+            anchor = self.config.anchor_block or BeaconBlock(
+                slot=state.slot,
+                proposer_index=0,
+                parent_root=b"\x00" * 32,
+                state_root=state.hash_tree_root(spec),
+                body=BeaconBlockBody(),
+            )
+            return state, anchor
+        raise RuntimeError(
+            "no anchor available: provide genesis_state or checkpoint_sync_url"
+        )
+
+    async def _start_network(self) -> None:
+        digest = self.chain.fork_digest()
+        self.port = await Port.start(
+            listen_addr=self.config.listen_addr,
+            bootnodes=self.config.bootnodes,
+            fork_digest=digest,
+        )
+        self.port.on_new_peer = self._on_new_peer
+        self.port.on_peer_gone = self._on_peer_gone
+        self.port.on_exit = self._on_sidecar_exit
+        self.downloader = BlockDownloader(self.port, self.peerbook, self.spec)
+        self.reqresp = ReqRespServer(self.port, self.chain, self.spec)
+        await self.reqresp.register()
+
+        # gossip topics (ref: gossipsub.ex:16-34 — block + aggregate topics)
+        block_topic = topic_name(digest, "beacon_block")
+        sub = TopicSubscription(
+            self.port, block_topic, self._on_block_batch,
+            ssz_type=SignedBeaconBlock, spec=self.spec,
+        )
+        await sub.start()
+        self._subs.append(sub)
+        agg_topic = topic_name(digest, "beacon_aggregate_and_proof")
+        agg = TopicSubscription(
+            self.port, agg_topic, self._on_aggregate_batch,
+            ssz_type=SignedAggregateAndProof, spec=self.spec,
+        )
+        await agg.start()
+        self._subs.append(agg)
+
+    # ------------------------------------------------------------- handlers
+
+    def _on_new_peer(self, peer_id: bytes, addr: str) -> None:
+        self.peerbook.add_peer(peer_id)
+        self.metrics.set_gauge("peers_connection_count", len(self.peerbook))
+
+    def _on_peer_gone(self, peer_id: bytes) -> None:
+        self.peerbook.remove_peer(peer_id)
+        self.metrics.set_gauge("peers_connection_count", len(self.peerbook))
+
+    async def _on_sidecar_exit(self) -> None:
+        if self._stopping:
+            return
+        log.warning("network sidecar died; restarting")
+        self.metrics.inc("sidecar_restarts")
+        await asyncio.sleep(1.0)
+        if not self._stopping:
+            await self._start_network()
+
+    async def _on_block_batch(self, batch) -> list[int]:
+        """Batched gossip blocks -> pending set (one decode pass; signature
+        verification happens in on_block)."""
+        verdicts = []
+        head_slot = self.store.current_slot(self.spec)
+        for msg in batch:
+            block = msg.value
+            self.metrics.inc("network_gossip_count", type="beacon_block")
+            # within-one-epoch window check (ref: gossip_handler.ex:21)
+            if abs(block.message.slot - head_slot) <= self.spec.SLOTS_PER_EPOCH:
+                self.pending.add_block(block)
+                verdicts.append(VERDICT_ACCEPT)
+            else:
+                verdicts.append(VERDICT_IGNORE)
+        return verdicts
+
+    async def _on_aggregate_batch(self, batch) -> list[int]:
+        verdicts = []
+        for msg in batch:
+            self.metrics.inc("network_gossip_count", type="aggregate_and_proof")
+            try:
+                on_attestation(
+                    self.store,
+                    msg.value.message.aggregate,
+                    is_from_block=False,
+                    spec=self.spec,
+                )
+                verdicts.append(VERDICT_ACCEPT)
+            except SpecError:
+                verdicts.append(VERDICT_IGNORE)
+        return verdicts
+
+    def _on_applied(self, root: bytes, signed: SignedBeaconBlock) -> None:
+        self.blocks_db.store_block(signed, self.spec)
+        self.states_db.store_state(root, self.store.block_states[root], self.spec)
+        self.metrics.set_gauge("sync_store_slot", signed.message.slot)
+
+    # ---------------------------------------------------------------- loops
+
+    async def _tick_loop(self) -> None:
+        """1 s wall-clock ticks, aligned to the second boundary
+        (ref: fork_choice/store.ex:178-182)."""
+        while True:
+            now = time.time()
+            await asyncio.sleep(1.0 - (now % 1.0))
+            try:
+                on_tick(self.store, int(time.time()), self.spec)
+            except Exception:
+                log.exception("tick failed")
+
+    async def _range_sync(self) -> None:
+        sync = SyncBlocks(self.store, self.pending, self.downloader, self.spec)
+        # wait for at least one peer before syncing
+        for _ in range(100):
+            if len(self.peerbook):
+                break
+            await asyncio.sleep(0.1)
+        if not len(self.peerbook):
+            return
+        try:
+            fetched = await sync.run()
+            self.metrics.inc("network_request_count", value=fetched, result="ok", type="range_sync")
+            log.info("range sync fetched %d blocks", fetched)
+        except Exception:
+            log.exception("range sync failed")
+
+    # ------------------------------------------------------------- shutdown
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for sub in self._subs:
+            try:
+                await sub.stop()
+            except Exception:
+                pass
+        if self.pending is not None:
+            self.pending.stop()
+        for t in self._tasks:
+            t.cancel()
+        if self.api is not None:
+            await self.api.stop()
+        if self.port is not None:
+            await self.port.close()
+        if self.kv is not None:
+            self.kv.flush()
+            self.kv.close()

@@ -1,0 +1,132 @@
+"""Pending-block tracking: hold, order, apply or mark invalid, fetch parents.
+
+Port of the reference's PendingBlocks GenServer (ref: lib/.../beacon/
+pending_blocks.ex): every PROCESS_INTERVAL the pending set is scanned in slot
+order — blocks whose parent is in the fork-choice store are applied, blocks
+with invalid parents become (transitively) invalid, unknown parents are
+queued for download; every DOWNLOAD_INTERVAL up to MAX_DOWNLOAD queued roots
+are fetched from peers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..config import ChainSpec
+from ..fork_choice import Store, on_block
+from ..state_transition.errors import SpecError
+from ..types.beacon import SignedBeaconBlock
+
+log = logging.getLogger("pending_blocks")
+
+PROCESS_INTERVAL = 3.0  # ref: pending_blocks.ex:158-164
+DOWNLOAD_INTERVAL = 1.0
+MAX_DOWNLOAD = 20
+
+
+class PendingBlocks:
+    def __init__(
+        self,
+        store: Store,
+        spec: ChainSpec,
+        downloader=None,
+        on_applied=None,
+    ):
+        self.store = store
+        self.spec = spec
+        self.downloader = downloader
+        self.on_applied = on_applied  # callback(root, signed_block)
+        self.pending: dict[bytes, SignedBeaconBlock] = {}
+        self.invalid: set[bytes] = set()
+        self.to_download: set[bytes] = set()
+        self._tasks: list[asyncio.Task] = []
+
+    def add_block(self, signed_block: SignedBeaconBlock) -> None:
+        root = signed_block.message.hash_tree_root(self.spec)
+        if root in self.invalid or root in self.store.blocks:
+            return
+        self.pending[root] = signed_block
+
+    def is_pending(self, root: bytes) -> bool:
+        return root in self.pending
+
+    # ------------------------------------------------------------ processing
+
+    async def process_once(self) -> int:
+        """One scan over the pending set; returns number applied."""
+        applied = 0
+        for root, signed in sorted(
+            list(self.pending.items()), key=lambda kv: kv[1].message.slot
+        ):
+            if root not in self.pending:
+                continue
+            parent = bytes(signed.message.parent_root)
+            if parent in self.invalid:
+                self._mark_invalid(root)
+            elif parent in self.store.blocks:
+                try:
+                    on_block(self.store, signed, spec=self.spec)
+                except SpecError as e:
+                    log.warning("invalid block %s: %s", root.hex()[:16], e)
+                    self._mark_invalid(root)
+                    continue
+                del self.pending[root]
+                applied += 1
+                if self.on_applied is not None:
+                    self.on_applied(root, signed)
+            elif parent in self.pending:
+                continue  # parent queued; it will be applied first next scan
+            else:
+                self.to_download.add(parent)
+        return applied
+
+    def _mark_invalid(self, root: bytes) -> None:
+        self.invalid.add(root)
+        self.pending.pop(root, None)
+        # transitively invalidate queued descendants
+        for r, b in list(self.pending.items()):
+            if bytes(b.message.parent_root) in self.invalid:
+                self._mark_invalid(r)
+
+    async def download_once(self) -> None:
+        if not self.to_download or self.downloader is None:
+            return
+        roots = [
+            r
+            for r in list(self.to_download)[:MAX_DOWNLOAD]
+            if r not in self.store.blocks and r not in self.pending
+        ]
+        self.to_download.difference_update(roots)
+        if not roots:
+            return
+        try:
+            blocks = await self.downloader.request_blocks_by_root(roots)
+        except Exception as e:
+            log.debug("parent download failed: %s", e)
+            self.to_download.update(roots)  # retry next tick
+            return
+        for block in blocks:
+            self.add_block(block)
+
+    # ---------------------------------------------------------------- loops
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.ensure_future(self._loop(self.process_once, PROCESS_INTERVAL)),
+            asyncio.ensure_future(self._loop(self.download_once, DOWNLOAD_INTERVAL)),
+        ]
+
+    def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+
+    async def _loop(self, fn, interval: float) -> None:
+        while True:
+            try:
+                await fn()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("pending-blocks loop error")
+            await asyncio.sleep(interval)

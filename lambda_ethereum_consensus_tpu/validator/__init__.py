@@ -1,0 +1,10 @@
+"""Validator duties: block production, attestation production, signing.
+
+The reference ships validator *containers* only (lib/ssz_types/validator/);
+a standalone framework also needs the production side — devnets, fixtures and
+integration tests all mint real signed blocks/attestations through here.
+"""
+
+from .duties import build_signed_block, make_attestation, sign_block
+
+__all__ = ["build_signed_block", "make_attestation", "sign_block"]

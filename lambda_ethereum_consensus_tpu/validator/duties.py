@@ -1,0 +1,130 @@
+"""Block and attestation production.
+
+``build_signed_block`` produces a fully valid signed block on top of a state:
+randao reveal, execution payload consistent with the state's payload header,
+expected withdrawals, the post-state root (computed by dry-running the
+transition) and the proposer signature.  This is the write-side counterpart
+of :mod:`..state_transition` and what devnets and integration tests use to
+mint chains.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..config import ChainSpec, constants, get_chain_spec
+from ..crypto import bls
+from ..state_transition import accessors, misc, process_slots
+from ..state_transition.core import state_transition
+from ..state_transition.mutable import BeaconStateMut
+from ..types.beacon import (
+    Attestation,
+    AttestationData,
+    BeaconBlock,
+    BeaconBlockBody,
+    BeaconState,
+    Checkpoint,
+    ExecutionPayload,
+    SignedBeaconBlock,
+    SyncAggregate,
+)
+
+
+def sign_block(
+    state, block: BeaconBlock, secret_key: bytes, spec: ChainSpec
+) -> SignedBeaconBlock:
+    domain = accessors.get_domain(state, constants.DOMAIN_BEACON_PROPOSER, spec=spec)
+    signature = bls.sign(secret_key, misc.compute_signing_root(block, domain))
+    return SignedBeaconBlock(message=block, signature=signature)
+
+
+def build_signed_block(
+    state: BeaconState,
+    slot: int,
+    secret_keys: Sequence[bytes],
+    attestations: Sequence[Attestation] = (),
+    graffiti: bytes = b"\x00" * 32,
+    spec: ChainSpec | None = None,
+) -> tuple[SignedBeaconBlock, BeaconState]:
+    """Produce ``(signed_block, post_state)`` for ``slot`` on top of ``state``.
+
+    ``secret_keys[i]`` must be validator ``i``'s key (devnet-style registry).
+    """
+    spec = spec or get_chain_spec()
+    pre = process_slots(state, slot, spec) if state.slot < slot else state
+    ws = BeaconStateMut(pre)
+    proposer = accessors.get_beacon_proposer_index(ws, spec)
+    epoch = accessors.get_current_epoch(ws, spec)
+
+    randao_domain = accessors.get_domain(ws, constants.DOMAIN_RANDAO, epoch, spec)
+    randao_reveal = bls.sign(
+        secret_keys[proposer], misc.compute_signing_root_epoch(epoch, randao_domain)
+    )
+    payload = ExecutionPayload(
+        parent_hash=bytes(pre.latest_execution_payload_header.block_hash),
+        prev_randao=accessors.get_randao_mix(ws, epoch, spec),
+        timestamp=misc.compute_timestamp_at_slot(ws, slot, spec),
+        block_number=slot,
+        block_hash=misc.hash_bytes(
+            bytes(pre.latest_execution_payload_header.block_hash) + graffiti
+        ),
+        withdrawals=accessors.get_expected_withdrawals(ws, spec),
+    )
+    body = BeaconBlockBody(
+        randao_reveal=randao_reveal,
+        eth1_data=pre.eth1_data,
+        graffiti=graffiti,
+        attestations=list(attestations),
+        sync_aggregate=SyncAggregate(
+            sync_committee_signature=bls.G2_POINT_AT_INFINITY
+        ),
+        execution_payload=payload,
+    )
+    header = pre.latest_block_header
+    if bytes(header.state_root) == b"\x00" * 32:
+        header = header.copy(state_root=pre.hash_tree_root(spec))
+    block = BeaconBlock(
+        slot=slot,
+        proposer_index=proposer,
+        parent_root=header.hash_tree_root(spec),
+        state_root=b"\x00" * 32,
+        body=body,
+    )
+    post = state_transition(
+        state, SignedBeaconBlock(message=block), validate_result=False, spec=spec
+    )
+    block = block.copy(state_root=post.hash_tree_root(spec))
+    signed = sign_block(ws, block, secret_keys[proposer], spec)
+    return signed, post
+
+
+def make_attestation(
+    state: BeaconState,
+    slot: int,
+    committee_index: int,
+    head_root: bytes,
+    target: Checkpoint,
+    source: Checkpoint,
+    secret_keys: Sequence[bytes],
+    spec: ChainSpec | None = None,
+) -> Attestation:
+    """Aggregate attestation signed by the full committee of ``slot``."""
+    spec = spec or get_chain_spec()
+    committee = accessors.get_beacon_committee(state, slot, committee_index, spec)
+    data = AttestationData(
+        slot=slot,
+        index=committee_index,
+        beacon_block_root=head_root,
+        source=source,
+        target=target,
+    )
+    domain = accessors.get_domain(
+        state, constants.DOMAIN_BEACON_ATTESTER, target.epoch, spec
+    )
+    signing_root = misc.compute_signing_root(data, domain)
+    sigs = [bls.sign(secret_keys[i], signing_root) for i in committee]
+    return Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=bls.aggregate(sigs),
+    )

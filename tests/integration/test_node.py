@@ -1,0 +1,175 @@
+"""Two full beacon nodes over loopback: range sync, gossip, Beacon API.
+
+The end-to-end slice: node A holds a minted chain; node B joins via
+bootnode, range-syncs to A's head through real req/resp, then receives the
+next block via gossip.  Mirrors the reference's multi-node-on-one-machine
+strategy (ref: test/unit/libp2p_port_test.exs:30-50) at whole-node scope.
+"""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.fork_choice import get_head
+from lambda_ethereum_consensus_tpu.network.gossip import publish_ssz, topic_name
+from lambda_ethereum_consensus_tpu.node import BeaconNode, NodeConfig
+from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
+from lambda_ethereum_consensus_tpu.validator import build_signed_block
+
+N = 64
+SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
+CHAIN_LEN = 5
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=300))
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """Genesis (recent wall-clock genesis_time) + CHAIN_LEN built blocks."""
+    with use_chain_spec(minimal_spec()) as spec:
+        genesis_time = int(time.time()) - CHAIN_LEN * spec.SECONDS_PER_SLOT - 30
+        genesis = build_genesis_state(
+            [bls.sk_to_pk(sk) for sk in SKS], genesis_time=genesis_time, spec=spec
+        )
+        blocks = []
+        state = genesis
+        for slot in range(1, CHAIN_LEN + 1):
+            signed, state = build_signed_block(state, slot, SKS, spec=spec)
+            blocks.append(signed)
+        yield spec, genesis, blocks, state
+
+
+def test_two_nodes_sync_and_gossip(chain, tmp_path):
+    spec, genesis, blocks, tip_state = chain
+
+    async def main():
+        with use_chain_spec(spec):
+            node_a = BeaconNode(
+                NodeConfig(
+                    db_path=str(tmp_path / "a.wal"),
+                    genesis_state=genesis,
+                    enable_range_sync=False,
+                ),
+                spec,
+            )
+            await node_a.start()
+            # seed A's chain through the real pending-blocks/on_block path
+            for signed in blocks:
+                node_a.pending.add_block(signed)
+            applied = await node_a.pending.process_once()
+            assert applied == CHAIN_LEN
+            head_a = get_head(node_a.store, spec)
+            assert node_a.store.blocks[head_a].slot == CHAIN_LEN
+
+            node_b = BeaconNode(
+                NodeConfig(
+                    db_path=str(tmp_path / "b.wal"),
+                    genesis_state=genesis,
+                    bootnodes=[f"127.0.0.1:{node_a.port.listen_port}"],
+                    enable_range_sync=True,
+                ),
+                spec,
+            )
+            await node_b.start()
+
+            # wait until B catches up to A's head via range sync
+            for _ in range(200):
+                await node_b.pending.process_once()
+                if get_head(node_b.store, spec) == head_a:
+                    break
+                await asyncio.sleep(0.25)
+            assert get_head(node_b.store, spec) == head_a, "range sync failed"
+
+            # now extend the chain and gossip the new block from A
+            signed6, _ = build_signed_block(tip_state, CHAIN_LEN + 1, SKS, spec=spec)
+            node_a.pending.add_block(signed6)
+            await node_a.pending.process_once()
+            digest = node_a.chain.fork_digest()
+            await publish_ssz(
+                node_a.port, topic_name(digest, "beacon_block"), signed6, spec
+            )
+            root6 = signed6.message.hash_tree_root(spec)
+            for _ in range(200):
+                await node_b.pending.process_once()
+                if get_head(node_b.store, spec) == root6:
+                    break
+                await asyncio.sleep(0.25)
+            assert get_head(node_b.store, spec) == root6, "gossip block not applied"
+
+            # persistence carried the synced chain
+            assert node_b.blocks_db.highest_slot() == CHAIN_LEN + 1
+
+            # ---------------- Beacon API over real HTTP against node A
+            # (urllib blocks, so run it off-loop — the server lives on this loop)
+            base = f"http://127.0.0.1:{node_a.api.port}"
+            loop = asyncio.get_running_loop()
+
+            def get_sync(path):
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return json.loads(r.read())
+
+            async def get(path):
+                return await loop.run_in_executor(None, get_sync, path)
+
+            head_resp = await get("/eth/v1/beacon/blocks/head/root")
+            assert head_resp["data"]["root"] == "0x" + root6.hex()
+            by_slot = await get(f"/eth/v1/beacon/blocks/{CHAIN_LEN}/root")
+            assert by_slot["data"]["root"] == (
+                "0x" + blocks[-1].message.hash_tree_root(spec).hex()
+            )
+            block_v2 = await get(f"/eth/v2/beacon/blocks/0x{root6.hex()}")
+            assert block_v2["data"]["message"]["slot"] == str(CHAIN_LEN + 1)
+            state_root = await get("/eth/v1/beacon/states/head/root")
+            assert state_root["data"]["root"].startswith("0x")
+            metrics_body = await loop.run_in_executor(
+                None,
+                lambda: urllib.request.urlopen(base + "/metrics", timeout=10).read(),
+            )
+            assert b"peers_connection_count" in metrics_body
+
+            await node_b.stop()
+            await node_a.stop()
+
+    run(main())
+
+
+def test_node_restart_resumes_from_db(chain, tmp_path):
+    spec, genesis, blocks, _ = chain
+
+    async def main():
+        with use_chain_spec(spec):
+            node = BeaconNode(
+                NodeConfig(
+                    db_path=str(tmp_path / "resume.wal"),
+                    genesis_state=genesis,
+                    enable_range_sync=False,
+                ),
+                spec,
+            )
+            await node.start()
+            for signed in blocks[:3]:
+                node.pending.add_block(signed)
+            await node.pending.process_once()
+            head = get_head(node.store, spec)
+            await node.stop()
+
+            node2 = BeaconNode(
+                NodeConfig(
+                    db_path=str(tmp_path / "resume.wal"),
+                    enable_range_sync=False,
+                ),
+                spec,
+            )
+            await node2.start()
+            assert get_head(node2.store, spec) == head
+            assert node2.store.blocks[head].slot == 3
+            await node2.stop()
+
+    run(main())

@@ -1,0 +1,124 @@
+"""Engine API client (JWT + JSON-RPC), telemetry rendering, checkpoint sync."""
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.api.engine import (
+    EngineApiClient,
+    EngineApiError,
+    OptimisticEngine,
+    execution_payload_to_json,
+    generate_token,
+)
+from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.node.telemetry import Metrics
+from lambda_ethereum_consensus_tpu.types.beacon import ExecutionPayload
+
+SECRET = "aa" * 32
+
+
+def test_jwt_structure_and_signature():
+    token = generate_token(SECRET, now=1_700_000_000)
+    header_b64, claims_b64, sig_b64 = token.split(".")
+
+    def unb64(s):
+        return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+    assert json.loads(unb64(header_b64)) == {"alg": "HS256", "typ": "JWT"}
+    assert json.loads(unb64(claims_b64)) == {"iat": 1_700_000_000}
+    expected = hmac.new(
+        bytes.fromhex(SECRET),
+        f"{header_b64}.{claims_b64}".encode(),
+        hashlib.sha256,
+    ).digest()
+    assert unb64(sig_b64) == expected
+
+
+class _FakeEngine(BaseHTTPRequestHandler):
+    requests: list = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        type(self).requests.append((dict(self.headers), body))
+        if body["method"] == "engine_exchangeCapabilities":
+            result = {"result": ["engine_newPayloadV2"], "id": body["id"]}
+        elif body["method"] == "engine_newPayloadV2":
+            result = {"result": {"status": "VALID"}, "id": body["id"]}
+        else:
+            result = {"error": {"code": -32601, "message": "unknown"}, "id": body["id"]}
+        out = json.dumps(result).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def fake_engine():
+    server = HTTPServer(("127.0.0.1", 0), _FakeEngine)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    _FakeEngine.requests = []
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_rpc_call_with_jwt(fake_engine):
+    client = EngineApiClient(endpoint=fake_engine, jwt_secret_hex=SECRET)
+    caps = client.exchange_capabilities(["engine_newPayloadV2"])
+    assert caps == ["engine_newPayloadV2"]
+    headers, body = _FakeEngine.requests[0]
+    assert headers.get("Authorization", "").startswith("Bearer ")
+    assert body["jsonrpc"] == "2.0"
+
+
+def test_engine_error_raises(fake_engine):
+    client = EngineApiClient(endpoint=fake_engine)
+    with pytest.raises(EngineApiError, match="engine error"):
+        client.rpc_call("engine_unknown", [])
+
+
+def test_verify_and_notify(fake_engine):
+    with use_chain_spec(minimal_spec()) as spec:
+        payload = ExecutionPayload(block_number=7)
+        client = EngineApiClient(endpoint=fake_engine, jwt_secret_hex=SECRET)
+        assert client.verify_and_notify(payload) is True
+        js = execution_payload_to_json(payload)
+        assert js["blockNumber"] == "0x7"
+        assert OptimisticEngine().verify_and_notify(payload) is True
+
+
+def test_engine_unreachable():
+    client = EngineApiClient(endpoint="http://127.0.0.1:1", timeout=0.5)
+    with pytest.raises(EngineApiError):
+        client.exchange_capabilities([])
+
+
+def test_metrics_render():
+    m = Metrics()
+    m.inc("network_request_count", result="ok", type="range_sync")
+    m.inc("network_request_count", result="ok", type="range_sync")
+    m.set_gauge("sync_store_slot", 42)
+    text = m.render_prometheus()
+    assert 'network_request_count{result="ok",type="range_sync"} 2' in text
+    assert "sync_store_slot 42" in text
+    assert m.get("sync_store_slot") == 42
+
+
+def test_checkpoint_sync_error_on_bad_url():
+    from lambda_ethereum_consensus_tpu.api.checkpoint_sync import (
+        CheckpointSyncError,
+        fetch_finalized_state,
+    )
+
+    with pytest.raises(CheckpointSyncError):
+        fetch_finalized_state("http://127.0.0.1:1", timeout=0.5)
