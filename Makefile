@@ -1,0 +1,33 @@
+# Build/test orchestration (parity with the reference's root Makefile:
+# native build, spec-vector download, test targets — ref: Makefile:45-166).
+
+SPECTEST_VERSION := v1.3.0
+SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
+VENDOR := vendor/consensus-spec-tests
+
+.PHONY: all native test spec-test spec-vectors bench clean
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+test: native
+	python -m pytest tests/ -q -m "not spectest"
+
+# Conformance vectors (ref: Makefile:60-100). Requires network egress.
+spec-vectors:
+	mkdir -p $(VENDOR)
+	for cfg in general minimal mainnet; do \
+	  curl -L -o $(VENDOR)/$$cfg.tar.gz $(SPECTEST_URL)/$$cfg.tar.gz && \
+	  tar -xzf $(VENDOR)/$$cfg.tar.gz -C $(VENDOR); \
+	done
+
+spec-test:
+	python -m pytest tests/spec -q -m spectest
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C native clean

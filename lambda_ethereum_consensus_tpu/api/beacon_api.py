@@ -96,6 +96,9 @@ class BeaconApiServer:
             (r"/eth/v1/beacon/states/([^/]+)/root", self._state_root),
             (r"/eth/v1/beacon/blocks/([^/]+)/root", self._block_root),
             (r"/eth/v2/beacon/blocks/([^/]+)", self._block_v2),
+            # SSZ state download — what checkpoint sync fetches
+            # (ref: checkpoint_sync.ex:14 GET /eth/v2/debug/beacon/states/...)
+            (r"/eth/v2/debug/beacon/states/([^/]+)", self._debug_state),
             (r"/eth/v1/node/health", self._health),
             (r"/eth/v1/node/identity", self._identity),
             (r"/metrics", self._metrics),
@@ -160,8 +163,12 @@ class BeaconApiServer:
                     block.slot // self.spec.SLOTS_PER_EPOCH
                 ),
                 "execution_optimistic": False,
-                "finalized": block.slot
-                <= self.store.finalized_checkpoint.epoch * self.spec.SLOTS_PER_EPOCH,
+                # finalized = ancestor of the finalized checkpoint, not just
+                # an old slot (fork blocks below the boundary are NOT final)
+                "finalized": self.store.get_ancestor(
+                    bytes(self.store.finalized_checkpoint.root), block.slot
+                )
+                == root,
                 "data": {
                     "message": {
                         "slot": str(block.slot),
@@ -173,6 +180,11 @@ class BeaconApiServer:
                 },
             }
         )
+
+    def _debug_state(self, state_id: str) -> tuple[str, str, bytes]:
+        root = self._resolve_block_root(state_id)
+        state = self.store.block_states[root]
+        return "200 OK", "application/octet-stream", state.encode(self.spec)
 
     def _health(self) -> tuple[str, str, bytes]:
         return "200 OK", "application/json", b"{}"

@@ -92,12 +92,20 @@ def get_forkchoice_store(
     anchor_state: BeaconState,
     anchor_block: BeaconBlock,
     spec: ChainSpec | None = None,
+    anchor_root: bytes | None = None,
 ) -> Store:
-    """Fresh store from an anchor (ref: fork_choice/helpers.ex:12-50)."""
+    """Fresh store from an anchor (ref: fork_choice/helpers.ex:12-50).
+
+    ``anchor_root`` overrides the anchor's identity for checkpoint-sync
+    anchors where only the block *header* is known: the header root equals
+    the real block root, while a reconstructed block with an empty body
+    would hash differently and orphan every descendant.
+    """
     spec = spec or get_chain_spec()
     if bytes(anchor_block.state_root) != anchor_state.hash_tree_root(spec):
         raise ForkChoiceError("anchor block state root does not match anchor state")
-    anchor_root = anchor_block.hash_tree_root(spec)
+    if anchor_root is None:
+        anchor_root = anchor_block.hash_tree_root(spec)
     anchor_epoch = accessors.get_current_epoch(anchor_state, spec)
     justified = Checkpoint(epoch=anchor_epoch, root=anchor_root)
     finalized = Checkpoint(epoch=anchor_epoch, root=anchor_root)

@@ -72,6 +72,10 @@ class TopicSubscription:
 
     async def stop(self) -> None:
         await self.port.unsubscribe(self.topic)
+        self.cancel()
+
+    def cancel(self) -> None:
+        """Kill the drain loop without touching the port (dead-sidecar path)."""
         if self._task is not None:
             self._task.cancel()
 

@@ -77,15 +77,17 @@ class BeaconNode:
         self.blocks_db = BlockStore(self.kv)
         self.states_db = StateStore(self.kv)
 
-        anchor_state, anchor_block = await self._select_anchor()
-        self.store = get_forkchoice_store(anchor_state, anchor_block, spec)
+        anchor_state, anchor_block, anchor_root = await self._select_anchor()
+        self.store = get_forkchoice_store(
+            anchor_state, anchor_block, spec, anchor_root=anchor_root
+        )
         # catch the store up to wall clock immediately (ref: on_tick_now at
         # fork_choice/store.ex:65-82) so blocks are acceptable before the
         # first timer tick
         on_tick(self.store, int(time.time()), spec)
-        anchor_root = anchor_block.hash_tree_root(spec)
+        anchor_root = anchor_root or anchor_block.hash_tree_root(spec)
         self.blocks_db.store_block(
-            SignedBeaconBlock(message=anchor_block), spec
+            SignedBeaconBlock(message=anchor_block), spec, root=anchor_root
         )
         self.states_db.store_state(anchor_root, anchor_state, spec)
 
@@ -116,9 +118,14 @@ class BeaconNode:
             get_head(self.store, spec).hex()[:16],
         )
 
-    async def _select_anchor(self) -> tuple[BeaconState, BeaconBlock]:
+    async def _select_anchor(self) -> tuple[BeaconState, BeaconBlock, bytes | None]:
         """DB resume | checkpoint sync | provided genesis
-        (ref: fork_choice/supervisor.ex:16-44)."""
+        (ref: fork_choice/supervisor.ex:16-44).
+
+        Returns ``(state, block, root_override)`` — the override is set when
+        only the block *header* is known (checkpoint sync), so the store is
+        keyed by the real block root rather than a reconstructed block's.
+        """
         spec = self.spec
         latest = self.states_db.get_latest_state(spec)
         if latest is not None:
@@ -126,7 +133,9 @@ class BeaconNode:
             stored = self.blocks_db.get_block(root, spec)
             if stored is not None:
                 log.info("resuming from stored state at slot %d", state.slot)
-                return state, stored.message
+                # the stored key is authoritative (a checkpoint anchor's
+                # reconstructed block hashes differently from its real root)
+                return state, stored.message, root
         if self.config.checkpoint_sync_url:
             from ..api.checkpoint_sync import sync_from_checkpoint
 
@@ -141,7 +150,9 @@ class BeaconNode:
                 state_root=bytes(header.state_root),
                 body=BeaconBlockBody(),
             )
-            return state, anchor
+            # the header root IS the finalized block's root; descendants
+            # reference it as parent_root
+            return state, anchor, header.hash_tree_root(spec)
         if self.config.genesis_state is not None:
             state = self.config.genesis_state
             anchor = self.config.anchor_block or BeaconBlock(
@@ -151,12 +162,16 @@ class BeaconNode:
                 state_root=state.hash_tree_root(spec),
                 body=BeaconBlockBody(),
             )
-            return state, anchor
+            return state, anchor, None
         raise RuntimeError(
             "no anchor available: provide genesis_state or checkpoint_sync_url"
         )
 
     async def _start_network(self) -> None:
+        # on restart: drop pipelines bound to the dead sidecar first
+        for sub in self._subs:
+            sub.cancel()
+        self._subs.clear()
         digest = self.chain.fork_digest()
         self.port = await Port.start(
             listen_addr=self.config.listen_addr,
@@ -167,6 +182,8 @@ class BeaconNode:
         self.port.on_peer_gone = self._on_peer_gone
         self.port.on_exit = self._on_sidecar_exit
         self.downloader = BlockDownloader(self.port, self.peerbook, self.spec)
+        if self.pending is not None:  # restart: rebind to the live port
+            self.pending.downloader = self.downloader
         self.reqresp = ReqRespServer(self.port, self.chain, self.spec)
         await self.reqresp.register()
 

@@ -33,40 +33,49 @@ class SyncBlocks:
         """Sync from the finalized checkpoint to the wall-clock head.
 
         Returns the number of blocks fetched.  Mirrors SyncBlocks.run/1 +
-        perform_sync/1 with recursive retry of failed chunks.
+        perform_sync/1: failed chunks are retried; a chunk is *done* once a
+        download for it succeeds (slot-presence can't signal completion —
+        skipped slots are routine and would re-download forever).
         """
         start = misc.compute_start_slot_at_epoch(
             self.store.finalized_checkpoint.epoch, self.spec
         )
         fetched = 0
+        done: set[int] = set()
         for _ in range(MAX_ROUNDS):
             head = self.store.current_slot(self.spec)
-            chunks = [
-                (s, min(CHUNK_SIZE, head + 1 - s))
-                for s in range(start, head + 1, CHUNK_SIZE)
-            ]
-            missing = [c for c in chunks if self._chunk_missing(c)]
-            if not missing:
+            known_slots = {b.slot for b in self.store.blocks.values()}
+            todo = []
+            for s in range(start, head + 1, CHUNK_SIZE):
+                count = min(CHUNK_SIZE, head + 1 - s)
+                if s in done:
+                    continue
+                if all(slot in known_slots for slot in range(s, s + count)):
+                    done.add(s)  # everything already present locally
+                    continue
+                todo.append((s, count))
+            if not todo:
                 return fetched
             sem = asyncio.Semaphore(MAX_CONCURRENT)
 
             async def fetch(chunk):
                 async with sem:
                     try:
-                        return await asyncio.wait_for(
+                        return chunk, await asyncio.wait_for(
                             self.downloader.request_blocks_by_range(*chunk),
                             CHUNK_TIMEOUT,
                         )
                     except Exception as e:
                         log.debug("chunk %s failed: %s", chunk, e)
-                        return None
+                        return chunk, None
 
-            results = await asyncio.gather(*(fetch(c) for c in missing))
+            results = await asyncio.gather(*(fetch(c) for c in todo))
             progress = False
-            for blocks in results:
+            for chunk, blocks in results:
                 if blocks is None:
                     continue
                 progress = True
+                done.add(chunk[0])
                 for block in blocks:
                     self.pending.add_block(block)
                     fetched += 1
@@ -74,10 +83,3 @@ class SyncBlocks:
             if not progress:
                 await asyncio.sleep(1.0)  # ref: 1s sleep before chunk retry
         return fetched
-
-    def _chunk_missing(self, chunk) -> bool:
-        start, count = chunk
-        known_slots = {b.slot for b in self.store.blocks.values()}
-        return any(
-            s not in known_slots for s in range(start, start + count)
-        )

@@ -1,0 +1,20 @@
+"""Conformance-test harness for the official consensus-spec-tests vectors.
+
+The reference treats this as the heart of its test strategy (ref: lib/spec/
+runner_behaviour.ex, lib/spec/runners/*, SURVEY.md §4): per-format runners,
+skip-list ratcheting, structural diffs, config matrix.  This package mirrors
+that: :mod:`.loader` reads the vector file formats (``.ssz_snappy`` = raw
+snappy blocks + SSZ, ``.yaml``), :mod:`.runners` implements one runner per
+upstream format, and :func:`discover_cases` walks the official directory
+layout ``tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>``.
+
+Vectors are downloaded with ``make spec-vectors`` (ref: Makefile:60-100) into
+``vendor/consensus-spec-tests``; the pytest bridge in ``tests/spec/`` skips
+gracefully when they are absent and always exercises the harness itself on
+self-minted cases.
+"""
+
+from .loader import load_ssz_snappy, load_yaml
+from .runners import RUNNERS, discover_cases, run_case
+
+__all__ = ["RUNNERS", "discover_cases", "load_ssz_snappy", "load_yaml", "run_case"]

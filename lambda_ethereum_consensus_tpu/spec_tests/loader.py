@@ -1,0 +1,41 @@
+"""Vector file loaders (ref: lib/spec/utils.ex).
+
+``.ssz_snappy`` files are raw-snappy-compressed SSZ; ``.yaml`` files use the
+upstream scalar conventions (0x-hex strings for roots/signatures).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from ..compression.snappy import decompress
+from ..config import ChainSpec
+
+
+def load_ssz_snappy(path: str, ssz_type, spec: ChainSpec):
+    with open(path, "rb") as f:
+        data = decompress(f.read())
+    return ssz_type.deserialize(data, spec) if hasattr(ssz_type, "deserialize") else ssz_type.decode(data, spec)
+
+
+def load_raw_ssz(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return decompress(f.read())
+
+
+def load_yaml(path: str) -> Any:
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def maybe(path: str) -> str | None:
+    return path if os.path.exists(path) else None
+
+
+def hex_bytes(value: str | bytes) -> bytes:
+    if isinstance(value, bytes):
+        return value
+    return bytes.fromhex(value.removeprefix("0x"))

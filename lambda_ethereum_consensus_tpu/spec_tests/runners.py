@@ -1,0 +1,426 @@
+"""Per-format conformance runners (ref: lib/spec/runners/*.ex).
+
+Each runner implements ``run(case_dir, spec)`` raising ``AssertionError`` with
+a structural diff on mismatch, and ``skip(handler)`` for the skip-list
+ratchet (ref: operations.ex:43-54 — coverage grows by deleting entries).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import types as T
+from ..config import ChainSpec
+from ..crypto import bls
+from ..state_transition import accessors, misc, process_slots
+from ..state_transition.core import state_transition
+from ..state_transition.errors import SpecError
+from ..state_transition.mutable import BeaconStateMut
+from ..state_transition import epoch as epoch_processing
+from ..state_transition import operations as ops
+from ..types.beacon import (
+    Attestation,
+    AttesterSlashing,
+    BeaconBlock,
+    BeaconBlockBody,
+    BeaconState,
+    Deposit,
+    ExecutionPayload,
+    ProposerSlashing,
+    SignedBeaconBlock,
+    SignedBLSToExecutionChange,
+    SignedVoluntaryExit,
+    SyncAggregate,
+)
+from ..utils.diff import UNCHANGED, diff, format_diff
+from .loader import hex_bytes, load_raw_ssz, load_ssz_snappy, load_yaml, maybe
+
+
+def assert_states_equal(got: BeaconState, want: BeaconState, spec: ChainSpec) -> None:
+    d = diff(got, want)
+    assert d == UNCHANGED, "post-state mismatch:\n" + format_diff(d)
+
+
+# -------------------------------------------------------------- ssz_static
+
+class SszStaticRunner:
+    """Decode -> re-encode -> hash_tree_root round-trip
+    (ref: lib/spec/runners/ssz_static.ex:30-59)."""
+
+    name = "ssz_static"
+    skip_handlers: set[str] = set()
+
+    @staticmethod
+    def resolve_type(handler: str):
+        from ..types import beacon, p2p, validator
+
+        for mod in (beacon, p2p, validator):
+            if hasattr(mod, handler):
+                return getattr(mod, handler)
+        return None
+
+    def skip(self, handler: str) -> bool:
+        return self.resolve_type(handler) is None or handler in self.skip_handlers
+
+    def run(self, case_dir: str, spec: ChainSpec, handler: str) -> None:
+        ssz_type = self.resolve_type(handler)
+        assert ssz_type is not None, f"unknown container {handler}"
+        raw = load_raw_ssz(os.path.join(case_dir, "serialized.ssz_snappy"))
+        value = ssz_type.decode(raw, spec)
+        assert ssz_type.serialize(value, spec) == raw, "re-encode mismatch"
+        roots = load_yaml(os.path.join(case_dir, "roots.yaml"))
+        got_root = value.hash_tree_root(spec)
+        assert got_root == hex_bytes(roots["root"]), (
+            f"root mismatch: got 0x{got_root.hex()}, want {roots['root']}"
+        )
+
+
+# --------------------------------------------------------------------- bls
+
+class BlsRunner:
+    """Vector formats of the upstream bls runner (ref: lib/spec/runners/bls.ex)."""
+
+    name = "bls"
+
+    def skip(self, handler: str) -> bool:
+        return handler not in {
+            "sign", "verify", "aggregate", "aggregate_verify",
+            "fast_aggregate_verify", "eth_fast_aggregate_verify",
+            "eth_aggregate_pubkeys",
+        }
+
+    def run(self, case_dir: str, spec: ChainSpec, handler: str) -> None:
+        data = load_yaml(os.path.join(case_dir, "data.yaml"))
+        inp, out = data["input"], data["output"]
+        if handler == "sign":
+            try:
+                got = bls.sign(hex_bytes(inp["privkey"]), hex_bytes(inp["message"]))
+            except bls.BlsError:
+                got = None
+            want = None if out is None else hex_bytes(out)
+            assert got == want
+        elif handler == "verify":
+            got = bls.verify(
+                hex_bytes(inp["pubkey"]), hex_bytes(inp["message"]), hex_bytes(inp["signature"])
+            )
+            assert got == out
+        elif handler == "aggregate":
+            try:
+                got = bls.aggregate([hex_bytes(s) for s in inp])
+            except bls.BlsError:
+                got = None
+            want = None if out is None else hex_bytes(out)
+            assert got == want
+        elif handler == "aggregate_verify":
+            got = bls.aggregate_verify(
+                [hex_bytes(p) for p in inp["pubkeys"]],
+                [hex_bytes(m) for m in inp["messages"]],
+                hex_bytes(inp["signature"]),
+            )
+            assert got == out
+        elif handler in ("fast_aggregate_verify", "eth_fast_aggregate_verify"):
+            fn = getattr(bls, handler)
+            got = fn(
+                [hex_bytes(p) for p in inp["pubkeys"]],
+                hex_bytes(inp["message"]),
+                hex_bytes(inp["signature"]),
+            )
+            assert got == out
+        elif handler == "eth_aggregate_pubkeys":
+            try:
+                got = bls.eth_aggregate_pubkeys([hex_bytes(p) for p in inp])
+            except bls.BlsError:
+                got = None
+            want = None if out is None else hex_bytes(out)
+            assert got == want
+
+
+# -------------------------------------------------------------- operations
+
+OPERATION_TYPES = {
+    "attestation": ("attestation", Attestation, ops.process_attestation),
+    "attester_slashing": ("attester_slashing", AttesterSlashing, ops.process_attester_slashing),
+    "block_header": ("block", BeaconBlock, ops.process_block_header),
+    "bls_to_execution_change": (
+        "address_change", SignedBLSToExecutionChange, ops.process_bls_to_execution_change
+    ),
+    "deposit": ("deposit", Deposit, ops.process_deposit),
+    "proposer_slashing": ("proposer_slashing", ProposerSlashing, ops.process_proposer_slashing),
+    "sync_aggregate": ("sync_aggregate", SyncAggregate, ops.process_sync_aggregate),
+    "voluntary_exit": ("voluntary_exit", SignedVoluntaryExit, ops.process_voluntary_exit),
+    "withdrawals": ("execution_payload", ExecutionPayload, ops.process_withdrawals),
+    "execution_payload": ("body", BeaconBlockBody, None),  # special-cased below
+}
+
+
+class OperationsRunner:
+    """pre/operation/post diff (ref: lib/spec/runners/operations.ex:62-107)."""
+
+    name = "operations"
+    skip_handlers: set[str] = set()
+
+    def skip(self, handler: str) -> bool:
+        return handler not in OPERATION_TYPES or handler in self.skip_handlers
+
+    def run(self, case_dir: str, spec: ChainSpec, handler: str) -> None:
+        file_name, op_type, process = OPERATION_TYPES[handler]
+        pre = load_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy"), BeaconState, spec)
+        operation = load_ssz_snappy(
+            os.path.join(case_dir, f"{file_name}.ssz_snappy"), op_type, spec
+        )
+        post_path = maybe(os.path.join(case_dir, "post.ssz_snappy"))
+        ws = BeaconStateMut(pre)
+        try:
+            if handler == "execution_payload":
+                meta = load_yaml(os.path.join(case_dir, "execution.yaml")) or {}
+
+                class _Engine:
+                    def verify_and_notify(self, payload, _ok=meta.get("execution_valid", True)):
+                        return _ok
+
+                ops.process_execution_payload(ws, operation, _Engine(), spec)
+            else:
+                process(ws, operation, spec)
+        except SpecError:
+            assert post_path is None, "valid operation rejected"
+            return
+        assert post_path is not None, "invalid operation accepted"
+        want = load_ssz_snappy(post_path, BeaconState, spec)
+        assert_states_equal(ws.freeze(), want, spec)
+
+
+# --------------------------------------------------------- epoch processing
+
+EPOCH_HANDLERS = {
+    "justification_and_finalization": epoch_processing.process_justification_and_finalization,
+    "inactivity_updates": epoch_processing.process_inactivity_updates,
+    "rewards_and_penalties": epoch_processing.process_rewards_and_penalties,
+    "registry_updates": epoch_processing.process_registry_updates,
+    "slashings": epoch_processing.process_slashings,
+    "eth1_data_reset": epoch_processing.process_eth1_data_reset,
+    "effective_balance_updates": epoch_processing.process_effective_balance_updates,
+    "slashings_reset": epoch_processing.process_slashings_reset,
+    "randao_mixes_reset": epoch_processing.process_randao_mixes_reset,
+    "historical_summaries_update": epoch_processing.process_historical_summaries_update,
+    "participation_flag_updates": epoch_processing.process_participation_flag_updates,
+    "sync_committee_updates": epoch_processing.process_sync_committee_updates,
+}
+
+
+class EpochProcessingRunner:
+    """pre/post per epoch pass (ref: lib/spec/runners/epoch_processing.ex:38-68)."""
+
+    name = "epoch_processing"
+    skip_handlers: set[str] = set()
+
+    def skip(self, handler: str) -> bool:
+        return handler not in EPOCH_HANDLERS or handler in self.skip_handlers
+
+    def run(self, case_dir: str, spec: ChainSpec, handler: str) -> None:
+        pre = load_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy"), BeaconState, spec)
+        post_path = maybe(os.path.join(case_dir, "post.ssz_snappy"))
+        ws = BeaconStateMut(pre)
+        try:
+            EPOCH_HANDLERS[handler](ws, spec)
+        except SpecError:
+            assert post_path is None, "valid epoch transition rejected"
+            return
+        assert post_path is not None, "invalid epoch transition accepted"
+        want = load_ssz_snappy(post_path, BeaconState, spec)
+        assert_states_equal(ws.freeze(), want, spec)
+
+
+# ---------------------------------------------------------------- shuffling
+
+class ShufflingRunner:
+    """mapping.yaml: full permutation check (ref: lib/spec/runners/shuffling.ex)."""
+
+    name = "shuffling"
+
+    def skip(self, handler: str) -> bool:
+        return False
+
+    def run(self, case_dir: str, spec: ChainSpec, handler: str) -> None:
+        data = load_yaml(os.path.join(case_dir, "mapping.yaml"))
+        seed = hex_bytes(data["seed"])
+        count = int(data["count"])
+        perm = misc.compute_shuffled_indices(count, seed, spec.SHUFFLE_ROUND_COUNT)
+        assert list(perm) == [int(x) for x in data["mapping"]]
+
+
+# ------------------------------------------------------------------- sanity
+
+class SanityRunner:
+    """slots/blocks formats (upstream `sanity` runner)."""
+
+    name = "sanity"
+
+    def skip(self, handler: str) -> bool:
+        return handler not in ("slots", "blocks")
+
+    def run(self, case_dir: str, spec: ChainSpec, handler: str) -> None:
+        pre = load_ssz_snappy(os.path.join(case_dir, "pre.ssz_snappy"), BeaconState, spec)
+        post_path = maybe(os.path.join(case_dir, "post.ssz_snappy"))
+        if handler == "slots":
+            n = load_yaml(os.path.join(case_dir, "slots.yaml"))
+            got = process_slots(pre, pre.slot + int(n), spec)
+            want = load_ssz_snappy(post_path, BeaconState, spec)
+            assert_states_equal(got, want, spec)
+            return
+        meta = load_yaml(os.path.join(case_dir, "meta.yaml")) or {}
+        state = pre
+        try:
+            for i in range(int(meta.get("blocks_count", 0))):
+                signed = load_ssz_snappy(
+                    os.path.join(case_dir, f"blocks_{i}.ssz_snappy"), SignedBeaconBlock, spec
+                )
+                state = state_transition(state, signed, validate_result=True, spec=spec)
+        except SpecError:
+            assert post_path is None, "valid block rejected"
+            return
+        if post_path is None:
+            raise AssertionError("invalid block accepted")
+        want = load_ssz_snappy(post_path, BeaconState, spec)
+        assert_states_equal(state, want, spec)
+
+
+# -------------------------------------------------------------- fork choice
+
+class ForkChoiceRunner:
+    """Step interpreter: tick/block/attestation/attester_slashing + checks
+    (ref: lib/spec/runners/fork_choice.ex:63-160)."""
+
+    name = "fork_choice"
+
+    def skip(self, handler: str) -> bool:
+        return False
+
+    def run(self, case_dir: str, spec: ChainSpec, handler: str) -> None:
+        from ..fork_choice import (
+            get_forkchoice_store,
+            get_head,
+            on_attestation,
+            on_attester_slashing,
+            on_block,
+            on_tick,
+        )
+
+        anchor_state = load_ssz_snappy(
+            os.path.join(case_dir, "anchor_state.ssz_snappy"), BeaconState, spec
+        )
+        anchor_block = load_ssz_snappy(
+            os.path.join(case_dir, "anchor_block.ssz_snappy"), BeaconBlock, spec
+        )
+        store = get_forkchoice_store(anchor_state, anchor_block, spec)
+        steps = load_yaml(os.path.join(case_dir, "steps.yaml"))
+        for step in steps:
+            if "tick" in step:
+                on_tick(store, int(step["tick"]), spec)
+            elif "block" in step:
+                signed = load_ssz_snappy(
+                    os.path.join(case_dir, f"{step['block']}.ssz_snappy"),
+                    SignedBeaconBlock,
+                    spec,
+                )
+                valid = step.get("valid", True)
+                try:
+                    on_block(store, signed, spec=spec)
+                    assert valid, "invalid block accepted"
+                except SpecError:
+                    assert not valid, "valid block rejected"
+            elif "attestation" in step:
+                att = load_ssz_snappy(
+                    os.path.join(case_dir, f"{step['attestation']}.ssz_snappy"),
+                    Attestation,
+                    spec,
+                )
+                valid = step.get("valid", True)
+                try:
+                    on_attestation(store, att, is_from_block=False, spec=spec)
+                    assert valid, "invalid attestation accepted"
+                except SpecError:
+                    assert not valid, "valid attestation rejected"
+            elif "attester_slashing" in step:
+                slashing = load_ssz_snappy(
+                    os.path.join(case_dir, f"{step['attester_slashing']}.ssz_snappy"),
+                    AttesterSlashing,
+                    spec,
+                )
+                try:
+                    on_attester_slashing(store, slashing, spec)
+                except SpecError:
+                    assert not step.get("valid", True)
+            elif "checks" in step:
+                self._run_checks(store, step["checks"], spec)
+
+    @staticmethod
+    def _run_checks(store, checks: dict, spec: ChainSpec) -> None:
+        from ..fork_choice import get_head
+
+        if "time" in checks:
+            assert store.time == int(checks["time"]), "time mismatch"
+        if "head" in checks:
+            head = get_head(store, spec)
+            want = checks["head"]
+            assert head == hex_bytes(want["root"]), (
+                f"head mismatch: got 0x{head.hex()}, want {want['root']}"
+            )
+            assert store.blocks[head].slot == int(want["slot"])
+        for name in ("justified_checkpoint", "finalized_checkpoint"):
+            if name in checks:
+                got = getattr(store, name)
+                want = checks[name]
+                assert got.epoch == int(want["epoch"]), f"{name} epoch mismatch"
+                assert bytes(got.root) == hex_bytes(want["root"]), f"{name} root mismatch"
+        if "proposer_boost_root" in checks:
+            assert store.proposer_boost_root == hex_bytes(checks["proposer_boost_root"])
+
+
+RUNNERS = {
+    r.name: r
+    for r in (
+        SszStaticRunner(),
+        BlsRunner(),
+        OperationsRunner(),
+        EpochProcessingRunner(),
+        ShufflingRunner(),
+        SanityRunner(),
+        ForkChoiceRunner(),
+    )
+}
+
+
+def discover_cases(root: str, configs=("minimal", "mainnet", "general")):
+    """Walk ``tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>``;
+    yields ``(config, fork, runner, handler, case_dir)`` for known runners."""
+    base = os.path.join(root, "tests")
+    if not os.path.isdir(base):
+        return
+    for config in sorted(os.listdir(base)):
+        if config not in configs:
+            continue
+        config_dir = os.path.join(base, config)
+        for fork in sorted(os.listdir(config_dir)):
+            fork_dir = os.path.join(config_dir, fork)
+            for runner in sorted(os.listdir(fork_dir)):
+                if runner not in RUNNERS:
+                    continue
+                runner_dir = os.path.join(fork_dir, runner)
+                for handler in sorted(os.listdir(runner_dir)):
+                    handler_dir = os.path.join(runner_dir, handler)
+                    for suite in sorted(os.listdir(handler_dir)):
+                        suite_dir = os.path.join(handler_dir, suite)
+                        for case in sorted(os.listdir(suite_dir)):
+                            case_dir = os.path.join(suite_dir, case)
+                            if os.path.isdir(case_dir):
+                                yield (config, fork, runner, handler, case_dir)
+
+
+def run_case(config: str, runner: str, handler: str, case_dir: str, spec=None) -> None:
+    """Entry point used by the pytest bridge; resolves the spec per config."""
+    from ..config import ChainSpec, mainnet_spec, minimal_spec, use_chain_spec
+
+    if spec is None:
+        spec = minimal_spec() if config == "minimal" else mainnet_spec()
+    with use_chain_spec(spec):
+        RUNNERS[runner].run(case_dir, spec, handler)

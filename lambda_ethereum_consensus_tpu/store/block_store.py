@@ -26,10 +26,16 @@ class BlockStore:
         self._kv = kv
 
     def store_block(
-        self, signed_block: SignedBeaconBlock, spec: ChainSpec | None = None
+        self,
+        signed_block: SignedBeaconBlock,
+        spec: ChainSpec | None = None,
+        root: bytes | None = None,
     ) -> bytes:
+        """Store under ``root`` (defaults to the block's hash tree root —
+        checkpoint anchors override it with the real header root)."""
         spec = spec or get_chain_spec()
-        root = signed_block.message.hash_tree_root(spec)
+        if root is None:
+            root = signed_block.message.hash_tree_root(spec)
         self._kv.put(_BLOCK + root, signed_block.encode(spec))
         self._kv.put(_slot_key(signed_block.message.slot), root)
         return root

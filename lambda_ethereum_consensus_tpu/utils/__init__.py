@@ -1,0 +1,5 @@
+"""Shared utilities: structural diff (ref: lib/utils/diff.ex)."""
+
+from .diff import diff, format_diff
+
+__all__ = ["diff", "format_diff"]

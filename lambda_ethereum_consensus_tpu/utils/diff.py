@@ -1,0 +1,82 @@
+"""Recursive structural diff for readable assertion failures
+(ref: lib/utils/diff.ex:32-47 — ``:unchanged`` or a changed-map).
+
+Spec-test runners compare post-states with this instead of ``==`` so a
+failing case reports *which fields* diverged, not two multi-KB dumps.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+UNCHANGED = "unchanged"
+
+
+def diff(left: Any, right: Any) -> Any:
+    """``UNCHANGED`` or a nested description of what differs."""
+    if type(left).__name__ != type(right).__name__:
+        return {"type_changed": (type(left).__name__, type(right).__name__)}
+    schema = getattr(type(left), "__ssz_schema__", None)
+    if schema is not None:  # SSZ containers: field-by-field
+        fields = {}
+        for name in schema:
+            d = diff(getattr(left, name), getattr(right, name))
+            if d != UNCHANGED:
+                fields[name] = d
+        return UNCHANGED if not fields else {"fields": fields}
+    if isinstance(left, (list, tuple)):
+        if len(left) != len(right):
+            return {"length_changed": (len(left), len(right))}
+        items = {}
+        for i, (a, b) in enumerate(zip(left, right)):
+            d = diff(a, b)
+            if d != UNCHANGED:
+                items[i] = d
+        return UNCHANGED if not items else {"items": items}
+    if isinstance(left, dict):
+        keys = {}
+        for k in set(left) | set(right):
+            if k not in left:
+                keys[k] = {"added_right": right[k]}
+            elif k not in right:
+                keys[k] = {"added_left": left[k]}
+            else:
+                d = diff(left[k], right[k])
+                if d != UNCHANGED:
+                    keys[k] = d
+        return UNCHANGED if not keys else {"keys": keys}
+    if left != right:
+        return {"changed": (_show(left), _show(right))}
+    return UNCHANGED
+
+
+def _show(v: Any) -> str:
+    if isinstance(v, (bytes, bytearray)):
+        return "0x" + bytes(v).hex()
+    return repr(v)
+
+
+def format_diff(d: Any, indent: int = 0) -> str:
+    pad = "  " * indent
+    if d == UNCHANGED:
+        return pad + "unchanged"
+    lines = []
+    if "fields" in d:
+        for name, sub in d["fields"].items():
+            lines.append(f"{pad}.{name}:")
+            lines.append(format_diff(sub, indent + 1))
+    elif "items" in d:
+        for i, sub in d["items"].items():
+            lines.append(f"{pad}[{i}]:")
+            lines.append(format_diff(sub, indent + 1))
+    elif "keys" in d:
+        for k, sub in d["keys"].items():
+            lines.append(f"{pad}{k!r}:")
+            lines.append(format_diff(sub, indent + 1))
+    elif "changed" in d:
+        a, b = d["changed"]
+        lines.append(f"{pad}- {a}")
+        lines.append(f"{pad}+ {b}")
+    else:
+        lines.append(f"{pad}{d}")
+    return "\n".join(lines)

@@ -1,0 +1,72 @@
+"""Short-window profiling to qcachegrind files (ref: lib/utils/profile.ex).
+
+The reference wraps ``:eep`` tracing into ``callgrind.out.<ts>`` files with a
+default 300 ms capture window (profile.ex:7-33).  Same shape here: wrap a
+callable (or use :class:`ProfileWindow` around a code region) with cProfile
+and emit a callgrind-format file qcachegrind/kcachegrind can open.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import pstats
+import time
+
+
+def build(fn, *args, output_dir: str = ".", **kwargs):
+    """Profile ``fn(*args, **kwargs)``; write ``callgrind.out.<ts>``.
+
+    Returns ``(result, path)``.
+    """
+    profiler = cProfile.Profile()
+    result = profiler.runcall(fn, *args, **kwargs)
+    path = f"{output_dir}/callgrind.out.{int(time.time() * 1000)}"
+    _write_callgrind(pstats.Stats(profiler), path)
+    return result, path
+
+
+class ProfileWindow:
+    """``with ProfileWindow() as p: ...`` -> ``p.path`` after exit."""
+
+    def __init__(self, output_dir: str = "."):
+        self.output_dir = output_dir
+        self.path: str | None = None
+        self._profiler = cProfile.Profile()
+
+    def __enter__(self):
+        self._profiler.enable()
+        return self
+
+    def __exit__(self, *exc):
+        self._profiler.disable()
+        self.path = f"{self.output_dir}/callgrind.out.{int(time.time() * 1000)}"
+        _write_callgrind(pstats.Stats(self._profiler), self.path)
+        return False
+
+
+def _write_callgrind(stats: pstats.Stats, path: str) -> None:
+    """pstats -> callgrind format (events: nanoseconds).
+
+    pstats stores each function's *callers*; callgrind wants caller blocks
+    with callee edges, so the graph is inverted before writing.
+    """
+    raw = stats.stats  # type: ignore[attr-defined]
+    edges: dict[tuple, list[tuple]] = {}
+    for callee, (_cc, _nc, _tt, _ct, callers) in raw.items():
+        for caller, (ncalls, _, _, ccumtime) in callers.items():
+            edges.setdefault(caller, []).append((callee, ncalls, ccumtime))
+    with open(path, "w") as out:
+        out.write("# callgrind format\n")
+        out.write("version: 1\ncreator: lambda_ethereum_consensus_tpu\n")
+        out.write("events: ns\n\n")
+        for func, (_cc, _nc, tottime, _ct, _callers) in raw.items():
+            filename, lineno, funcname = func
+            out.write(f"fl={filename}\n")
+            out.write(f"fn={funcname}\n")
+            out.write(f"{lineno} {int(tottime * 1e9)}\n")
+            for (cfile, cline, cfunc), ncalls, ccumtime in edges.get(func, ()):
+                out.write(f"cfl={cfile}\n")
+                out.write(f"cfn={cfunc}\n")
+                out.write(f"calls={ncalls} {cline}\n")
+                out.write(f"{lineno} {int(ccumtime * 1e9)}\n")
+            out.write("\n")

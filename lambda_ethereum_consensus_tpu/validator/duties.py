@@ -90,9 +90,13 @@ def build_signed_block(
         state_root=b"\x00" * 32,
         body=body,
     )
-    post = state_transition(
-        state, SignedBeaconBlock(message=block), validate_result=False, spec=spec
-    )
+    # apply block processing on the already-advanced pre-state (running the
+    # full state_transition would redo the slot/epoch advance a second time)
+    from ..state_transition.core import process_block
+
+    post_ws = BeaconStateMut(pre)
+    process_block(post_ws, block, None, spec)
+    post = post_ws.freeze()
     block = block.copy(state_root=post.hash_tree_root(spec))
     signed = sign_block(ws, block, secret_keys[proposer], spec)
     return signed, post

@@ -32,9 +32,14 @@ def run(coro):
 
 @pytest.fixture(scope="module")
 def chain():
-    """Genesis (recent wall-clock genesis_time) + CHAIN_LEN built blocks."""
+    """Genesis (recent wall-clock genesis_time) + CHAIN_LEN built blocks.
+
+    genesis_time sits just far enough in the past that slots 1..CHAIN_LEN+1
+    are acceptable now — and stays inside the one-epoch gossip window for as
+    long as possible, so slow machines don't flake the gossip assertion.
+    """
     with use_chain_spec(minimal_spec()) as spec:
-        genesis_time = int(time.time()) - CHAIN_LEN * spec.SECONDS_PER_SLOT - 30
+        genesis_time = int(time.time()) - (CHAIN_LEN + 1) * spec.SECONDS_PER_SLOT - 2
         genesis = build_genesis_state(
             [bls.sk_to_pk(sk) for sk in SKS], genesis_time=genesis_time, spec=spec
         )
@@ -135,6 +140,42 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path):
             assert b"peers_connection_count" in metrics_body
 
             await node_b.stop()
+            await node_a.stop()
+
+    run(main())
+
+
+def test_checkpoint_sync_from_our_own_api(chain, tmp_path):
+    """Node C boots via --checkpoint-sync pointed at node A's Beacon API:
+    the full weak-subjectivity flow (ref: checkpoint_sync.ex:14-40) served
+    and consumed entirely by this framework."""
+    spec, genesis, blocks, _ = chain
+
+    async def main():
+        with use_chain_spec(spec):
+            node_a = BeaconNode(
+                NodeConfig(
+                    db_path=str(tmp_path / "ca.wal"),
+                    genesis_state=genesis,
+                    enable_range_sync=False,
+                ),
+                spec,
+            )
+            await node_a.start()
+            node_c = BeaconNode(
+                NodeConfig(
+                    db_path=str(tmp_path / "cc.wal"),
+                    checkpoint_sync_url=f"http://127.0.0.1:{node_a.api.port}",
+                    enable_range_sync=False,
+                ),
+                spec,
+            )
+            await node_c.start()
+            # C anchored on A's finalized state (genesis here)
+            head_c = get_head(node_c.store, spec)
+            state_c = node_c.store.block_states[head_c]
+            assert state_c.hash_tree_root(spec) == genesis.hash_tree_root(spec)
+            await node_c.stop()
             await node_a.stop()
 
     run(main())

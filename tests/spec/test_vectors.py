@@ -1,0 +1,174 @@
+"""Conformance vectors: official corpus when present + harness self-tests.
+
+Official vectors (``make spec-vectors`` or ``SPEC_TESTS_DIR``) are collected
+through :func:`discover_cases` — one pytest per case, tagged by config/fork/
+runner/handler like the reference's generated modules (ref: lib/mix/tasks/
+generate_spec_tests.ex:45-79).  Without the corpus those tests skip, and the
+self-test section below still exercises every runner on self-minted case
+directories, so the harness itself is always covered.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from lambda_ethereum_consensus_tpu.compression.snappy import compress
+from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.spec_tests import RUNNERS, discover_cases, run_case
+from lambda_ethereum_consensus_tpu.state_transition import misc, process_slots
+from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
+from lambda_ethereum_consensus_tpu.types.beacon import BeaconBlock, BeaconBlockBody
+from lambda_ethereum_consensus_tpu.validator import build_signed_block
+
+SPEC_TESTS_DIR = os.environ.get(
+    "SPEC_TESTS_DIR",
+    os.path.join(os.path.dirname(__file__), "..", "..", "vendor", "consensus-spec-tests"),
+)
+
+OFFICIAL = list(discover_cases(SPEC_TESTS_DIR))
+
+
+def _case_id(case):
+    config, fork, runner, handler, case_dir = case
+    return f"{config}/{fork}/{runner}/{handler}/{os.path.basename(case_dir)}"
+
+
+@pytest.mark.spectest
+@pytest.mark.parametrize("case", OFFICIAL, ids=map(_case_id, OFFICIAL))
+def test_official_vector(case):
+    config, fork, runner, handler, case_dir = case
+    if RUNNERS[runner].skip(handler):
+        pytest.skip(f"handler {handler} not implemented yet")
+    run_case(config, runner, handler, case_dir)
+
+
+def test_official_corpus_presence_note():
+    if not OFFICIAL:
+        pytest.skip(
+            f"official vectors not present under {SPEC_TESTS_DIR} "
+            "(run `make spec-vectors` where network egress is available)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Harness self-tests: mint case directories with our own codec and verify the
+# runners accept good vectors and reject corrupted ones with readable diffs.
+# ---------------------------------------------------------------------------
+
+N = 32
+SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
+
+
+def write_ssz(path, value, spec):
+    with open(path, "wb") as f:
+        f.write(compress(value.encode(spec)))
+
+
+def write_yaml(path, data):
+    with open(path, "w") as f:
+        yaml.safe_dump(data, f)
+
+
+@pytest.fixture(scope="module")
+def minted(tmp_path_factory):
+    """A vector tree with ssz_static, sanity/slots, shuffling and bls cases."""
+    with use_chain_spec(minimal_spec()) as spec:
+        root = tmp_path_factory.mktemp("vectors")
+        genesis = build_genesis_state([bls.sk_to_pk(sk) for sk in SKS], spec=spec)
+
+        def case(runner, handler, suite="pyspec_tests", name="case_0"):
+            d = root / "tests" / "minimal" / "capella" / runner / handler / suite / name
+            d.mkdir(parents=True, exist_ok=True)
+            return d
+
+        # ssz_static on a Checkpoint
+        from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint
+
+        cp = Checkpoint(epoch=7, root=b"\x42" * 32)
+        d = case("ssz_static", "Checkpoint", "ssz_random")
+        write_ssz(d / "serialized.ssz_snappy", cp, spec)
+        write_yaml(d / "roots.yaml", {"root": "0x" + cp.hash_tree_root(spec).hex()})
+
+        # sanity/slots
+        d = case("sanity", "slots")
+        write_ssz(d / "pre.ssz_snappy", genesis, spec)
+        write_yaml(d / "slots.yaml", 3)
+        write_ssz(d / "post.ssz_snappy", process_slots(genesis, 3, spec), spec)
+
+        # sanity/blocks with one real block
+        signed, post = build_signed_block(genesis, 1, SKS, spec=spec)
+        d = case("sanity", "blocks")
+        write_ssz(d / "pre.ssz_snappy", genesis, spec)
+        write_yaml(d / "meta.yaml", {"blocks_count": 1})
+        write_ssz(d / "blocks_0.ssz_snappy", signed, spec)
+        write_ssz(d / "post.ssz_snappy", post, spec)
+
+        # shuffling vector from the scalar-oracle implementation
+        seed = b"\x5b" * 32
+        mapping = [
+            misc.compute_shuffled_index(i, 17, seed, spec) for i in range(17)
+        ]
+        d = case("shuffling", "core", "shuffle")
+        write_yaml(
+            d / "mapping.yaml",
+            {"seed": "0x" + seed.hex(), "count": 17, "mapping": mapping},
+        )
+
+        # bls verify vectors (one positive, one negative)
+        sig = bls.sign(SKS[0], b"msg")
+        d = case("bls", "verify", "bls", "case_ok")
+        write_yaml(
+            d / "data.yaml",
+            {
+                "input": {
+                    "pubkey": "0x" + bls.sk_to_pk(SKS[0]).hex(),
+                    "message": "0x" + b"msg".hex(),
+                    "signature": "0x" + sig.hex(),
+                },
+                "output": True,
+            },
+        )
+        d = case("bls", "verify", "bls", "case_bad")
+        write_yaml(
+            d / "data.yaml",
+            {
+                "input": {
+                    "pubkey": "0x" + bls.sk_to_pk(SKS[1]).hex(),
+                    "message": "0x" + b"msg".hex(),
+                    "signature": "0x" + sig.hex(),
+                },
+                "output": False,
+            },
+        )
+
+        yield str(root), spec, genesis
+
+
+def test_discovery_and_all_minted_cases_pass(minted):
+    root, spec, _ = minted
+    cases = list(discover_cases(root))
+    assert len(cases) >= 6
+    for config, fork, runner, handler, case_dir in cases:
+        assert not RUNNERS[runner].skip(handler), (runner, handler)
+        run_case(config, runner, handler, case_dir, spec=spec)
+
+
+def test_corrupted_post_state_fails_with_diff(minted, tmp_path):
+    root, spec, genesis = minted
+    d = tmp_path / "bad_case"
+    d.mkdir()
+    write_ssz(d / "pre.ssz_snappy", genesis, spec)
+    write_yaml(d / "slots.yaml", 2)
+    tampered = process_slots(genesis, 2, spec).copy(genesis_time=12345)
+    write_ssz(d / "post.ssz_snappy", tampered, spec)
+    with pytest.raises(AssertionError, match="genesis_time"):
+        RUNNERS["sanity"].run(str(d), spec, "slots")
+
+
+def test_skip_list_mechanism():
+    assert RUNNERS["operations"].skip("nonexistent_handler")
+    assert not RUNNERS["operations"].skip("attestation")
+    assert RUNNERS["ssz_static"].skip("NotAContainer")
+    assert not RUNNERS["ssz_static"].skip("BeaconState")

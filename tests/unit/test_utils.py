@@ -1,0 +1,51 @@
+"""Structural diff + callgrind profiling utilities."""
+
+import os
+
+from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint, Fork
+from lambda_ethereum_consensus_tpu.utils import diff, format_diff
+from lambda_ethereum_consensus_tpu.utils.diff import UNCHANGED
+from lambda_ethereum_consensus_tpu.utils.profile import ProfileWindow, build
+
+
+def test_diff_unchanged():
+    with use_chain_spec(minimal_spec()):
+        a = Checkpoint(epoch=1, root=b"\x01" * 32)
+        assert diff(a, Checkpoint(epoch=1, root=b"\x01" * 32)) == UNCHANGED
+
+
+def test_diff_reports_changed_fields():
+    with use_chain_spec(minimal_spec()):
+        a = Checkpoint(epoch=1, root=b"\x01" * 32)
+        b = Checkpoint(epoch=2, root=b"\x01" * 32)
+        d = diff(a, b)
+        assert d == {"fields": {"epoch": {"changed": ("1", "2")}}}
+        assert ".epoch" in format_diff(d)
+
+
+def test_diff_nested_and_lists():
+    with use_chain_spec(minimal_spec()):
+        f1 = Fork(previous_version=b"\x00" * 4, current_version=b"\x01" * 4, epoch=0)
+        f2 = Fork(previous_version=b"\x00" * 4, current_version=b"\x02" * 4, epoch=0)
+        d = diff([f1, f1], [f1, f2])
+        assert "items" in d and 1 in d["items"]
+        assert diff([1, 2], [1, 2, 3]) == {"length_changed": (2, 3)}
+
+
+def test_profile_build_writes_callgrind(tmp_path):
+    def workload():
+        return sum(i * i for i in range(2000))
+
+    result, path = build(workload, output_dir=str(tmp_path))
+    assert result == sum(i * i for i in range(2000))
+    content = open(path).read()
+    assert content.startswith("# callgrind format")
+    assert "events: ns" in content
+    assert "workload" in content
+
+
+def test_profile_window(tmp_path):
+    with ProfileWindow(output_dir=str(tmp_path)) as p:
+        sorted(range(1000), key=lambda x: -x)
+    assert p.path is not None and os.path.exists(p.path)
