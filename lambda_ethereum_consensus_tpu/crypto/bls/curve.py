@@ -37,6 +37,9 @@ class GroupOps:
     zero: Any
     one: Any
     is_zero: Callable
+    # optional C++ fast path for scalar multiplication (set post-definition;
+    # same (pt, k) -> pt signature and semantics as _multiply_py)
+    native_mul: Callable | None = None
 
     def scalar(self, a, k: int):
         if isinstance(a, int):
@@ -138,6 +141,11 @@ class GroupOps:
         """Scalar multiplication WITHOUT reducing k mod R (cofactor clearing)."""
         if pt is None or k == 0:
             return None
+        if self.native_mul is not None:
+            return self.native_mul(pt, k)
+        return self._multiply_py(pt, k)
+
+    def _multiply_py(self, pt: AffinePoint, k: int) -> AffinePoint:
         acc = (self.one, self.one, self.zero)
         base = self.to_jacobian(pt)
         while k:
@@ -196,6 +204,14 @@ G2_GENERATOR: AffinePoint = (
         0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
     ),
 )
+
+# Hook up the C++ scalar-multiplication fast path when the library is built;
+# the pure-Python path remains as fallback and cross-check oracle.
+from . import native as _native  # noqa: E402
+
+if _native.available():
+    object.__setattr__(g1, "native_mul", _native.g1_mul)
+    object.__setattr__(g2, "native_mul", _native.g2_mul)
 
 # Transcription-error firewall: the published generators must be on-curve and
 # of order R, or this module refuses to import.

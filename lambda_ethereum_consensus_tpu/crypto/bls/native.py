@@ -1,0 +1,115 @@
+"""ctypes binding over ``native/libbls381.so``.
+
+Accelerates the three hot operations — pairing product checks, G1/G2 scalar
+multiplication (signing, subgroup checks, cofactor clearing) — while the
+pure-Python implementation stays as the always-available oracle and fallback.
+Boundary format: big-endian 48-byte field elements, affine ``x||y`` points.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_SO_PATH = os.path.join(
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ),
+    "native",
+    "build",
+    "libbls381.so",
+)
+
+
+def _load():
+    if os.environ.get("BLS_DISABLE_NATIVE"):
+        return None
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    lib.bls381_init.restype = None
+    lib.bls381_pairing_check.restype = ctypes.c_int
+    lib.bls381_pairing_check.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.bls381_g1_mul.restype = None
+    lib.bls381_g1_mul.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.bls381_g2_mul.restype = None
+    lib.bls381_g2_mul.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.bls381_init()
+    return lib
+
+
+_LIB = _load()
+
+
+def available() -> bool:
+    return _LIB is not None
+
+
+# ------------------------------------------------------------- converters
+
+def _g1_bytes(pt) -> bytes:
+    x, y = pt
+    return x.to_bytes(48, "big") + y.to_bytes(48, "big")
+
+
+def _g2_bytes(pt) -> bytes:
+    (x0, x1), (y0, y1) = pt
+    return (
+        x0.to_bytes(48, "big") + x1.to_bytes(48, "big")
+        + y0.to_bytes(48, "big") + y1.to_bytes(48, "big")
+    )
+
+
+def _g1_from(buf: bytes):
+    return (int.from_bytes(buf[:48], "big"), int.from_bytes(buf[48:], "big"))
+
+
+def _g2_from(buf: bytes):
+    return (
+        (int.from_bytes(buf[:48], "big"), int.from_bytes(buf[48:96], "big")),
+        (int.from_bytes(buf[96:144], "big"), int.from_bytes(buf[144:], "big")),
+    )
+
+
+# ------------------------------------------------------------- operations
+
+def pairing_check(pairs) -> bool:
+    """prod e(P_i, Q_i) == 1 over affine (g1, g2) point pairs (no Nones)."""
+    g1buf = b"".join(_g1_bytes(p) for p, _ in pairs)
+    g2buf = b"".join(_g2_bytes(q) for _, q in pairs)
+    return bool(_LIB.bls381_pairing_check(g1buf, g2buf, len(pairs)))
+
+
+def g1_mul(pt, scalar: int):
+    if pt is None or scalar == 0:
+        return None
+    nbytes = max(1, (scalar.bit_length() + 7) // 8)
+    out = ctypes.create_string_buffer(96)
+    is_inf = ctypes.c_int()
+    _LIB.bls381_g1_mul(
+        out, _g1_bytes(pt), scalar.to_bytes(nbytes, "big"), nbytes, ctypes.byref(is_inf)
+    )
+    return None if is_inf.value else _g1_from(out.raw)
+
+
+def g2_mul(pt, scalar: int):
+    if pt is None or scalar == 0:
+        return None
+    nbytes = max(1, (scalar.bit_length() + 7) // 8)
+    out = ctypes.create_string_buffer(192)
+    is_inf = ctypes.c_int()
+    _LIB.bls381_g2_mul(
+        out, _g2_bytes(pt), scalar.to_bytes(nbytes, "big"), nbytes, ctypes.byref(is_inf)
+    )
+    return None if is_inf.value else _g2_from(out.raw)

@@ -150,11 +150,20 @@ def pairing(p: AffinePoint, q: AffinePoint) -> F.Fq12:
 
 def pairing_check(pairs: list[tuple[AffinePoint, AffinePoint]]) -> bool:
     """True iff prod e(P_i, Q_i) == 1, with a single final exponentiation."""
-    f = F.FQ12_ONE
+    live = []
     for p, q in pairs:
         if p is None or q is None:
             continue
         if not g1.on_curve(p) or not g2.on_curve(q):
             return False
+        live.append((p, q))
+    if not live:
+        return True
+    from . import native
+
+    if native.available():
+        return native.pairing_check(live)
+    f = F.FQ12_ONE
+    for p, q in live:
         f = F.fq12_mul(f, miller_loop(p, q))
     return F.fq12_is_one(final_exponentiation(f))

@@ -1,0 +1,980 @@
+// BLS12-381 native backend: field tower, curve ops, optimal ate pairing.
+//
+// The C++ counterpart of crypto/bls (which stays as the reference oracle) —
+// the role blst plays for the reference client (ref: native/bls_nif).  The
+// algorithms mirror the Python implementation exactly: same tower
+// (Fq2 = Fq[u]/(u^2+1), Fq6 = Fq2[v]/(v^3-(1+u)), Fq12 = Fq6[w]/(w^2-v)),
+// same affine Miller loop with combined slope inversion, same
+// (x-1)^2 (x+p)(x^2+p^2-1)+3 hard part (cubed — gcd(3,r)=1 keeps ==1 checks
+// exact).  Base field: 6x64-bit limbs, Montgomery multiplication (CIOS).
+//
+// C ABI at the bottom; all boundary buffers are big-endian byte strings
+// (48 bytes per Fq element), affine points as x||y (G1: 96B, G2: 192B with
+// each Fq2 as c0||c1).
+
+#include <cstdint>
+#include <cstring>
+
+using u64 = uint64_t;
+using u128 = __uint128_t;
+
+static const int NLIMBS = 6;
+
+// p, little-endian limbs (the only transcribed constant; validated against
+// the Python oracle by the cross-tests)
+static const u64 P[NLIMBS] = {
+    0xb9feffffffffaaabULL, 0x1eabfffeb153ffffULL, 0x6730d2a0f6b0f624ULL,
+    0x64774b84f38512bfULL, 0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL,
+};
+// Montgomery parameters, computed in init_constants (not transcribed):
+static u64 P_INV;          // -p^{-1} mod 2^64
+static u64 R2[NLIMBS];     // R^2 mod p (R = 2^384)
+
+struct Fp {
+    u64 l[NLIMBS];
+};
+
+static inline bool fp_is_zero(const Fp& a) {
+    u64 acc = 0;
+    for (int i = 0; i < NLIMBS; i++) acc |= a.l[i];
+    return acc == 0;
+}
+
+static inline bool fp_eq(const Fp& a, const Fp& b) {
+    u64 acc = 0;
+    for (int i = 0; i < NLIMBS; i++) acc |= a.l[i] ^ b.l[i];
+    return acc == 0;
+}
+
+static inline int fp_cmp_p(const Fp& a) {  // compare to modulus
+    for (int i = NLIMBS - 1; i >= 0; i--) {
+        if (a.l[i] < P[i]) return -1;
+        if (a.l[i] > P[i]) return 1;
+    }
+    return 0;
+}
+
+static inline void fp_add(Fp& out, const Fp& a, const Fp& b) {
+    u128 carry = 0;
+    for (int i = 0; i < NLIMBS; i++) {
+        u128 s = (u128)a.l[i] + b.l[i] + carry;
+        out.l[i] = (u64)s;
+        carry = s >> 64;
+    }
+    // reduce once if >= p (carry can only be 0 here since 2p < 2^384)
+    if (carry || fp_cmp_p(out) >= 0) {
+        u64 borrow = 0;
+        for (int i = 0; i < NLIMBS; i++) {
+            u128 d = (u128)out.l[i] - P[i] - borrow;
+            out.l[i] = (u64)d;
+            borrow = (d >> 64) ? 1 : 0;
+        }
+    }
+}
+
+static inline void fp_sub(Fp& out, const Fp& a, const Fp& b) {
+    u64 borrow = 0;
+    for (int i = 0; i < NLIMBS; i++) {
+        u128 d = (u128)a.l[i] - b.l[i] - borrow;
+        out.l[i] = (u64)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    if (borrow) {  // add p back
+        u128 carry = 0;
+        for (int i = 0; i < NLIMBS; i++) {
+            u128 s = (u128)out.l[i] + P[i] + carry;
+            out.l[i] = (u64)s;
+            carry = s >> 64;
+        }
+    }
+}
+
+static inline void fp_neg(Fp& out, const Fp& a) {
+    if (fp_is_zero(a)) {
+        out = a;
+        return;
+    }
+    u64 borrow = 0;
+    for (int i = 0; i < NLIMBS; i++) {
+        u128 d = (u128)P[i] - a.l[i] - borrow;
+        out.l[i] = (u64)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+// Montgomery multiplication (CIOS)
+static void fp_mul(Fp& out, const Fp& a, const Fp& b) {
+    u64 t[NLIMBS + 2] = {0};
+    for (int i = 0; i < NLIMBS; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < NLIMBS; j++) {
+            u128 s = (u128)t[j] + (u128)a.l[j] * b.l[i] + carry;
+            t[j] = (u64)s;
+            carry = s >> 64;
+        }
+        u128 s = (u128)t[NLIMBS] + carry;
+        t[NLIMBS] = (u64)s;
+        t[NLIMBS + 1] = (u64)(s >> 64);
+
+        u64 m = t[0] * P_INV;
+        carry = ((u128)t[0] + (u128)m * P[0]) >> 64;
+        for (int j = 1; j < NLIMBS; j++) {
+            u128 s2 = (u128)t[j] + (u128)m * P[j] + carry;
+            t[j - 1] = (u64)s2;
+            carry = s2 >> 64;
+        }
+        s = (u128)t[NLIMBS] + carry;
+        t[NLIMBS - 1] = (u64)s;
+        t[NLIMBS] = t[NLIMBS + 1] + (u64)(s >> 64);
+    }
+    for (int i = 0; i < NLIMBS; i++) out.l[i] = t[i];
+    if (t[NLIMBS] || fp_cmp_p(out) >= 0) {
+        u64 borrow = 0;
+        for (int i = 0; i < NLIMBS; i++) {
+            u128 d = (u128)out.l[i] - P[i] - borrow;
+            out.l[i] = (u64)d;
+            borrow = (d >> 64) ? 1 : 0;
+        }
+    }
+}
+
+static inline void fp_sq(Fp& out, const Fp& a) { fp_mul(out, a, a); }
+
+static const Fp FP_ZERO = {{0, 0, 0, 0, 0, 0}};
+
+static Fp FP_ONE;  // R mod p (Montgomery one), initialized below
+
+static void fp_pow(Fp& out, const Fp& base, const u64* exp, int explimbs) {
+    Fp result = FP_ONE;
+    Fp b = base;
+    for (int i = 0; i < explimbs; i++) {
+        u64 e = exp[i];
+        for (int bit = 0; bit < 64; bit++) {
+            if (e & 1) fp_mul(result, result, b);
+            fp_sq(b, b);
+            e >>= 1;
+        }
+    }
+    out = result;
+}
+
+// p - 2, for inversion by Fermat
+static u64 P_MINUS_2[NLIMBS];
+
+static void fp_inv(Fp& out, const Fp& a) { fp_pow(out, a, P_MINUS_2, NLIMBS); }
+
+static void init_constants() {
+    // P_INV = -p^{-1} mod 2^64 by Newton iteration
+    u64 inv = 1;
+    for (int i = 0; i < 6; i++) inv *= 2 - P[0] * inv;
+    P_INV = (u64)(0 - inv);
+    // R2 = 2^768 mod p by 768 doublings of 1 with modular reduction
+    Fp acc = {{1, 0, 0, 0, 0, 0}};
+    for (int i = 0; i < 768; i++) fp_add(acc, acc, acc);
+    memcpy(R2, acc.l, sizeof(R2));
+    // FP_ONE = R mod p = mont_mul(1, R2)
+    Fp one_raw = {{1, 0, 0, 0, 0, 0}};
+    Fp r2;
+    memcpy(r2.l, R2, sizeof(R2));
+    fp_mul(FP_ONE, one_raw, r2);
+    memcpy(P_MINUS_2, P, sizeof(P));
+    P_MINUS_2[0] -= 2;
+}
+
+static void fp_from_bytes(Fp& out, const uint8_t* be48) {
+    Fp raw;
+    for (int i = 0; i < NLIMBS; i++) {
+        u64 limb = 0;
+        for (int b = 0; b < 8; b++) limb = (limb << 8) | be48[(NLIMBS - 1 - i) * 8 + b];
+        raw.l[i] = limb;
+    }
+    Fp r2;
+    memcpy(r2.l, R2, sizeof(R2));
+    fp_mul(out, raw, r2);  // to Montgomery form
+}
+
+static void fp_to_bytes(uint8_t* be48, const Fp& a) {
+    Fp one_raw = {{1, 0, 0, 0, 0, 0}};
+    Fp norm;
+    fp_mul(norm, a, one_raw);  // from Montgomery form
+    for (int i = 0; i < NLIMBS; i++) {
+        u64 limb = norm.l[i];
+        for (int b = 7; b >= 0; b--) {
+            be48[(NLIMBS - 1 - i) * 8 + b] = (uint8_t)(limb & 0xff);
+            limb >>= 8;
+        }
+    }
+}
+
+// ------------------------------------------------------------------- Fq2
+
+struct Fq2 {
+    Fp c0, c1;
+};
+
+static inline void fq2_add(Fq2& o, const Fq2& a, const Fq2& b) {
+    fp_add(o.c0, a.c0, b.c0);
+    fp_add(o.c1, a.c1, b.c1);
+}
+static inline void fq2_sub(Fq2& o, const Fq2& a, const Fq2& b) {
+    fp_sub(o.c0, a.c0, b.c0);
+    fp_sub(o.c1, a.c1, b.c1);
+}
+static inline void fq2_neg(Fq2& o, const Fq2& a) {
+    fp_neg(o.c0, a.c0);
+    fp_neg(o.c1, a.c1);
+}
+static void fq2_mul(Fq2& o, const Fq2& a, const Fq2& b) {
+    Fp t0, t1, s1, s2, sum;
+    fp_mul(t0, a.c0, b.c0);
+    fp_mul(t1, a.c1, b.c1);
+    fp_add(s1, a.c0, a.c1);
+    fp_add(s2, b.c0, b.c1);
+    fp_mul(sum, s1, s2);
+    Fp c0, c1;
+    fp_sub(c0, t0, t1);
+    fp_sub(sum, sum, t0);
+    fp_sub(c1, sum, t1);
+    o.c0 = c0;
+    o.c1 = c1;
+}
+static void fq2_sq(Fq2& o, const Fq2& a) {
+    Fp s, d, m;
+    fp_add(s, a.c0, a.c1);
+    fp_sub(d, a.c0, a.c1);
+    fp_mul(m, a.c0, a.c1);
+    fp_mul(o.c0, s, d);
+    fp_add(o.c1, m, m);
+}
+static void fq2_inv(Fq2& o, const Fq2& a) {
+    Fp n, t, inv;
+    fp_sq(n, a.c0);
+    fp_sq(t, a.c1);
+    fp_add(n, n, t);
+    fp_inv(inv, n);
+    fp_mul(o.c0, a.c0, inv);
+    Fp neg;
+    fp_neg(neg, a.c1);
+    fp_mul(o.c1, neg, inv);
+}
+static inline void fq2_conj(Fq2& o, const Fq2& a) {
+    o.c0 = a.c0;
+    fp_neg(o.c1, a.c1);
+}
+static inline void fq2_mul_by_xi(Fq2& o, const Fq2& a) {  // xi = 1 + u
+    Fp c0, c1;
+    fp_sub(c0, a.c0, a.c1);
+    fp_add(c1, a.c0, a.c1);
+    o.c0 = c0;
+    o.c1 = c1;
+}
+static inline bool fq2_is_zero(const Fq2& a) { return fp_is_zero(a.c0) && fp_is_zero(a.c1); }
+static inline bool fq2_eq(const Fq2& a, const Fq2& b) {
+    return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1);
+}
+
+// ------------------------------------------------------------------- Fq6
+
+struct Fq6 {
+    Fq2 c0, c1, c2;
+};
+
+static void fq6_add(Fq6& o, const Fq6& a, const Fq6& b) {
+    fq2_add(o.c0, a.c0, b.c0);
+    fq2_add(o.c1, a.c1, b.c1);
+    fq2_add(o.c2, a.c2, b.c2);
+}
+static void fq6_sub(Fq6& o, const Fq6& a, const Fq6& b) {
+    fq2_sub(o.c0, a.c0, b.c0);
+    fq2_sub(o.c1, a.c1, b.c1);
+    fq2_sub(o.c2, a.c2, b.c2);
+}
+static void fq6_neg(Fq6& o, const Fq6& a) {
+    fq2_neg(o.c0, a.c0);
+    fq2_neg(o.c1, a.c1);
+    fq2_neg(o.c2, a.c2);
+}
+static void fq6_mul(Fq6& o, const Fq6& a, const Fq6& b) {
+    Fq2 t0, t1, t2, s, u_, v_;
+    fq2_mul(t0, a.c0, b.c0);
+    fq2_mul(t1, a.c1, b.c1);
+    fq2_mul(t2, a.c2, b.c2);
+    Fq2 c0, c1, c2;
+    // c0 = t0 + xi*((a1+a2)(b1+b2) - t1 - t2)
+    fq2_add(s, a.c1, a.c2);
+    fq2_add(u_, b.c1, b.c2);
+    fq2_mul(v_, s, u_);
+    fq2_sub(v_, v_, t1);
+    fq2_sub(v_, v_, t2);
+    fq2_mul_by_xi(v_, v_);
+    fq2_add(c0, t0, v_);
+    // c1 = (a0+a1)(b0+b1) - t0 - t1 + xi*t2
+    fq2_add(s, a.c0, a.c1);
+    fq2_add(u_, b.c0, b.c1);
+    fq2_mul(v_, s, u_);
+    fq2_sub(v_, v_, t0);
+    fq2_sub(v_, v_, t1);
+    Fq2 xt2;
+    fq2_mul_by_xi(xt2, t2);
+    fq2_add(c1, v_, xt2);
+    // c2 = (a0+a2)(b0+b2) - t0 - t2 + t1
+    fq2_add(s, a.c0, a.c2);
+    fq2_add(u_, b.c0, b.c2);
+    fq2_mul(v_, s, u_);
+    fq2_sub(v_, v_, t0);
+    fq2_sub(v_, v_, t2);
+    fq2_add(c2, v_, t1);
+    o.c0 = c0;
+    o.c1 = c1;
+    o.c2 = c2;
+}
+static void fq6_mul_by_v(Fq6& o, const Fq6& a) {
+    Fq2 c0;
+    fq2_mul_by_xi(c0, a.c2);
+    Fq2 c1 = a.c0, c2 = a.c1;
+    o.c0 = c0;
+    o.c1 = c1;
+    o.c2 = c2;
+}
+static void fq6_inv(Fq6& o, const Fq6& a) {
+    Fq2 c0, c1, c2, t, t2;
+    fq2_sq(c0, a.c0);
+    fq2_mul(t, a.c1, a.c2);
+    fq2_mul_by_xi(t, t);
+    fq2_sub(c0, c0, t);
+    fq2_sq(c1, a.c2);
+    fq2_mul_by_xi(c1, c1);
+    fq2_mul(t, a.c0, a.c1);
+    fq2_sub(c1, c1, t);
+    fq2_sq(c2, a.c1);
+    fq2_mul(t, a.c0, a.c2);
+    fq2_sub(c2, c2, t);
+    // t = xi*(a1*c2 + a2*c1) + a0*c0
+    Fq2 x, y;
+    fq2_mul(x, a.c1, c2);
+    fq2_mul(y, a.c2, c1);
+    fq2_add(x, x, y);
+    fq2_mul_by_xi(x, x);
+    fq2_mul(t2, a.c0, c0);
+    fq2_add(x, x, t2);
+    Fq2 xin;
+    fq2_inv(xin, x);
+    fq2_mul(o.c0, c0, xin);
+    fq2_mul(o.c1, c1, xin);
+    fq2_mul(o.c2, c2, xin);
+}
+
+// ------------------------------------------------------------------ Fq12
+
+struct Fq12 {
+    Fq6 c0, c1;
+};
+
+static void fq12_mul(Fq12& o, const Fq12& a, const Fq12& b) {
+    Fq6 t0, t1, s, u_, v_;
+    fq6_mul(t0, a.c0, b.c0);
+    fq6_mul(t1, a.c1, b.c1);
+    Fq6 c0, c1;
+    fq6_mul_by_v(v_, t1);
+    fq6_add(c0, t0, v_);
+    fq6_add(s, a.c0, a.c1);
+    fq6_add(u_, b.c0, b.c1);
+    fq6_mul(v_, s, u_);
+    fq6_sub(v_, v_, t0);
+    fq6_sub(c1, v_, t1);
+    o.c0 = c0;
+    o.c1 = c1;
+}
+static void fq12_sq(Fq12& o, const Fq12& a) { fq12_mul(o, a, a); }
+static void fq12_inv(Fq12& o, const Fq12& a) {
+    Fq6 t0, t1;
+    fq6_mul(t0, a.c0, a.c0);
+    fq6_mul(t1, a.c1, a.c1);
+    fq6_mul_by_v(t1, t1);
+    fq6_sub(t0, t0, t1);
+    Fq6 tinv;
+    fq6_inv(tinv, t0);
+    fq6_mul(o.c0, a.c0, tinv);
+    Fq6 n;
+    fq6_mul(n, a.c1, tinv);
+    fq6_neg(o.c1, n);
+}
+static void fq12_conj(Fq12& o, const Fq12& a) {
+    o.c0 = a.c0;
+    fq6_neg(o.c1, a.c1);
+}
+
+static Fq12 FQ12_ONE;
+
+static bool fq12_is_one(const Fq12& a) {
+    if (!fq2_eq(a.c0.c0, FQ12_ONE.c0.c0)) return false;
+    const Fp* rest[] = {
+        &a.c0.c1.c0, &a.c0.c1.c1, &a.c0.c2.c0, &a.c0.c2.c1,
+        &a.c1.c0.c0, &a.c1.c0.c1, &a.c1.c1.c0, &a.c1.c1.c1,
+        &a.c1.c2.c0, &a.c1.c2.c1,
+    };
+    for (auto r : rest)
+        if (!fp_is_zero(*r)) return false;
+    return true;
+}
+
+// Frobenius: gammas computed at init (xi^((p-1)/6) etc.)
+static Fq2 G12, G6_1, G6_2;
+
+static void fq2_pow(Fq2& out, const Fq2& base, const u64* exp, int explimbs) {
+    Fq2 result;
+    result.c0 = FP_ONE;
+    result.c1 = FP_ZERO;
+    Fq2 b = base;
+    for (int i = 0; i < explimbs; i++) {
+        u64 e = exp[i];
+        for (int bit = 0; bit < 64; bit++) {
+            if (e & 1) fq2_mul(result, result, b);
+            fq2_sq(b, b);
+            e >>= 1;
+        }
+    }
+    out = result;
+}
+
+static void fq6_frob(Fq6& o, const Fq6& a) {
+    fq2_conj(o.c0, a.c0);
+    Fq2 t;
+    fq2_conj(t, a.c1);
+    fq2_mul(o.c1, t, G6_1);
+    fq2_conj(t, a.c2);
+    fq2_mul(o.c2, t, G6_2);
+}
+static void fq12_frob(Fq12& o, const Fq12& a) {
+    fq6_frob(o.c0, a.c0);
+    Fq6 t;
+    fq6_frob(t, a.c1);
+    fq2_mul(o.c1.c0, t.c0, G12);
+    fq2_mul(o.c1.c1, t.c1, G12);
+    fq2_mul(o.c1.c2, t.c2, G12);
+}
+
+// ------------------------------------------------------------ curve (G1/G2)
+// Jacobian arithmetic templated over the field via macros would be nicer;
+// two concrete copies keep it simple.
+
+struct G1J {
+    Fp x, y, z;
+};
+struct G2J {
+    Fq2 x, y, z;
+};
+
+static bool g1j_is_inf(const G1J& p) { return fp_is_zero(p.z); }
+static bool g2j_is_inf(const G2J& p) { return fq2_is_zero(p.z); }
+
+static void g1_double(G1J& o, const G1J& p) {
+    if (g1j_is_inf(p) || fp_is_zero(p.y)) {
+        o.x = FP_ONE;
+        o.y = FP_ONE;
+        o.z = FP_ZERO;
+        return;
+    }
+    Fp a, b, c, d, e, f, t, t2;
+    fp_sq(a, p.x);
+    fp_sq(b, p.y);
+    fp_sq(c, b);
+    fp_add(t, p.x, b);
+    fp_sq(t, t);
+    fp_sub(t, t, a);
+    fp_sub(t, t, c);
+    fp_add(d, t, t);
+    fp_add(e, a, a);
+    fp_add(e, e, a);
+    fp_sq(f, e);
+    Fp x3, y3, z3;
+    fp_add(t, d, d);
+    fp_sub(x3, f, t);
+    fp_sub(t, d, x3);
+    fp_mul(t, e, t);
+    fp_add(t2, c, c);
+    fp_add(t2, t2, t2);
+    fp_add(t2, t2, t2);
+    fp_sub(y3, t, t2);
+    fp_mul(z3, p.y, p.z);
+    fp_add(z3, z3, z3);
+    o.x = x3;
+    o.y = y3;
+    o.z = z3;
+}
+
+static void g1_add(G1J& o, const G1J& p, const G1J& q) {
+    if (g1j_is_inf(p)) {
+        o = q;
+        return;
+    }
+    if (g1j_is_inf(q)) {
+        o = p;
+        return;
+    }
+    Fp z1z1, z2z2, u1, u2, s1, s2, t;
+    fp_sq(z1z1, p.z);
+    fp_sq(z2z2, q.z);
+    fp_mul(u1, p.x, z2z2);
+    fp_mul(u2, q.x, z1z1);
+    fp_mul(t, p.y, q.z);
+    fp_mul(s1, t, z2z2);
+    fp_mul(t, q.y, p.z);
+    fp_mul(s2, t, z1z1);
+    if (fp_eq(u1, u2)) {
+        if (fp_eq(s1, s2)) {
+            g1_double(o, p);
+            return;
+        }
+        o.x = FP_ONE;
+        o.y = FP_ONE;
+        o.z = FP_ZERO;
+        return;
+    }
+    Fp h, i, j, r, v;
+    fp_sub(h, u2, u1);
+    fp_add(t, h, h);
+    fp_sq(i, t);
+    fp_mul(j, h, i);
+    fp_sub(t, s2, s1);
+    fp_add(r, t, t);
+    fp_mul(v, u1, i);
+    Fp x3, y3, z3;
+    fp_sq(t, r);
+    fp_sub(t, t, j);
+    fp_sub(x3, t, v);
+    fp_sub(x3, x3, v);
+    fp_sub(t, v, x3);
+    fp_mul(t, r, t);
+    Fp t2;
+    fp_mul(t2, s1, j);
+    fp_add(t2, t2, t2);
+    fp_sub(y3, t, t2);
+    fp_mul(t, p.z, q.z);
+    fp_add(t, t, t);
+    fp_mul(z3, t, h);
+    o.x = x3;
+    o.y = y3;
+    o.z = z3;
+}
+
+static void g2_double(G2J& o, const G2J& p) {
+    if (g2j_is_inf(p) || fq2_is_zero(p.y)) {
+        o.x.c0 = FP_ONE;
+        o.x.c1 = FP_ZERO;
+        o.y = o.x;
+        o.z.c0 = FP_ZERO;
+        o.z.c1 = FP_ZERO;
+        return;
+    }
+    Fq2 a, b, c, d, e, f, t, t2;
+    fq2_sq(a, p.x);
+    fq2_sq(b, p.y);
+    fq2_sq(c, b);
+    fq2_add(t, p.x, b);
+    fq2_sq(t, t);
+    fq2_sub(t, t, a);
+    fq2_sub(t, t, c);
+    fq2_add(d, t, t);
+    fq2_add(e, a, a);
+    fq2_add(e, e, a);
+    fq2_sq(f, e);
+    Fq2 x3, y3, z3;
+    fq2_add(t, d, d);
+    fq2_sub(x3, f, t);
+    fq2_sub(t, d, x3);
+    fq2_mul(t, e, t);
+    fq2_add(t2, c, c);
+    fq2_add(t2, t2, t2);
+    fq2_add(t2, t2, t2);
+    fq2_sub(y3, t, t2);
+    fq2_mul(z3, p.y, p.z);
+    fq2_add(z3, z3, z3);
+    o.x = x3;
+    o.y = y3;
+    o.z = z3;
+}
+
+static void g2_add(G2J& o, const G2J& p, const G2J& q) {
+    if (g2j_is_inf(p)) {
+        o = q;
+        return;
+    }
+    if (g2j_is_inf(q)) {
+        o = p;
+        return;
+    }
+    Fq2 z1z1, z2z2, u1, u2, s1, s2, t;
+    fq2_sq(z1z1, p.z);
+    fq2_sq(z2z2, q.z);
+    fq2_mul(u1, p.x, z2z2);
+    fq2_mul(u2, q.x, z1z1);
+    fq2_mul(t, p.y, q.z);
+    fq2_mul(s1, t, z2z2);
+    fq2_mul(t, q.y, p.z);
+    fq2_mul(s2, t, z1z1);
+    if (fq2_eq(u1, u2)) {
+        if (fq2_eq(s1, s2)) {
+            g2_double(o, p);
+            return;
+        }
+        o.x.c0 = FP_ONE;
+        o.x.c1 = FP_ZERO;
+        o.y = o.x;
+        o.z.c0 = FP_ZERO;
+        o.z.c1 = FP_ZERO;
+        return;
+    }
+    Fq2 h, i, j, r, v;
+    fq2_sub(h, u2, u1);
+    fq2_add(t, h, h);
+    fq2_sq(i, t);
+    fq2_mul(j, h, i);
+    fq2_sub(t, s2, s1);
+    fq2_add(r, t, t);
+    fq2_mul(v, u1, i);
+    Fq2 x3, y3, z3;
+    fq2_sq(t, r);
+    fq2_sub(t, t, j);
+    fq2_sub(x3, t, v);
+    fq2_sub(x3, x3, v);
+    fq2_sub(t, v, x3);
+    fq2_mul(t, r, t);
+    Fq2 t2;
+    fq2_mul(t2, s1, j);
+    fq2_add(t2, t2, t2);
+    fq2_sub(y3, t, t2);
+    fq2_mul(t, p.z, q.z);
+    fq2_add(t, t, t);
+    fq2_mul(z3, t, h);
+    o.x = x3;
+    o.y = y3;
+    o.z = z3;
+}
+
+// ------------------------------------------------------------ Miller loop
+//
+// Same structure as the Python pairing: untwist Q into Fq12 affine
+// coordinates, affine double/add steps with one combined inversion.
+
+struct G2A {
+    Fq12 x, y;  // untwisted coordinates in Fq12
+};
+
+static Fq12 W2_INV, W3_INV;  // w^-2, w^-3, computed at init
+
+static void fq12_from_fq2_slot0(Fq12& o, const Fq2& a) {
+    memset(&o, 0, sizeof(o));
+    o.c0.c0 = a;
+}
+
+static void untwist(G2A& o, const Fq2& qx, const Fq2& qy) {
+    Fq12 ex, ey;
+    fq12_from_fq2_slot0(ex, qx);
+    fq12_from_fq2_slot0(ey, qy);
+    fq12_mul(o.x, ex, W2_INV);
+    fq12_mul(o.y, ey, W3_INV);
+}
+
+static void fq12_sub3(Fq12& o, const Fq12& a, const Fq12& b) {
+    fq6_sub(o.c0, a.c0, b.c0);
+    fq6_sub(o.c1, a.c1, b.c1);
+}
+static void fq12_add3(Fq12& o, const Fq12& a, const Fq12& b) {
+    fq6_add(o.c0, a.c0, b.c0);
+    fq6_add(o.c1, a.c1, b.c1);
+}
+
+static const u64 BLS_X = 0xd201000000010000ULL;  // |x|, parameter is negative
+
+static bool fq12_eq(const Fq12& a, const Fq12& b) {
+    const Fq2* as[] = {&a.c0.c0, &a.c0.c1, &a.c0.c2, &a.c1.c0, &a.c1.c1, &a.c1.c2};
+    const Fq2* bs[] = {&b.c0.c0, &b.c0.c1, &b.c0.c2, &b.c1.c0, &b.c1.c1, &b.c1.c2};
+    for (int i = 0; i < 6; i++)
+        if (!fq2_eq(*as[i], *bs[i])) return false;
+    return true;
+}
+
+// line through r (doubling) or r,q (addition) evaluated at P, then advance r.
+// Returns false when the line is vertical (result point at infinity) — the
+// Python oracle's `r2 is None` case, which terminates the Miller loop.
+static bool line_and_step(Fq12& line, G2A& r, const G2A& q, const Fq12& px,
+                          const Fq12& py, bool doubling) {
+    Fq12 num, den, slope, t;
+    bool as_doubling = doubling || (fq12_eq(r.x, q.x) && fq12_eq(r.y, q.y));
+    if (as_doubling) {
+        // slope = 3 x^2 / 2 y
+        fq12_mul(t, r.x, r.x);
+        fq12_add3(num, t, t);
+        fq12_add3(num, num, t);
+        fq12_add3(den, r.y, r.y);
+    } else if (fq12_eq(r.x, q.x)) {
+        // vertical line: l(P) = px - r.x, result is the point at infinity
+        fq12_sub3(line, px, r.x);
+        return false;
+    } else {
+        fq12_sub3(num, q.y, r.y);
+        fq12_sub3(den, q.x, r.x);
+    }
+    Fq12 dinv;
+    fq12_inv(dinv, den);
+    fq12_mul(slope, num, dinv);
+    // line = (py - r.y) - slope*(px - r.x)
+    Fq12 dy, dx, sdx;
+    fq12_sub3(dy, py, r.y);
+    fq12_sub3(dx, px, r.x);
+    fq12_mul(sdx, slope, dx);
+    fq12_sub3(line, dy, sdx);
+    // advance
+    Fq12 x3, y3;
+    fq12_mul(t, slope, slope);
+    fq12_sub3(x3, t, r.x);
+    const Fq12& other_x = as_doubling ? r.x : q.x;
+    fq12_sub3(x3, x3, other_x);
+    fq12_sub3(t, r.x, x3);
+    fq12_mul(t, slope, t);
+    fq12_sub3(y3, t, r.y);
+    r.x = x3;
+    r.y = y3;
+    return true;
+}
+
+static void miller_loop(Fq12& f, const Fp& px_, const Fp& py_, const Fq2& qx,
+                        const Fq2& qy) {
+    G2A q, r;
+    untwist(q, qx, qy);
+    r = q;
+    Fq12 px, py;
+    memset(&px, 0, sizeof(px));
+    memset(&py, 0, sizeof(py));
+    px.c0.c0.c0 = px_;
+    py.c0.c0.c0 = py_;
+    f = FQ12_ONE;
+    // bits of |x| after the MSB (63 down to 0 of a 64-bit value with MSB at 63)
+    int started = 0;
+    for (int bit = 63; bit >= 0; bit--) {
+        u64 mask = 1ULL << bit;
+        if (!started) {
+            if (BLS_X & mask) started = 1;  // skip the MSB itself
+            continue;
+        }
+        Fq12 line;
+        line_and_step(line, r, r, px, py, true);
+        Fq12 f2;
+        fq12_sq(f2, f);
+        fq12_mul(f, f2, line);
+        if (BLS_X & mask) {
+            bool alive = line_and_step(line, r, q, px, py, false);
+            fq12_mul(f, f, line);
+            if (!alive) break;  // mirror the Python oracle's early exit
+        }
+    }
+    // negative x: conjugate
+    Fq12 c;
+    fq12_conj(c, f);
+    f = c;
+}
+
+static void fq12_pow_x(Fq12& o, const Fq12& a) {  // a^x, x negative
+    Fq12 result = FQ12_ONE;
+    Fq12 b = a;
+    u64 e = BLS_X;
+    while (e) {
+        if (e & 1) fq12_mul(result, result, b);
+        fq12_sq(b, b);
+        e >>= 1;
+    }
+    fq12_conj(o, result);  // cyclotomic: conj == inverse
+}
+
+static void final_exponentiation(Fq12& o, const Fq12& f_in) {
+    // easy part: f^((p^6-1)(p^2+1))
+    Fq12 f, conj, inv, t;
+    fq12_conj(conj, f_in);
+    fq12_inv(inv, f_in);
+    fq12_mul(f, conj, inv);
+    fq12_frob(t, f);
+    fq12_frob(t, t);
+    fq12_mul(f, t, f);
+    // hard part (cubed): (x-1)^2 (x+p) (x^2+p^2-1) + 3
+    Fq12 a, b, c, d, m = f;
+    fq12_pow_x(t, m);
+    fq12_conj(conj, m);
+    fq12_mul(a, t, conj);  // m^(x-1)
+    fq12_pow_x(t, a);
+    fq12_conj(conj, a);
+    fq12_mul(b, t, conj);  // a^(x-1)
+    fq12_pow_x(t, b);
+    fq12_frob(conj, b);
+    fq12_mul(c, t, conj);  // b^(x+p)
+    Fq12 xx, fr2, cc;
+    fq12_pow_x(t, c);
+    fq12_pow_x(xx, t);  // c^(x^2)
+    fq12_frob(fr2, c);
+    fq12_frob(fr2, fr2);  // c^(p^2)
+    fq12_conj(cc, c);     // c^(-1)
+    fq12_mul(d, xx, fr2);
+    fq12_mul(d, d, cc);
+    // * m^3
+    Fq12 m2;
+    fq12_sq(m2, m);
+    fq12_mul(m2, m2, m);
+    fq12_mul(o, d, m2);
+}
+
+// ------------------------------------------------------------------ C ABI
+
+extern "C" {
+
+static bool initialized = false;
+
+void bls381_init() {
+    if (initialized) return;
+    init_constants();
+    // FQ12_ONE
+    memset(&FQ12_ONE, 0, sizeof(FQ12_ONE));
+    FQ12_ONE.c0.c0.c0 = FP_ONE;
+    // gammas: xi^((p-1)/6), xi^((p-1)/3), square of the latter
+    // exponents computed limb-wise: (p-1)/6 and (p-1)/3
+    u64 pm1[NLIMBS];
+    memcpy(pm1, P, sizeof(P));
+    pm1[0] -= 1;
+    // divide little-endian multiprecision by small k
+    auto div_small = [](u64* out, const u64* in, u64 k) {
+        u128 rem = 0;
+        for (int i = NLIMBS - 1; i >= 0; i--) {
+            u128 cur = (rem << 64) | in[i];
+            out[i] = (u64)(cur / k);
+            rem = cur % k;
+        }
+    };
+    u64 e6[NLIMBS], e3[NLIMBS];
+    div_small(e6, pm1, 6);
+    div_small(e3, pm1, 3);
+    Fq2 xi;
+    xi.c0 = FP_ONE;
+    xi.c1 = FP_ONE;
+    fq2_pow(G12, xi, e6, NLIMBS);
+    fq2_pow(G6_1, xi, e3, NLIMBS);
+    fq2_sq(G6_2, G6_1);
+    // W2_INV / W3_INV: w^2 = v -> as Fq12: c0 = (0, 1, 0)
+    Fq12 w2;
+    memset(&w2, 0, sizeof(w2));
+    w2.c0.c1.c0 = FP_ONE;
+    fq12_inv(W2_INV, w2);
+    Fq12 w3;  // w^3 = v*w -> c1 = (0, 1, 0)
+    memset(&w3, 0, sizeof(w3));
+    w3.c1.c1.c0 = FP_ONE;
+    fq12_inv(W3_INV, w3);
+    initialized = true;
+}
+
+// pairing product check: prod e(P_i, Q_i) == 1
+// g1s: n*96 bytes (x||y big-endian), g2s: n*192 bytes (x0||x1||y0||y1)
+int bls381_pairing_check(const uint8_t* g1s, const uint8_t* g2s, size_t n) {
+    bls381_init();
+    Fq12 acc = FQ12_ONE;
+    for (size_t i = 0; i < n; i++) {
+        Fp px, py;
+        fp_from_bytes(px, g1s + i * 96);
+        fp_from_bytes(py, g1s + i * 96 + 48);
+        Fq2 qx, qy;
+        fp_from_bytes(qx.c0, g2s + i * 192);
+        fp_from_bytes(qx.c1, g2s + i * 192 + 48);
+        fp_from_bytes(qy.c0, g2s + i * 192 + 96);
+        fp_from_bytes(qy.c1, g2s + i * 192 + 144);
+        Fq12 f;
+        miller_loop(f, px, py, qx, qy);
+        Fq12 t;
+        fq12_mul(t, acc, f);
+        acc = t;
+    }
+    Fq12 out;
+    final_exponentiation(out, acc);
+    return fq12_is_one(out) ? 1 : 0;
+}
+
+// scalar multiplication, scalar as big-endian bytes (no reduction)
+void bls381_g1_mul(uint8_t* out96, const uint8_t* in96, const uint8_t* scalar,
+                   size_t scalar_len, int* is_inf) {
+    bls381_init();
+    G1J acc = {FP_ONE, FP_ONE, FP_ZERO};
+    G1J base;
+    fp_from_bytes(base.x, in96);
+    fp_from_bytes(base.y, in96 + 48);
+    base.z = FP_ONE;
+    for (size_t i = 0; i < scalar_len; i++) {
+        uint8_t byte = scalar[i];
+        for (int bit = 7; bit >= 0; bit--) {
+            G1J t;
+            g1_double(t, acc);
+            acc = t;
+            if ((byte >> bit) & 1) {
+                g1_add(t, acc, base);
+                acc = t;
+            }
+        }
+    }
+    if (g1j_is_inf(acc)) {
+        *is_inf = 1;
+        memset(out96, 0, 96);
+        return;
+    }
+    *is_inf = 0;
+    Fp zinv, zinv2, zinv3, ax, ay;
+    fp_inv(zinv, acc.z);
+    fp_sq(zinv2, zinv);
+    fp_mul(zinv3, zinv2, zinv);
+    fp_mul(ax, acc.x, zinv2);
+    fp_mul(ay, acc.y, zinv3);
+    fp_to_bytes(out96, ax);
+    fp_to_bytes(out96 + 48, ay);
+}
+
+void bls381_g2_mul(uint8_t* out192, const uint8_t* in192, const uint8_t* scalar,
+                   size_t scalar_len, int* is_inf) {
+    bls381_init();
+    G2J acc;
+    acc.x.c0 = FP_ONE;
+    acc.x.c1 = FP_ZERO;
+    acc.y = acc.x;
+    acc.z.c0 = FP_ZERO;
+    acc.z.c1 = FP_ZERO;
+    G2J base;
+    fp_from_bytes(base.x.c0, in192);
+    fp_from_bytes(base.x.c1, in192 + 48);
+    fp_from_bytes(base.y.c0, in192 + 96);
+    fp_from_bytes(base.y.c1, in192 + 144);
+    base.z.c0 = FP_ONE;
+    base.z.c1 = FP_ZERO;
+    for (size_t i = 0; i < scalar_len; i++) {
+        uint8_t byte = scalar[i];
+        for (int bit = 7; bit >= 0; bit--) {
+            G2J t;
+            g2_double(t, acc);
+            acc = t;
+            if ((byte >> bit) & 1) {
+                g2_add(t, acc, base);
+                acc = t;
+            }
+        }
+    }
+    if (g2j_is_inf(acc)) {
+        *is_inf = 1;
+        memset(out192, 0, 192);
+        return;
+    }
+    *is_inf = 0;
+    Fq2 zinv, zinv2, zinv3, ax, ay;
+    fq2_inv(zinv, acc.z);
+    fq2_sq(zinv2, zinv);
+    fq2_mul(zinv3, zinv2, zinv);
+    fq2_mul(ax, acc.x, zinv2);
+    fq2_mul(ay, acc.y, zinv3);
+    fp_to_bytes(out192, ax.c0);
+    fp_to_bytes(out192 + 48, ax.c1);
+    fp_to_bytes(out192 + 96, ay.c0);
+    fp_to_bytes(out192 + 144, ay.c1);
+}
+
+}  // extern "C"
