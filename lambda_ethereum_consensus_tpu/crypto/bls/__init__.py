@@ -20,12 +20,14 @@ from .api import (
     sk_to_pk,
     verify,
 )
+from .batch import batch_verify
 
 __all__ = [
     "BlsError",
     "G2_POINT_AT_INFINITY",
     "aggregate",
     "aggregate_verify",
+    "batch_verify",
     "eth_aggregate_pubkeys",
     "eth_fast_aggregate_verify",
     "fast_aggregate_verify",

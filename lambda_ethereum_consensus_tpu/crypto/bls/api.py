@@ -13,6 +13,7 @@ per dispatch) plugs in behind the same functions via :mod:`.batch`.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 from typing import Sequence
 
@@ -97,8 +98,15 @@ def sign(private_key: bytes, message: bytes, dst: bytes = DST_POP) -> bytes:
     return C.g2_to_bytes(C.g2.multiply(hash_to_g2(message, dst), sk))
 
 
+@functools.lru_cache(maxsize=65536)
+def _pubkey_point(public_key: bytes) -> C.AffinePoint:
+    """Decompression+subgroup check cached per pubkey — the validator set
+    recurs on every attestation, the ~1.5 ms subgroup check need not."""
+    return C.g1_from_bytes(public_key)
+
+
 def _load_pubkey(public_key: bytes) -> C.AffinePoint:
-    pt = C.g1_from_bytes(public_key)
+    pt = _pubkey_point(bytes(public_key))
     if pt is None:
         raise BlsError("public key is the identity")
     return pt

@@ -11,7 +11,13 @@ Layout: :mod:`.store` (the Store object + constructor), :mod:`.handlers`
 :mod:`.head` (``get_head`` with batched vote-weight accumulation).
 """
 
-from .handlers import on_attestation, on_attester_slashing, on_block, on_tick
+from .handlers import (
+    on_attestation,
+    on_attestation_batch,
+    on_attester_slashing,
+    on_block,
+    on_tick,
+)
 from .head import get_head, get_weight
 from .store import ForkChoiceError, LatestMessage, Store, get_forkchoice_store
 
@@ -23,6 +29,7 @@ __all__ = [
     "get_head",
     "get_weight",
     "on_attestation",
+    "on_attestation_batch",
     "on_attester_slashing",
     "on_block",
     "on_tick",

@@ -234,6 +234,65 @@ def on_attestation(
     update_latest_messages(store, indexed.attesting_indices, attestation)
 
 
+def on_attestation_batch(
+    store: Store,
+    attestations: list[Attestation],
+    is_from_block: bool = False,
+    spec: ChainSpec | None = None,
+) -> list[ForkChoiceError | None]:
+    """Record many attestations with ONE batched signature check.
+
+    The TPU-shaped replacement for per-message verification (SURVEY.md §2.3:
+    "collect N gossip messages -> one batched verify"): structural validation
+    runs per item (through the same helper the per-item path uses), aggregate
+    pubkeys are summed from cached, already-subgroup-checked points, and all
+    signatures are checked in one random-linear-combination pairing product —
+    with bisection blame attribution when the batch fails, so one bad item
+    costs O(log N) sub-batches, not 2N pairings.  Returns one ``None``
+    (accepted) or ``ForkChoiceError`` (rejected) per input.
+    """
+    from ..crypto.bls import BlsError
+    from ..crypto.bls.api import _pubkey_point
+    from ..crypto.bls.batch import batch_verify_each_points
+    from ..crypto.bls.curve import DeserializationError, g1, g2_from_bytes
+    from ..state_transition.predicates import indexed_attestation_signature_inputs
+
+    spec = spec or get_chain_spec()
+    results: list[ForkChoiceError | None] = [None] * len(attestations)
+    prepared = []  # (index, attestation, indexed, point entry)
+    for i, attestation in enumerate(attestations):
+        try:
+            validate_on_attestation(store, attestation, is_from_block, spec)
+            store_target_checkpoint_state(store, attestation.data.target, spec)
+            target_state = store.checkpoint_states[
+                checkpoint_key(attestation.data.target)
+            ]
+            indexed = accessors.get_indexed_attestation(target_state, attestation, spec)
+            pubkeys, signing_root = indexed_attestation_signature_inputs(
+                target_state, indexed, spec
+            )
+            # sum of individually subgroup-checked (cached) points is in the
+            # subgroup — no compress/decompress/re-check round trip
+            agg_pk = None
+            for pk in pubkeys:
+                pt = _pubkey_point(pk)
+                if pt is None:
+                    raise ForkChoiceError("identity pubkey in committee")
+                agg_pk = pt if agg_pk is None else g1.affine_add(agg_pk, pt)
+            sig_pt = g2_from_bytes(bytes(indexed.signature))
+            prepared.append((i, attestation, indexed, (agg_pk, signing_root, sig_pt)))
+        except (SpecError, BlsError, DeserializationError) as e:
+            results[i] = ForkChoiceError(str(e))
+    if prepared:
+        flags = batch_verify_each_points([entry[3] for entry in prepared])
+        for (i, attestation, indexed, _), ok in zip(prepared, flags):
+            if ok:
+                update_latest_messages(store, indexed.attesting_indices, attestation)
+            else:
+                results[i] = ForkChoiceError("invalid attestation signature")
+    return results
+
+
 # -------------------------------------------------------- attester slashing
 
 def on_attester_slashing(

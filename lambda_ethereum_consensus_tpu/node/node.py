@@ -20,7 +20,7 @@ from ..fork_choice import (
     Store,
     get_forkchoice_store,
     get_head,
-    on_attestation,
+    on_attestation_batch,
     on_tick,
 )
 from ..network import Port
@@ -239,20 +239,18 @@ class BeaconNode:
         return verdicts
 
     async def _on_aggregate_batch(self, batch) -> list[int]:
-        verdicts = []
-        for msg in batch:
-            self.metrics.inc("network_gossip_count", type="aggregate_and_proof")
-            try:
-                on_attestation(
-                    self.store,
-                    msg.value.message.aggregate,
-                    is_from_block=False,
-                    spec=self.spec,
-                )
-                verdicts.append(VERDICT_ACCEPT)
-            except SpecError:
-                verdicts.append(VERDICT_IGNORE)
-        return verdicts
+        """One batched signature check for the whole gossip drain
+        (fork_choice.on_attestation_batch) instead of per-message pairings."""
+        self.metrics.inc(
+            "network_gossip_count", value=len(batch), type="aggregate_and_proof"
+        )
+        attestations = [msg.value.message.aggregate for msg in batch]
+        results = on_attestation_batch(
+            self.store, attestations, is_from_block=False, spec=self.spec
+        )
+        return [
+            VERDICT_ACCEPT if err is None else VERDICT_IGNORE for err in results
+        ]
 
     def _on_applied(self, root: bytes, signed: SignedBeaconBlock) -> None:
         self.blocks_db.store_block(signed, self.spec)

@@ -42,19 +42,22 @@ def is_slashable_attestation_data(d1: AttestationData, d2: AttestationData) -> b
     )
 
 
-def is_valid_indexed_attestation(
+def indexed_attestation_signature_inputs(
     state, indexed_attestation: IndexedAttestation, spec: ChainSpec | None = None
-) -> bool:
-    """Sorted-unique index check + aggregate signature check (the BLS hot path
-    — ref: predicates.ex:109-136)."""
+) -> tuple[list[bytes], bytes]:
+    """Structural validation + ``(pubkeys, signing_root)`` for the signature
+    check — shared by the per-item and batched verification paths so the two
+    can never drift.  Raises :class:`~.errors.OperationError` on bad indices.
+    """
     from .accessors import get_domain  # local import to avoid cycle
+    from .errors import OperationError
 
     spec = spec or get_chain_spec()
     indices = list(indexed_attestation.attesting_indices)
     if not indices or indices != sorted(set(indices)):
-        return False
+        raise OperationError("attesting indices not sorted-unique or empty")
     if any(i >= len(state.validators) for i in indices):
-        return False
+        raise OperationError("attesting index out of range")
     pubkeys = [bytes(state.validators[i].pubkey) for i in indices]
     domain = get_domain(
         state,
@@ -63,6 +66,22 @@ def is_valid_indexed_attestation(
         spec,
     )
     signing_root = misc.compute_signing_root(indexed_attestation.data, domain)
+    return pubkeys, signing_root
+
+
+def is_valid_indexed_attestation(
+    state, indexed_attestation: IndexedAttestation, spec: ChainSpec | None = None
+) -> bool:
+    """Sorted-unique index check + aggregate signature check (the BLS hot path
+    — ref: predicates.ex:109-136)."""
+    from .errors import OperationError
+
+    try:
+        pubkeys, signing_root = indexed_attestation_signature_inputs(
+            state, indexed_attestation, spec
+        )
+    except OperationError:
+        return False
     return bls.fast_aggregate_verify(
         pubkeys, signing_root, bytes(indexed_attestation.signature)
     )

@@ -213,3 +213,57 @@ def test_key_validate():
 def test_keygen_produces_valid_key():
     sk = bls.keygen(b"\x42" * 32)
     assert bls.key_validate(bls.sk_to_pk(sk))
+
+
+# ------------------------------------------------------------ batch verify
+
+def test_batch_verify_mixed_messages():
+    sks = [(i + 30).to_bytes(32, "big") for i in range(6)]
+    items = []
+    for i, sk in enumerate(sks):
+        msg = b"msg-%d" % (i % 2)  # two distinct messages -> grouping path
+        items.append((bls.sk_to_pk(sk), msg, bls.sign(sk, msg)))
+    assert bls.batch_verify(items)
+
+
+def test_batch_verify_detects_single_bad_item():
+    sks = [(i + 40).to_bytes(32, "big") for i in range(4)]
+    items = [
+        (bls.sk_to_pk(sk), b"batch message", bls.sign(sk, b"batch message"))
+        for sk in sks
+    ]
+    assert bls.batch_verify(items)
+    bad = list(items)
+    bad[2] = (bad[2][0], b"batch message", bls.sign(sks[0], b"forged"))
+    assert not bls.batch_verify(bad)
+
+
+def test_batch_verify_empty_and_garbage():
+    assert bls.batch_verify([])
+    assert not bls.batch_verify([(b"\x00" * 48, b"m", b"\x00" * 96)])
+    assert not bls.batch_verify(
+        [(bls.sk_to_pk(SK1), b"m", bls.G2_POINT_AT_INFINITY)]
+    )
+
+
+def test_batch_verify_each_points_bisection_blames_correctly():
+    from lambda_ethereum_consensus_tpu.crypto.bls.api import _pubkey_point
+    from lambda_ethereum_consensus_tpu.crypto.bls.batch import (
+        batch_verify_each_points,
+    )
+    from lambda_ethereum_consensus_tpu.crypto.bls.curve import g2_from_bytes
+
+    sks = [(i + 50).to_bytes(32, "big") for i in range(7)]
+    entries = []
+    for i, sk in enumerate(sks):
+        msg = b"bisect-%d" % i
+        signer = sks[0] if i in (2, 5) else sk  # items 2 and 5 are forged
+        entries.append(
+            (
+                _pubkey_point(bls.sk_to_pk(sk)),
+                msg,
+                g2_from_bytes(bls.sign(signer, msg)),
+            )
+        )
+    flags = batch_verify_each_points(entries)
+    assert flags == [True, True, False, True, True, False, True]

@@ -184,6 +184,46 @@ def test_attestation_for_unknown_block_rejected(chain):
             on_attestation(store, att, spec=spec)
 
 
+def test_on_attestation_batch_mixed_validity(chain):
+    from lambda_ethereum_consensus_tpu.fork_choice import on_attestation_batch
+
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = make_store(genesis, anchor_block, spec)
+        on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+        signed1, _ = build_block(genesis, spec, 1)
+        root1 = on_block(store, signed1, spec=spec)
+
+        def make_att(committee_index, good=True):
+            committee = accessors.get_beacon_committee(
+                store.block_states[root1], 1, committee_index, spec
+            )
+            data = AttestationData(
+                slot=1,
+                index=committee_index,
+                beacon_block_root=root1,
+                source=store.justified_checkpoint,
+                target=Checkpoint(epoch=0, root=anchor_root),
+            )
+            domain = accessors.get_domain(
+                store.block_states[root1], constants.DOMAIN_BEACON_ATTESTER, 0, spec
+            )
+            signing_root = misc.compute_signing_root(data, domain)
+            signers = committee if good else [0] * len(committee)  # wrong keys
+            sigs = [bls.sign(SKS[i], signing_root) for i in signers]
+            return Attestation(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                signature=bls.aggregate(sigs),
+            )
+
+        atts = [make_att(0), make_att(1, good=False)]
+        results = on_attestation_batch(store, atts, spec=spec)
+        assert results[0] is None  # valid one accepted
+        assert results[1] is not None  # forged one attributed and rejected
+        assert get_weight(store, root1, spec) > 0
+
+
 def test_on_tick_pulls_up_checkpoints(chain):
     genesis, anchor_block, spec = chain
     with use_chain_spec(spec):
