@@ -39,16 +39,24 @@ _COEFF_BITS = 128
 PointEntry = tuple
 
 
-def verify_points(entries: Sequence[PointEntry], dst: bytes = DST_POP) -> bool:
+def verify_points(
+    entries: Sequence[PointEntry],
+    dst: bytes = DST_POP,
+    message_points: dict[bytes, C.AffinePoint] | None = None,
+) -> bool:
     """The core RLC check over already-decompressed, subgroup-checked points.
 
     Callers that build aggregate pubkeys from individually-validated keys
     skip the compress/decompress/subgroup-check round trip entirely.
+    ``message_points`` memoizes ``hash_to_g2`` across calls (the bisection
+    path re-checks sub-batches and must not re-run the SWU map each time).
     """
     if not entries:
         return True
     if any(pk is None or sig is None for pk, _, sig in entries):
         return False
+    if message_points is None:
+        message_points = {}
     coeffs = [secrets.randbits(_COEFF_BITS) | 1 for _ in entries]
     by_message: dict[bytes, C.AffinePoint] = {}
     sig_acc: C.AffinePoint = None
@@ -61,10 +69,12 @@ def verify_points(entries: Sequence[PointEntry], dst: bytes = DST_POP) -> bool:
         scaled_sig = C.g2.multiply_raw(sig_pt, r)
         sig_acc = scaled_sig if sig_acc is None else C.g2.affine_add(sig_acc, scaled_sig)
 
-    pairs: list[tuple[C.AffinePoint, C.AffinePoint]] = [
-        (pk_sum, hash_to_g2(message, dst))
-        for message, pk_sum in by_message.items()
-    ]
+    pairs: list[tuple[C.AffinePoint, C.AffinePoint]] = []
+    for message, pk_sum in by_message.items():
+        h = message_points.get(message)
+        if h is None:
+            h = message_points[message] = hash_to_g2(message, dst)
+        pairs.append((pk_sum, h))
     pairs.append((C.g1.affine_neg(C.G1_GENERATOR), sig_acc))
     return pairing_check(pairs)
 
@@ -74,9 +84,12 @@ def batch_verify_each_points(
 ) -> list[bool]:
     """Per-entry validity with bisection blame attribution."""
     flags = [False] * len(entries)
+    message_points: dict[bytes, C.AffinePoint] = {}
 
     def rec(index_range: list[int]) -> None:
-        if verify_points([entries[i] for i in index_range], dst):
+        if verify_points(
+            [entries[i] for i in index_range], dst, message_points
+        ):
             for i in index_range:
                 flags[i] = True
             return

@@ -211,6 +211,19 @@ def update_latest_messages(
             )
 
 
+def _prepare_attestation(
+    store: Store, attestation: Attestation, is_from_block: bool, spec: ChainSpec
+):
+    """Shared validation prefix of the per-item and batched paths: fork-choice
+    checks, checkpoint-state materialization, committee resolution.  Returns
+    ``(target_state, indexed_attestation)``."""
+    validate_on_attestation(store, attestation, is_from_block, spec)
+    store_target_checkpoint_state(store, attestation.data.target, spec)
+    target_state = store.checkpoint_states[checkpoint_key(attestation.data.target)]
+    indexed = accessors.get_indexed_attestation(target_state, attestation, spec)
+    return target_state, indexed
+
+
 def on_attestation(
     store: Store,
     attestation: Attestation,
@@ -220,11 +233,10 @@ def on_attestation(
     """Validate and record an attestation's LMD vote
     (ref: handlers.ex:100-119)."""
     spec = spec or get_chain_spec()
-    validate_on_attestation(store, attestation, is_from_block, spec)
-    store_target_checkpoint_state(store, attestation.data.target, spec)
-    target_state = store.checkpoint_states[checkpoint_key(attestation.data.target)]
     try:
-        indexed = accessors.get_indexed_attestation(target_state, attestation, spec)
+        target_state, indexed = _prepare_attestation(
+            store, attestation, is_from_block, spec
+        )
         expect(
             is_valid_indexed_attestation(target_state, indexed, spec),
             "invalid attestation signature",
@@ -262,12 +274,9 @@ def on_attestation_batch(
     prepared = []  # (index, attestation, indexed, point entry)
     for i, attestation in enumerate(attestations):
         try:
-            validate_on_attestation(store, attestation, is_from_block, spec)
-            store_target_checkpoint_state(store, attestation.data.target, spec)
-            target_state = store.checkpoint_states[
-                checkpoint_key(attestation.data.target)
-            ]
-            indexed = accessors.get_indexed_attestation(target_state, attestation, spec)
+            target_state, indexed = _prepare_attestation(
+                store, attestation, is_from_block, spec
+            )
             pubkeys, signing_root = indexed_attestation_signature_inputs(
                 target_state, indexed, spec
             )

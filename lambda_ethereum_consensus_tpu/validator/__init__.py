@@ -5,6 +5,20 @@ a standalone framework also needs the production side — devnets, fixtures and
 integration tests all mint real signed blocks/attestations through here.
 """
 
-from .duties import build_signed_block, make_attestation, sign_block
+from .duties import (
+    build_aggregate_and_proof,
+    build_signed_block,
+    get_slot_signature,
+    is_aggregator,
+    make_attestation,
+    sign_block,
+)
 
-__all__ = ["build_signed_block", "make_attestation", "sign_block"]
+__all__ = [
+    "build_aggregate_and_proof",
+    "build_signed_block",
+    "get_slot_signature",
+    "is_aggregator",
+    "make_attestation",
+    "sign_block",
+]

@@ -102,6 +102,55 @@ def build_signed_block(
     return signed, post
 
 
+def get_slot_signature(state, slot: int, secret_key: bytes, spec: ChainSpec) -> bytes:
+    """Selection proof: signature over the slot (validator spec)."""
+    domain = accessors.get_domain(
+        state, constants.DOMAIN_SELECTION_PROOF, misc.compute_epoch_at_slot(slot, spec), spec
+    )
+    # slot is a uint64; the epoch-root helper is generic over any uint64
+    return bls.sign(secret_key, misc.compute_signing_root_epoch(int(slot), domain))
+
+
+def is_aggregator(
+    state, slot: int, committee_index: int, selection_proof: bytes, spec: ChainSpec
+) -> bool:
+    """Hash-of-proof lottery selecting ~TARGET_AGGREGATORS_PER_COMMITTEE
+    members (validator spec)."""
+    committee = accessors.get_beacon_committee(state, slot, committee_index, spec)
+    modulo = max(
+        1, len(committee) // constants.TARGET_AGGREGATORS_PER_COMMITTEE
+    )
+    digest = misc.hash_bytes(selection_proof)
+    return int.from_bytes(digest[:8], "little") % modulo == 0
+
+
+def build_aggregate_and_proof(
+    state,
+    aggregator_index: int,
+    aggregate: Attestation,
+    secret_key: bytes,
+    spec: ChainSpec,
+):
+    """SignedAggregateAndProof for gossip publication (validator spec)."""
+    from ..types.validator import AggregateAndProof, SignedAggregateAndProof
+
+    proof = AggregateAndProof(
+        aggregator_index=aggregator_index,
+        aggregate=aggregate,
+        selection_proof=get_slot_signature(
+            state, aggregate.data.slot, secret_key, spec
+        ),
+    )
+    domain = accessors.get_domain(
+        state,
+        constants.DOMAIN_AGGREGATE_AND_PROOF,
+        misc.compute_epoch_at_slot(aggregate.data.slot, spec),
+        spec,
+    )
+    signature = bls.sign(secret_key, misc.compute_signing_root(proof, domain))
+    return SignedAggregateAndProof(message=proof, signature=signature)
+
+
 def make_attestation(
     state: BeaconState,
     slot: int,

@@ -1,0 +1,75 @@
+"""Chain-replay benchmark: BASELINE.md scenario 5 (block state-transition replay).
+
+Mints a devnet chain with real signatures, then measures full-validation
+replay throughput (signature + state-root checks on) — the fork-choice
+on_block hot path.  Prints one JSON line per phase.
+
+Usage: python scripts/bench_replay.py [n_validators] [n_blocks]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.state_transition.core import state_transition
+from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
+from lambda_ethereum_consensus_tpu.validator import build_signed_block
+
+
+def main() -> None:
+    n_validators = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    n_blocks = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    sks = [(i + 1).to_bytes(32, "big") for i in range(n_validators)]
+    with use_chain_spec(minimal_spec()) as spec:
+        genesis = build_genesis_state([bls.sk_to_pk(sk) for sk in sks], spec=spec)
+
+        t0 = time.perf_counter()
+        blocks = []
+        state = genesis
+        for slot in range(1, n_blocks + 1):
+            signed, state = build_signed_block(state, slot, sks, spec=spec)
+            blocks.append(signed)
+        t_mint = time.perf_counter() - t0
+        print(
+            json.dumps(
+                {
+                    "metric": "block_production",
+                    "value": round(n_blocks / t_mint, 2),
+                    "unit": "blocks/s",
+                    "n_validators": n_validators,
+                }
+            )
+        )
+
+        t0 = time.perf_counter()
+        replay_state = genesis
+        for signed in blocks:
+            replay_state = state_transition(
+                replay_state, signed, validate_result=True, spec=spec
+            )
+        t_replay = time.perf_counter() - t0
+        assert replay_state.hash_tree_root(spec) == state.hash_tree_root(spec)
+        print(
+            json.dumps(
+                {
+                    "metric": "full_validation_replay",
+                    "value": round(n_blocks / t_replay, 2),
+                    "unit": "blocks/s",
+                    "n_validators": n_validators,
+                    "slot_budget_used": round(
+                        t_replay / n_blocks / spec.SECONDS_PER_SLOT, 3
+                    ),
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()
