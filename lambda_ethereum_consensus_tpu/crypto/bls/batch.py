@@ -42,7 +42,7 @@ PointEntry = tuple
 def verify_points(
     entries: Sequence[PointEntry],
     dst: bytes = DST_POP,
-    message_points: dict[bytes, C.AffinePoint] | None = None,
+    message_points: dict[tuple[bytes, bytes], C.AffinePoint] | None = None,
 ) -> bool:
     """The core RLC check over already-decompressed, subgroup-checked points.
 
@@ -71,9 +71,9 @@ def verify_points(
 
     pairs: list[tuple[C.AffinePoint, C.AffinePoint]] = []
     for message, pk_sum in by_message.items():
-        h = message_points.get(message)
+        h = message_points.get((message, dst))
         if h is None:
-            h = message_points[message] = hash_to_g2(message, dst)
+            h = message_points[(message, dst)] = hash_to_g2(message, dst)
         pairs.append((pk_sum, h))
     pairs.append((C.g1.affine_neg(C.G1_GENERATOR), sig_acc))
     return pairing_check(pairs)
@@ -84,7 +84,7 @@ def batch_verify_each_points(
 ) -> list[bool]:
     """Per-entry validity with bisection blame attribution."""
     flags = [False] * len(entries)
-    message_points: dict[bytes, C.AffinePoint] = {}
+    message_points: dict[tuple[bytes, bytes], C.AffinePoint] = {}
 
     def rec(index_range: list[int]) -> None:
         if verify_points(

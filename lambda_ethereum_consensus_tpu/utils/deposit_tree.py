@@ -1,0 +1,51 @@
+"""Incremental deposit Merkle tree (the eth1 deposit contract's structure).
+
+Depth-32 sparse Merkle tree over ``DepositData`` roots with the deposit-count
+mix-in, producing the ``deposit_root`` the beacon state carries and the
+33-element proofs ``process_deposit`` verifies (ref: operations.ex deposit
+handling; spec: is_valid_merkle_branch with DEPOSIT_CONTRACT_TREE_DEPTH + 1).
+Used by devnets and tests to mint provable deposits.
+"""
+
+from __future__ import annotations
+
+from ..config import constants
+from ..ssz.hash import ZERO_HASHES, sha256
+
+DEPTH = constants.DEPOSIT_CONTRACT_TREE_DEPTH
+
+
+class DepositTree:
+    def __init__(self):
+        self.leaves: list[bytes] = []
+
+    def push(self, deposit_data_root: bytes) -> None:
+        self.leaves.append(deposit_data_root)
+
+    def _node(self, level: int, index: int) -> bytes:
+        """Root of the subtree at ``level`` (0 = leaves) covering
+        ``[index * 2^level, (index+1) * 2^level)``."""
+        span_start = index << level
+        if span_start >= len(self.leaves):
+            return ZERO_HASHES[level]
+        if level == 0:
+            return self.leaves[index]
+        left = self._node(level - 1, index * 2)
+        right = self._node(level - 1, index * 2 + 1)
+        return sha256(left + right)
+
+    def root(self) -> bytes:
+        """deposit_root: tree root with the count mixed in (little-endian)."""
+        tree_root = self._node(DEPTH, 0)
+        return sha256(tree_root + len(self.leaves).to_bytes(32, "little"))
+
+    def proof(self, index: int) -> list[bytes]:
+        """33-element branch for leaf ``index``: the 32 tree siblings plus
+        the count mix-in leaf."""
+        assert 0 <= index < len(self.leaves)
+        branch = []
+        for level in range(DEPTH):
+            sibling_index = (index >> level) ^ 1
+            branch.append(self._node(level, sibling_index))
+        branch.append(len(self.leaves).to_bytes(32, "little"))
+        return branch

