@@ -1,10 +1,15 @@
-"""Incremental deposit Merkle tree (the eth1 deposit contract's structure).
+"""Deposit Merkle tree (the eth1 deposit contract's structure).
 
 Depth-32 sparse Merkle tree over ``DepositData`` roots with the deposit-count
 mix-in, producing the ``deposit_root`` the beacon state carries and the
 33-element proofs ``process_deposit`` verifies (ref: operations.ex deposit
 handling; spec: is_valid_merkle_branch with DEPOSIT_CONTRACT_TREE_DEPTH + 1).
 Used by devnets and tests to mint provable deposits.
+
+This is the straightforward recompute-from-leaves implementation —
+``root()``/``proof()`` are O(n * depth) per call, which is fine at devnet
+scale; the eth1 contract's O(depth)-per-update branch cache can replace the
+internals later without changing the interface.
 """
 
 from __future__ import annotations

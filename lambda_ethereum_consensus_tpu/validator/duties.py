@@ -20,12 +20,16 @@ from ..state_transition.mutable import BeaconStateMut
 from ..types.beacon import (
     Attestation,
     AttestationData,
+    AttesterSlashing,
     BeaconBlock,
     BeaconBlockBody,
     BeaconState,
     Checkpoint,
     ExecutionPayload,
+    ProposerSlashing,
     SignedBeaconBlock,
+    SignedBLSToExecutionChange,
+    SignedVoluntaryExit,
     SyncAggregate,
 )
 
@@ -43,6 +47,10 @@ def build_signed_block(
     slot: int,
     secret_keys: Sequence[bytes],
     attestations: Sequence[Attestation] = (),
+    proposer_slashings: Sequence["ProposerSlashing"] = (),
+    attester_slashings: Sequence["AttesterSlashing"] = (),
+    voluntary_exits: Sequence["SignedVoluntaryExit"] = (),
+    bls_to_execution_changes: Sequence["SignedBLSToExecutionChange"] = (),
     graffiti: bytes = b"\x00" * 32,
     spec: ChainSpec | None = None,
 ) -> tuple[SignedBeaconBlock, BeaconState]:
@@ -74,7 +82,11 @@ def build_signed_block(
         randao_reveal=randao_reveal,
         eth1_data=pre.eth1_data,
         graffiti=graffiti,
+        proposer_slashings=list(proposer_slashings),
+        attester_slashings=list(attester_slashings),
         attestations=list(attestations),
+        voluntary_exits=list(voluntary_exits),
+        bls_to_execution_changes=list(bls_to_execution_changes),
         sync_aggregate=SyncAggregate(
             sync_committee_signature=bls.G2_POINT_AT_INFINITY
         ),

@@ -19,13 +19,11 @@ from lambda_ethereum_consensus_tpu.types.beacon import (
     DepositData,
     DepositMessage,
     ProposerSlashing,
-    SignedBeaconBlock,
     SignedVoluntaryExit,
     VoluntaryExit,
 )
 from lambda_ethereum_consensus_tpu.utils.deposit_tree import DepositTree
 from lambda_ethereum_consensus_tpu.validator import build_signed_block, make_attestation
-from lambda_ethereum_consensus_tpu.validator.duties import sign_block
 
 N = 64
 SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
@@ -101,12 +99,13 @@ def test_voluntary_exit_through_block(chain):
                 SKS[exiting], misc.compute_signing_root(exit_msg, domain)
             ),
         )
-        from lambda_ethereum_consensus_tpu.state_transition.operations import (
-            process_voluntary_exit,
+        # through a real block with full validation
+        signed2, post2 = build_signed_block(
+            post1, 2, SKS, voluntary_exits=[signed_exit], spec=spec2
         )
-
-        process_voluntary_exit(ws, signed_exit, spec2)
-        v = ws.validators[exiting]
+        replay = state_transition(post1, signed2, validate_result=True, spec=spec2)
+        assert replay.hash_tree_root(spec2) == post2.hash_tree_root(spec2)
+        v = post2.validators[exiting]
         assert v.exit_epoch != constants.FAR_FUTURE_EPOCH
         assert v.withdrawable_epoch == (
             v.exit_epoch + spec2.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
@@ -147,17 +146,18 @@ def test_proposer_slashing_through_block(chain):
             signed_header_1=sign_header(header(b"\xaa" * 32)),
             signed_header_2=sign_header(header(b"\xbb" * 32)),
         )
-        balance_before = ws.balances[offender]
-        from lambda_ethereum_consensus_tpu.state_transition.operations import (
-            process_proposer_slashing,
+        balance_before = post1.balances[offender]
+        # through a real block with full validation
+        signed2, post2 = build_signed_block(
+            post1, 2, SKS, proposer_slashings=[slashing], spec=spec
         )
+        replay = state_transition(post1, signed2, validate_result=True, spec=spec)
+        assert replay.hash_tree_root(spec) == post2.hash_tree_root(spec)
+        assert post2.validators[offender].slashed
+        assert post2.balances[offender] < balance_before
 
-        process_proposer_slashing(ws, slashing, spec)
-        assert ws.validators[offender].slashed
-        assert ws.balances[offender] < balance_before
 
-
-def test_attester_slashing_through_operations(chain):
+def test_attester_slashing_through_block(chain):
     spec, genesis, signed1, post1 = chain
     with use_chain_spec(spec):
         ws = BeaconStateMut(process_slots(post1, 2, spec))
@@ -187,16 +187,16 @@ def test_attester_slashing_through_operations(chain):
                 signature=bls.aggregate(sigs),
             )
 
-        # double vote: same target epoch, different data
+        # double vote: same target epoch, different data — through a block
         slashing = AttesterSlashing(
             attestation_1=indexed(b"\xca" * 32), attestation_2=indexed(b"\xcb" * 32)
         )
-        from lambda_ethereum_consensus_tpu.state_transition.operations import (
-            process_attester_slashing,
+        signed2, post2 = build_signed_block(
+            post1, 2, SKS, attester_slashings=[slashing], spec=spec
         )
-
-        process_attester_slashing(ws, slashing, spec)
-        assert all(ws.validators[i].slashed for i in committee)
+        replay = state_transition(post1, signed2, validate_result=True, spec=spec)
+        assert replay.hash_tree_root(spec) == post2.hash_tree_root(spec)
+        assert all(post2.validators[i].slashed for i in committee)
 
 
 def test_deposit_with_real_merkle_proof(chain):
