@@ -654,125 +654,229 @@ static void g2_add(G2J& o, const G2J& p, const G2J& q) {
 
 // ------------------------------------------------------------ Miller loop
 //
-// Same structure as the Python pairing: untwist Q into Fq12 affine
-// coordinates, affine double/add steps with one combined inversion.
-
-struct G2A {
-    Fq12 x, y;  // untwisted coordinates in Fq12
-};
-
-static Fq12 W2_INV, W3_INV;  // w^-2, w^-3, computed at init
-
-static void fq12_from_fq2_slot0(Fq12& o, const Fq2& a) {
-    memset(&o, 0, sizeof(o));
-    o.c0.c0 = a;
-}
-
-static void untwist(G2A& o, const Fq2& qx, const Fq2& qy) {
-    Fq12 ex, ey;
-    fq12_from_fq2_slot0(ex, qx);
-    fq12_from_fq2_slot0(ey, qy);
-    fq12_mul(o.x, ex, W2_INV);
-    fq12_mul(o.y, ey, W3_INV);
-}
-
-static void fq12_sub3(Fq12& o, const Fq12& a, const Fq12& b) {
-    fq6_sub(o.c0, a.c0, b.c0);
-    fq6_sub(o.c1, a.c1, b.c1);
-}
-static void fq12_add3(Fq12& o, const Fq12& a, const Fq12& b) {
-    fq6_add(o.c0, a.c0, b.c0);
-    fq6_add(o.c1, a.c1, b.c1);
-}
+// Twist-coordinate affine steps with sparse line multiplication.  With the
+// untwist x = X/w^2, y = Y/w^3 and w^6 = xi, the line through the running
+// point r evaluated at P = (px, py) in G1 is (after scaling by xi, legal
+// because subfield factors die under the final exponentiation's p^6-1 part):
+//
+//   l = (py * xi) * w^0  +  (lambda*X_r - Y_r) * w^3  +  (-lambda*px) * w^5
+//
+// i.e. three Fq2 coefficients at tower slots c0.c0 / c1.c1 / c1.c2 — so the
+// f update is a sparse multiplication (18 fq2 muls) instead of a generic
+// fq12 mul, and all point arithmetic stays in Fq2.
 
 static const u64 BLS_X = 0xd201000000010000ULL;  // |x|, parameter is negative
 
-static bool fq12_eq(const Fq12& a, const Fq12& b) {
-    const Fq2* as[] = {&a.c0.c0, &a.c0.c1, &a.c0.c2, &a.c1.c0, &a.c1.c1, &a.c1.c2};
-    const Fq2* bs[] = {&b.c0.c0, &b.c0.c1, &b.c0.c2, &b.c1.c0, &b.c1.c1, &b.c1.c2};
-    for (int i = 0; i < 6; i++)
-        if (!fq2_eq(*as[i], *bs[i])) return false;
-    return true;
+struct G2Aff {
+    Fq2 x, y;
+};
+
+static inline void fq2_mul_fp(Fq2& o, const Fq2& a, const Fp& s) {
+    fp_mul(o.c0, a.c0, s);
+    fp_mul(o.c1, a.c1, s);
 }
 
-// line through r (doubling) or r,q (addition) evaluated at P, then advance r.
-// Returns false when the line is vertical (result point at infinity) — the
-// Python oracle's `r2 is None` case, which terminates the Miller loop.
-static bool line_and_step(Fq12& line, G2A& r, const G2A& q, const Fq12& px,
-                          const Fq12& py, bool doubling) {
-    Fq12 num, den, slope, t;
-    bool as_doubling = doubling || (fq12_eq(r.x, q.x) && fq12_eq(r.y, q.y));
-    if (as_doubling) {
-        // slope = 3 x^2 / 2 y
-        fq12_mul(t, r.x, r.x);
-        fq12_add3(num, t, t);
-        fq12_add3(num, num, t);
-        fq12_add3(den, r.y, r.y);
-    } else if (fq12_eq(r.x, q.x)) {
-        // vertical line: l(P) = px - r.x, result is the point at infinity
-        fq12_sub3(line, px, r.x);
-        return false;
-    } else {
-        fq12_sub3(num, q.y, r.y);
-        fq12_sub3(den, q.x, r.x);
+// f *= a + b*w^3 + c*w^5, with slot(w^k): 0->c0.c0 1->c1.c0 2->c0.c1
+// 3->c1.c1 4->c0.c2 5->c1.c2 and w^6 = xi.
+static void fq12_mul_sparse035(Fq12& f, const Fq2& a, const Fq2& b, const Fq2& c) {
+    const Fq2* fs[6] = {&f.c0.c0, &f.c1.c0, &f.c0.c1, &f.c1.c1, &f.c0.c2, &f.c1.c2};
+    Fq2 out[6];
+    memset(out, 0, sizeof(out));
+    struct {
+        const Fq2* coeff;
+        int pow;
+    } ls[3] = {{&a, 0}, {&b, 3}, {&c, 5}};
+    for (int i = 0; i < 6; i++) {
+        for (int j = 0; j < 3; j++) {
+            int k = i + ls[j].pow;
+            Fq2 prod;
+            fq2_mul(prod, *fs[i], *ls[j].coeff);
+            if (k >= 6) {
+                k -= 6;
+                Fq2 shifted;
+                fq2_mul_by_xi(shifted, prod);
+                prod = shifted;
+            }
+            Fq2 sum;
+            fq2_add(sum, out[k], prod);
+            out[k] = sum;
+        }
     }
-    Fq12 dinv;
-    fq12_inv(dinv, den);
-    fq12_mul(slope, num, dinv);
-    // line = (py - r.y) - slope*(px - r.x)
-    Fq12 dy, dx, sdx;
-    fq12_sub3(dy, py, r.y);
-    fq12_sub3(dx, px, r.x);
-    fq12_mul(sdx, slope, dx);
-    fq12_sub3(line, dy, sdx);
-    // advance
-    Fq12 x3, y3;
-    fq12_mul(t, slope, slope);
-    fq12_sub3(x3, t, r.x);
-    const Fq12& other_x = as_doubling ? r.x : q.x;
-    fq12_sub3(x3, x3, other_x);
-    fq12_sub3(t, r.x, x3);
-    fq12_mul(t, slope, t);
-    fq12_sub3(y3, t, r.y);
-    r.x = x3;
-    r.y = y3;
-    return true;
+    f.c0.c0 = out[0];
+    f.c1.c0 = out[1];
+    f.c0.c1 = out[2];
+    f.c1.c1 = out[3];
+    f.c0.c2 = out[4];
+    f.c1.c2 = out[5];
 }
 
-static void miller_loop(Fq12& f, const Fp& px_, const Fp& py_, const Fq2& qx,
-                        const Fq2& qy) {
-    G2A q, r;
-    untwist(q, qx, qy);
-    r = q;
-    Fq12 px, py;
-    memset(&px, 0, sizeof(px));
-    memset(&py, 0, sizeof(py));
-    px.c0.c0.c0 = px_;
-    py.c0.c0.c0 = py_;
-    f = FQ12_ONE;
-    // bits of |x| after the MSB (63 down to 0 of a 64-bit value with MSB at 63)
+// f *= a + b*w^4 (the vertical-line shape: l*xi = px*xi - X_r * w^4)
+static void fq12_mul_sparse04(Fq12& f, const Fq2& a, const Fq2& b) {
+    const Fq2* fs[6] = {&f.c0.c0, &f.c1.c0, &f.c0.c1, &f.c1.c1, &f.c0.c2, &f.c1.c2};
+    Fq2 out[6];
+    memset(out, 0, sizeof(out));
+    for (int i = 0; i < 6; i++) {
+        Fq2 p0;
+        fq2_mul(p0, *fs[i], a);
+        Fq2 s0;
+        fq2_add(s0, out[i], p0);
+        out[i] = s0;
+        int k = i + 4;
+        Fq2 p1;
+        fq2_mul(p1, *fs[i], b);
+        if (k >= 6) {
+            k -= 6;
+            Fq2 sh;
+            fq2_mul_by_xi(sh, p1);
+            p1 = sh;
+        }
+        Fq2 s1;
+        fq2_add(s1, out[k], p1);
+        out[k] = s1;
+    }
+    f.c0.c0 = out[0];
+    f.c1.c0 = out[1];
+    f.c0.c1 = out[2];
+    f.c1.c1 = out[3];
+    f.c0.c2 = out[4];
+    f.c1.c2 = out[5];
+}
+
+// ------------------------------------------------ lockstep multi-pair loop
+//
+// All pairs advance through the Miller loop together; the per-step slope
+// denominators are inverted with ONE field inversion via Montgomery's batch
+// trick (3(n-1) muls + 1 inv), so inversion cost is O(steps) instead of
+// O(steps * pairs).
+
+static void fq2_batch_inv(Fq2* vals, size_t n, Fq2* prefix /* scratch, >= n */) {
+    if (n == 0) return;
+    prefix[0] = vals[0];
+    for (size_t i = 1; i < n; i++) fq2_mul(prefix[i], prefix[i - 1], vals[i]);
+    Fq2 inv_all;
+    fq2_inv(inv_all, prefix[n - 1]);
+    for (size_t i = n; i-- > 1;) {
+        Fq2 vi;
+        fq2_mul(vi, inv_all, prefix[i - 1]);  // inverse of vals[i]
+        Fq2 next;
+        fq2_mul(next, inv_all, vals[i]);
+        vals[i] = vi;
+        inv_all = next;
+    }
+    vals[0] = inv_all;
+}
+
+struct PairSt {
+    Fp px, py;
+    G2Aff q, r;
+    Fq12 f;
+    bool dead;  // vertical addition hit: f is final for this pair
+};
+
+// compute (num, den) for pair i's step; mirrors step_line's branch logic
+static int step_num_den(PairSt& s, bool doubling, Fq2& num, Fq2& den) {
+    // returns 0 normal, 1 vertical
+    bool as_doubling =
+        doubling || (fq2_eq(s.r.x, s.q.x) && fq2_eq(s.r.y, s.q.y));
+    if (as_doubling) {
+        Fq2 t;
+        fq2_sq(t, s.r.x);
+        fq2_add(num, t, t);
+        fq2_add(num, num, t);
+        fq2_add(den, s.r.y, s.r.y);
+        return 0;
+    }
+    if (fq2_eq(s.r.x, s.q.x)) return 1;
+    fq2_sub(num, s.q.y, s.r.y);
+    fq2_sub(den, s.q.x, s.r.x);
+    return 0;
+}
+
+static void step_finish(PairSt& s, const Fq2& lambda, bool doubling) {
+    bool as_doubling =
+        doubling || (fq2_eq(s.r.x, s.q.x) && fq2_eq(s.r.y, s.q.y));
+    Fq2 la, lb, lc, t;
+    Fq2 pye = {s.py, FP_ZERO};
+    fq2_mul_by_xi(la, pye);
+    fq2_mul(t, lambda, s.r.x);
+    fq2_sub(lb, t, s.r.y);
+    fq2_mul_fp(lc, lambda, s.px);
+    Fq2 neg;
+    fq2_neg(neg, lc);
+    lc = neg;
+    Fq2 x3, y3;
+    fq2_sq(t, lambda);
+    fq2_sub(x3, t, s.r.x);
+    const Fq2& other_x = as_doubling ? s.r.x : s.q.x;
+    fq2_sub(x3, x3, other_x);
+    fq2_sub(t, s.r.x, x3);
+    fq2_mul(t, lambda, t);
+    fq2_sub(y3, t, s.r.y);
+    s.r.x = x3;
+    s.r.y = y3;
+    fq12_mul_sparse035(s.f, la, lb, lc);
+}
+
+static void miller_loop_many(PairSt* pairs, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        pairs[i].f = FQ12_ONE;
+        pairs[i].r = pairs[i].q;
+        pairs[i].dead = false;
+    }
+    Fq2* dens = new Fq2[n];
+    Fq2* nums = new Fq2[n];
+    Fq2* scratch = new Fq2[n];
+    size_t* idx = new size_t[n];
     int started = 0;
     for (int bit = 63; bit >= 0; bit--) {
         u64 mask = 1ULL << bit;
         if (!started) {
-            if (BLS_X & mask) started = 1;  // skip the MSB itself
+            if (BLS_X & mask) started = 1;
             continue;
         }
-        Fq12 line;
-        line_and_step(line, r, r, px, py, true);
-        Fq12 f2;
-        fq12_sq(f2, f);
-        fq12_mul(f, f2, line);
-        if (BLS_X & mask) {
-            bool alive = line_and_step(line, r, q, px, py, false);
-            fq12_mul(f, f, line);
-            if (!alive) break;  // mirror the Python oracle's early exit
+        for (int phase = 0; phase < ((BLS_X & mask) ? 2 : 1); phase++) {
+            bool doubling = (phase == 0);
+            size_t m = 0;
+            for (size_t i = 0; i < n; i++) {
+                if (pairs[i].dead) continue;
+                if (doubling) {
+                    Fq12 f2;
+                    fq12_sq(f2, pairs[i].f);
+                    pairs[i].f = f2;
+                }
+                Fq2 num, den;
+                int kind = step_num_den(pairs[i], doubling, num, den);
+                if (kind == 1) {  // vertical addition: finalize this pair
+                    Fq2 la, vb;
+                    Fq2 pxe = {pairs[i].px, FP_ZERO};
+                    fq2_mul_by_xi(la, pxe);
+                    fq2_neg(vb, pairs[i].r.x);
+                    fq12_mul_sparse04(pairs[i].f, la, vb);
+                    pairs[i].dead = true;
+                    continue;
+                }
+                nums[m] = num;
+                dens[m] = den;
+                idx[m] = i;
+                m++;
+            }
+            fq2_batch_inv(dens, m, scratch);
+            for (size_t j = 0; j < m; j++) {
+                Fq2 lambda;
+                fq2_mul(lambda, nums[j], dens[j]);
+                step_finish(pairs[idx[j]], lambda, doubling);
+            }
         }
     }
-    // negative x: conjugate
-    Fq12 c;
-    fq12_conj(c, f);
-    f = c;
+    for (size_t i = 0; i < n; i++) {
+        Fq12 c;
+        fq12_conj(c, pairs[i].f);
+        pairs[i].f = c;
+    }
+    delete[] dens;
+    delete[] nums;
+    delete[] scratch;
+    delete[] idx;
 }
 
 static void fq12_pow_x(Fq12& o, const Fq12& a) {  // a^x, x negative
@@ -857,15 +961,6 @@ void bls381_init() {
     fq2_pow(G12, xi, e6, NLIMBS);
     fq2_pow(G6_1, xi, e3, NLIMBS);
     fq2_sq(G6_2, G6_1);
-    // W2_INV / W3_INV: w^2 = v -> as Fq12: c0 = (0, 1, 0)
-    Fq12 w2;
-    memset(&w2, 0, sizeof(w2));
-    w2.c0.c1.c0 = FP_ONE;
-    fq12_inv(W2_INV, w2);
-    Fq12 w3;  // w^3 = v*w -> c1 = (0, 1, 0)
-    memset(&w3, 0, sizeof(w3));
-    w3.c1.c1.c0 = FP_ONE;
-    fq12_inv(W3_INV, w3);
     initialized = true;
 }
 
@@ -873,22 +968,25 @@ void bls381_init() {
 // g1s: n*96 bytes (x||y big-endian), g2s: n*192 bytes (x0||x1||y0||y1)
 int bls381_pairing_check(const uint8_t* g1s, const uint8_t* g2s, size_t n) {
     bls381_init();
-    Fq12 acc = FQ12_ONE;
+    if (n == 0) return 1;
+    PairSt* pairs = new PairSt[n];
     for (size_t i = 0; i < n; i++) {
-        Fp px, py;
-        fp_from_bytes(px, g1s + i * 96);
-        fp_from_bytes(py, g1s + i * 96 + 48);
-        Fq2 qx, qy;
-        fp_from_bytes(qx.c0, g2s + i * 192);
-        fp_from_bytes(qx.c1, g2s + i * 192 + 48);
-        fp_from_bytes(qy.c0, g2s + i * 192 + 96);
-        fp_from_bytes(qy.c1, g2s + i * 192 + 144);
-        Fq12 f;
-        miller_loop(f, px, py, qx, qy);
+        fp_from_bytes(pairs[i].px, g1s + i * 96);
+        fp_from_bytes(pairs[i].py, g1s + i * 96 + 48);
+        fp_from_bytes(pairs[i].q.x.c0, g2s + i * 192);
+        fp_from_bytes(pairs[i].q.x.c1, g2s + i * 192 + 48);
+        fp_from_bytes(pairs[i].q.y.c0, g2s + i * 192 + 96);
+        fp_from_bytes(pairs[i].q.y.c1, g2s + i * 192 + 144);
+    }
+    // lockstep Miller loops share one batched inversion per step
+    miller_loop_many(pairs, n);
+    Fq12 acc = pairs[0].f;
+    for (size_t i = 1; i < n; i++) {
         Fq12 t;
-        fq12_mul(t, acc, f);
+        fq12_mul(t, acc, pairs[i].f);
         acc = t;
     }
+    delete[] pairs;
     Fq12 out;
     final_exponentiation(out, acc);
     return fq12_is_one(out) ? 1 : 0;
