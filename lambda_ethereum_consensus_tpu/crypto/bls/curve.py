@@ -169,7 +169,8 @@ g1 = GroupOps(
     sub=lambda a, b: (a - b) % P,
     mul=lambda a, b: a * b % P,
     sq=lambda a: a * a % P,
-    inv=lambda a: pow(a, P - 2, P),
+    # routed through the (possibly native-rebound) modpow hook like fq2_inv
+    inv=lambda a: F._fq_powmod(a, P - 2),
     neg=lambda a: -a % P,
     zero=0,
     one=1,

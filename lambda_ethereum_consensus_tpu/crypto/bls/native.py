@@ -45,6 +45,10 @@ def _load():
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
         ctypes.POINTER(ctypes.c_int),
     ]
+    lib.bls381_fp_powmod.restype = None
+    lib.bls381_fp_powmod.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+    ]
     lib.bls381_init()
     return lib
 
@@ -101,6 +105,16 @@ def g1_mul(pt, scalar: int):
         out, _g1_bytes(pt), scalar.to_bytes(nbytes, "big"), nbytes, ctypes.byref(is_inf)
     )
     return None if is_inf.value else _g1_from(out.raw)
+
+
+def fp_powmod(base: int, exp: int) -> int:
+    """base^exp mod p via the Montgomery backend (exp >= 0)."""
+    nbytes = max(1, (exp.bit_length() + 7) // 8)
+    out = ctypes.create_string_buffer(48)
+    _LIB.bls381_fp_powmod(
+        out, base.to_bytes(48, "big"), exp.to_bytes(nbytes, "big"), nbytes
+    )
+    return int.from_bytes(out.raw, "big")
 
 
 def g2_mul(pt, scalar: int):

@@ -31,6 +31,13 @@ def python_pairing_check(pairs) -> bool:
     return F.fq12_is_one(PR.final_exponentiation(f))
 
 
+@pytest.mark.parametrize("trial", range(8))
+def test_fp_powmod_matches_builtin(trial):
+    base = RNG.getrandbits(380)
+    exp = RNG.getrandbits(trial * 48 + 1)
+    assert native.fp_powmod(base, exp) == pow(base, exp, F.P)
+
+
 @pytest.mark.parametrize("trial", range(5))
 def test_g1_mul_matches_python(trial):
     k = RNG.getrandbits(256) + 1
@@ -81,6 +88,7 @@ def test_verify_same_through_both_paths(monkeypatch):
     assert not bls.verify(pk, b"other", sig)
     # force the pure-Python path everywhere and require identical verdicts
     monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(F, "_fq_powmod", lambda base, exp: pow(base, exp, F.P))
     object.__setattr__(C.g1, "native_mul", None)
     object.__setattr__(C.g2, "native_mul", None)
     try:
