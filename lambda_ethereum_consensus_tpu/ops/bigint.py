@@ -1,0 +1,193 @@
+"""Batched 384-bit Montgomery arithmetic on device (JAX, int32 limbs).
+
+The foundation of the device BLS path (SURVEY.md §7 hard-part #1: "381-bit
+field arithmetic must be limb-decomposed to fit TPU integer units").  Design:
+
+- An Fq element is 32 limbs x 12 bits, little-endian, ``int32``; products of
+  canonical limbs are < 2^24 and a full 32-term accumulation stays < 2^29 —
+  exact in int32.
+- Multiplication: one einsum through a static one-hot tensor ``T[i,j,k]``
+  (i+j == k) produces the 63-limb double-width product for a whole batch at
+  once, then Montgomery REDC runs as a 32-step ``lax.scan`` over digits.
+  Overflow invariant: a limb enters the REDC window carrying at most the
+  product bound 32*(2^12-1)^2 (< 2^29) and accumulates up to 32 more m*p
+  additions of (2^12-1)^2 each plus carries — ~2^30 total, inside int32 with
+  a 2x margin.  Widening limbs past 12 bits breaks this; re-derive before
+  touching LIMB_BITS.
+- Values are kept in Montgomery form between operations and fully reduced on
+  export; everything is shape-static and branch-free, so the whole pipeline
+  jits and vmaps.
+
+Status (round 1): correctness-complete and oracle-validated; wall-clock on
+TPU is NOT yet competitive — the sequential carry chains (REDC digit scan,
+normalize/borrow scans) serialize on device.  The round-2 optimization path
+is parallel-prefix carry propagation, carry-save accumulation through the
+ladder, and much larger batch axes.
+
+Tests cross-check every op against host bigint arithmetic on the CPU
+backend (tests/unit/test_device_bigint.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.bls.fields import P
+
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+NLIMBS = 32          # 32 * 12 = 384 bits
+NPROD = 2 * NLIMBS - 1
+R_MONT = 1 << (LIMB_BITS * NLIMBS)          # 2^384
+INV_R = pow(R_MONT, -1, P)
+# -p^{-1} mod 2^12
+P_INV_12 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+def to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
+    """int -> (n,) int32 little-endian 12-bit limbs."""
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    assert x == 0, "value exceeds limb capacity"
+    return out
+
+
+def from_limbs(limbs) -> int:
+    """(NLIMBS,)-ish limbs -> int (host)."""
+    arr = np.asarray(limbs)
+    x = 0
+    for i in reversed(range(arr.shape[-1])):
+        x = (x << LIMB_BITS) + int(arr[..., i])
+    return x
+
+
+def to_mont_limbs(x: int) -> np.ndarray:
+    """int -> Montgomery-form limbs (host-side conversion)."""
+    return to_limbs((x * R_MONT) % P)
+
+
+def from_mont_limbs(limbs) -> int:
+    """Montgomery-form limbs -> int (host-side conversion)."""
+    return (from_limbs(limbs) * INV_R) % P
+
+
+def _onehot_conv_tensor() -> np.ndarray:
+    t = np.zeros((NLIMBS, NLIMBS, NPROD), dtype=np.int32)
+    for i in range(NLIMBS):
+        for j in range(NLIMBS):
+            t[i, j, i + j] = 1
+    return t
+
+
+_CONV_T = _onehot_conv_tensor()
+_P_LIMBS = to_limbs(P)
+
+
+def make_ops():
+    """Build the jitted device ops (jax imported lazily so test conftest can
+    pin the backend first)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    conv_t = jnp.asarray(_CONV_T)
+    p_limbs = jnp.asarray(_P_LIMBS)            # (32,)
+    p_pad = jnp.concatenate([p_limbs, jnp.zeros(1, jnp.int32)])  # (33,)
+
+    def _normalize(v):
+        """Exact carry propagation to canonical 12-bit limbs via scan
+        (value must be non-negative and fit the limb count)."""
+
+        def step(carry, limb):
+            total = limb + carry
+            out = total & LIMB_MASK
+            return (total - out) >> LIMB_BITS, out
+
+        carry, limbs = lax.scan(step, jnp.zeros_like(v[..., 0]), jnp.moveaxis(v, -1, 0))
+        return jnp.moveaxis(limbs, 0, -1)
+
+    def _sub_if_ge(v, m):
+        """v - m when v >= m else v (borrow-chain compare; v, m canonical)."""
+
+        def step(borrow, pair):
+            ai, bi = pair
+            t = ai - bi - borrow
+            b_out = (t < 0).astype(jnp.int32)
+            return b_out, t + (b_out << LIMB_BITS)
+
+        m_b = jnp.broadcast_to(m, v.shape)
+        borrow, limbs = lax.scan(
+            step,
+            jnp.zeros_like(v[..., 0]),
+            (jnp.moveaxis(v, -1, 0), jnp.moveaxis(m_b, -1, 0)),
+        )
+        diff = jnp.moveaxis(limbs, 0, -1)
+        return jnp.where(borrow[..., None] != 0, v, diff)
+
+    def _redc(prod):
+        """Montgomery REDC of a (..., 63) double-width product ->
+        (..., 32) canonical limbs of (prod * 2^-384) mod p."""
+        # working window t of 33 limbs, shifted down one limb per step
+        t = prod[..., : NLIMBS + 1]
+        rest = prod[..., NLIMBS + 1 :]  # limbs that slide into the window
+
+        def step(carryover, _):
+            t_cur, rest_cur = carryover
+            m = ((t_cur[..., 0] & LIMB_MASK) * P_INV_12) & LIMB_MASK
+            t_new = t_cur + m[..., None] * p_pad
+            c = t_new[..., 0] >> LIMB_BITS  # limb 0 is ≡ 0 mod 2^12 now
+            # shift window down one limb; slide the next product limb in
+            incoming = rest_cur[..., 0]
+            t_shifted = jnp.concatenate(
+                [t_new[..., 1:], incoming[..., None]], axis=-1
+            )
+            t_shifted = t_shifted.at[..., 0].add(c)
+            rest_next = jnp.concatenate(
+                [rest_cur[..., 1:], jnp.zeros_like(rest_cur[..., :1])], axis=-1
+            )
+            return (t_shifted, rest_next), None
+
+        (t, _), _ = lax.scan(step, (t, rest), None, length=NLIMBS)
+        # t now holds (prod + sum m_i p 2^(12 i)) >> 384, value < 2p
+        t = _normalize(t)
+        t = _sub_if_ge(t, p_pad)
+        return t[..., :NLIMBS]
+
+    def mul_mont(a, b):
+        """Montgomery product: (a*b*2^-384) mod p, canonical limbs."""
+        prod = jnp.einsum(
+            "...i,...j,ijk->...k", a, b, conv_t, preferred_element_type=jnp.int32
+        )
+        return _redc(prod)
+
+    def add_mod(a, b):
+        v = _normalize(
+            jnp.concatenate([a + b, jnp.zeros_like(a[..., :1])], axis=-1)
+        )
+        v = _sub_if_ge(v, p_pad)
+        return v[..., :NLIMBS]
+
+    def sub_mod(a, b):
+        v = _normalize(
+            jnp.concatenate([a - b + p_limbs, jnp.zeros_like(a[..., :1])], axis=-1)
+        )
+        v = _sub_if_ge(v, p_pad)
+        return v[..., :NLIMBS]
+
+    return {
+        "mul_mont": jax.jit(mul_mont),
+        "add_mod": jax.jit(add_mod),
+        "sub_mod": jax.jit(sub_mod),
+    }
+
+
+_OPS = None
+
+
+def get_ops():
+    global _OPS
+    if _OPS is None:
+        _OPS = make_ops()
+    return _OPS

@@ -1,0 +1,183 @@
+"""Batched G1 scalar multiplication on device (JAX over limb arithmetic).
+
+The first stage of the device BLS path: the random-linear-combination batch
+verification (crypto/bls/batch.py) spends its time on many independent
+~128-bit scalar multiplications — exactly a data-parallel ladder.  This
+module runs them as one ``lax.scan`` ladder ``vmap``-ed over the batch, on
+top of :mod:`.bigint`'s Montgomery limb arithmetic.
+
+Branch-free completeness: the addition step computes both the generic
+addition and the doubling result and selects by the (canonical-form) limb
+equality masks, and point-at-infinity flags thread through ``where`` — no
+data-dependent Python control flow, so the whole ladder jits.
+
+Host boundary: affine integer points in, affine integer points out
+(Jacobian -> affine inversion happens on host, one inversion per result).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.bls.fields import P
+from . import bigint as BI
+
+SCALAR_BITS = 256
+
+
+def _scalar_bits(k: int) -> np.ndarray:
+    """int -> (SCALAR_BITS,) int32 bits, MSB first."""
+    assert 0 <= k < (1 << SCALAR_BITS)
+    return np.array(
+        [(k >> (SCALAR_BITS - 1 - i)) & 1 for i in range(SCALAR_BITS)], np.int32
+    )
+
+
+def make_g1_ops():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ops = BI.get_ops()
+    mul = ops["mul_mont"]
+    add = ops["add_mod"]
+    sub = ops["sub_mod"]
+
+    one_m = jnp.asarray(BI.to_mont_limbs(1))
+    zero = jnp.zeros(BI.NLIMBS, jnp.int32)
+
+    def dbl2(a):
+        return add(a, a)
+
+    def eq_limbs(a, b):
+        return jnp.all(a == b, axis=-1)
+
+    def is_zero(a):
+        return jnp.all(a == 0, axis=-1)
+
+    # points: (X, Y, Z, inf) with X/Y/Z (..., 32) Montgomery limbs, inf bool
+    def jac_double(pt):
+        x, y, z, inf = pt
+        a = mul(x, x)
+        b = mul(y, y)
+        c = mul(b, b)
+        t = sub(sub(mul(add(x, b), add(x, b)), a), c)
+        d = dbl2(t)
+        e = add(dbl2(a), a)
+        f = mul(e, e)
+        x3 = sub(f, dbl2(d))
+        c8 = dbl2(dbl2(dbl2(c)))
+        y3 = sub(mul(e, sub(d, x3)), c8)
+        z3 = dbl2(mul(y, z))
+        # doubling a point with y == 0 would be the identity; BLS12-381 G1
+        # has no 2-torsion so that only happens at infinity, already flagged
+        return (x3, y3, z3, inf)
+
+    def jac_add(p, q):
+        """Complete addition: generic add, doubling and identity cases all
+        computed and selected branch-free."""
+        x1, y1, z1, inf1 = p
+        x2, y2, z2, inf2 = q
+        z1z1 = mul(z1, z1)
+        z2z2 = mul(z2, z2)
+        u1 = mul(x1, z2z2)
+        u2 = mul(x2, z1z1)
+        s1 = mul(mul(y1, z2), z2z2)
+        s2 = mul(mul(y2, z1), z1z1)
+        h = sub(u2, u1)
+        i = mul(dbl2(h), dbl2(h))
+        j = mul(h, i)
+        rr = dbl2(sub(s2, s1))
+        v = mul(u1, i)
+        x3 = sub(sub(mul(rr, rr), j), dbl2(v))
+        y3 = sub(mul(rr, sub(v, x3)), dbl2(mul(s1, j)))
+        z3 = mul(dbl2(mul(z1, z2)), h)
+
+        same_x = eq_limbs(u1, u2)
+        same_y = eq_limbs(s1, s2)
+        dx, dy, dz, dinf = jac_double(p)
+
+        def sel(mask, a, b):
+            return jnp.where(mask[..., None], a, b)
+
+        # doubling case (P == Q), cancellation case (P == -Q -> infinity)
+        out_x = sel(same_x & same_y, dx, x3)
+        out_y = sel(same_x & same_y, dy, y3)
+        out_z = sel(same_x & same_y, dz, z3)
+        out_inf = same_x & ~same_y
+        # identity operands
+        out_x = sel(inf1, x2, sel(inf2, x1, out_x))
+        out_y = sel(inf1, y2, sel(inf2, y1, out_y))
+        out_z = sel(inf1, z2, sel(inf2, z1, out_z))
+        out_inf = jnp.where(inf1, inf2, jnp.where(inf2, inf1, out_inf))
+        return (out_x, out_y, out_z, out_inf)
+
+    def ladder(base_xy, bits):
+        """(x, y) Montgomery-limb affine base + (SCALAR_BITS,) bits ->
+        Jacobian (X, Y, Z, inf) of bits * base."""
+        bx, by = base_xy
+        base = (bx, by, one_m, jnp.zeros((), jnp.bool_))
+        acc = (
+            jnp.zeros_like(bx),
+            jnp.zeros_like(by),
+            zero,
+            jnp.ones((), jnp.bool_),
+        )
+
+        def step(acc, bit):
+            acc = jac_double(acc)
+            added = jac_add(acc, base)
+            take = bit.astype(jnp.bool_)
+            out = (
+                jnp.where(take[..., None], added[0], acc[0]),
+                jnp.where(take[..., None], added[1], acc[1]),
+                jnp.where(take[..., None], added[2], acc[2]),
+                jnp.where(take, added[3], acc[3]),
+            )
+            return out, None
+
+        acc, _ = lax.scan(step, acc, bits)
+        return acc
+
+    ladder_batched = jax.jit(jax.vmap(ladder, in_axes=((0, 0), 0)))
+    return {"ladder_batched": ladder_batched}
+
+
+_G1_OPS = None
+
+
+def _get_g1_ops():
+    global _G1_OPS
+    if _G1_OPS is None:
+        _G1_OPS = make_g1_ops()
+    return _G1_OPS
+
+
+def batch_g1_mul(points: list, scalars: list) -> list:
+    """Batched scalar multiplication: ``[k_i * P_i]`` on device.
+
+    ``points``: affine ``(x, y)`` int pairs (no Nones); ``scalars``: ints in
+    [0, 2^256).  Returns affine int pairs or ``None`` for infinity results.
+    """
+    assert len(points) == len(scalars)
+    if not points:
+        return []
+    ops = _get_g1_ops()
+    bx = np.stack([BI.to_mont_limbs(x) for x, _ in points])
+    by = np.stack([BI.to_mont_limbs(y) for _, y in points])
+    bits = np.stack([_scalar_bits(k) for k in scalars])
+    X, Y, Z, inf = ops["ladder_batched"]((bx, by), bits)
+    # bulk device->host transfer once, not per element
+    X, Y, Z, inf = (np.asarray(X), np.asarray(Y), np.asarray(Z), np.asarray(inf))
+    out = []
+    for i in range(len(points)):
+        if bool(inf[i]):
+            out.append(None)
+            continue
+        xm = BI.from_mont_limbs(X[i])
+        ym = BI.from_mont_limbs(Y[i])
+        zm = BI.from_mont_limbs(Z[i])
+        zinv = pow(zm, P - 2, P)
+        zinv2 = zinv * zinv % P
+        out.append((xm * zinv2 % P, ym * zinv2 % P * zinv % P))
+    return out

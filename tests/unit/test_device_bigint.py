@@ -1,0 +1,67 @@
+"""Device 384-bit Montgomery arithmetic vs host bigint oracle (CPU backend)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from lambda_ethereum_consensus_tpu.crypto.bls.fields import P
+from lambda_ethereum_consensus_tpu.ops import bigint as BI
+
+RNG = random.Random(7)
+
+
+def rand_fq():
+    return RNG.randrange(P)
+
+
+def test_limb_roundtrip():
+    for x in (0, 1, P - 1, rand_fq()):
+        assert BI.from_limbs(BI.to_limbs(x)) == x
+
+
+def test_mont_conversion_roundtrip():
+    x = rand_fq()
+    assert BI.from_mont_limbs(BI.to_mont_limbs(x)) == x
+
+
+@pytest.mark.parametrize("trial", range(4))
+def test_mul_mont_matches_host(trial):
+    ops = BI.get_ops()
+    a, b = rand_fq(), rand_fq()
+    am = BI.to_mont_limbs(a)[None, :]
+    bm = BI.to_mont_limbs(b)[None, :]
+    out = np.asarray(ops["mul_mont"](am, bm))[0]
+    assert BI.from_mont_limbs(out) == a * b % P
+
+
+def test_mul_mont_batched():
+    ops = BI.get_ops()
+    n = 16
+    xs = [rand_fq() for _ in range(n)]
+    ys = [rand_fq() for _ in range(n)]
+    am = np.stack([BI.to_mont_limbs(x) for x in xs])
+    bm = np.stack([BI.to_mont_limbs(y) for y in ys])
+    out = np.asarray(ops["mul_mont"](am, bm))
+    for i in range(n):
+        assert BI.from_mont_limbs(out[i]) == xs[i] * ys[i] % P
+
+
+def test_add_sub_mod():
+    ops = BI.get_ops()
+    a, b = rand_fq(), rand_fq()
+    al = BI.to_limbs(a)[None, :]
+    bl = BI.to_limbs(b)[None, :]
+    assert BI.from_limbs(np.asarray(ops["add_mod"](al, bl))[0]) == (a + b) % P
+    assert BI.from_limbs(np.asarray(ops["sub_mod"](al, bl))[0]) == (a - b) % P
+    assert BI.from_limbs(np.asarray(ops["sub_mod"](bl, al))[0]) == (b - a) % P
+
+
+def test_edge_values():
+    ops = BI.get_ops()
+    cases = [(0, 0), (1, 1), (P - 1, P - 1), (P - 1, 1), (0, rand_fq())]
+    for a, b in cases:
+        am = BI.to_mont_limbs(a)[None, :]
+        bm = BI.to_mont_limbs(b)[None, :]
+        out = np.asarray(ops["mul_mont"](am, bm))[0]
+        assert BI.from_mont_limbs(out) == a * b % P, (a, b)

@@ -1,0 +1,48 @@
+"""Device G1 scalar multiplication vs the host curve oracle (CPU backend)."""
+
+import random
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
+from lambda_ethereum_consensus_tpu.crypto.bls.fields import R
+from lambda_ethereum_consensus_tpu.ops.bls_g1 import batch_g1_mul
+
+RNG = random.Random(31)
+
+
+def host_mul(pt, k):
+    return C.g1._multiply_py(pt, k)
+
+
+def test_small_scalars_match_host():
+    pts = [C.G1_GENERATOR] * 6
+    ks = [1, 2, 3, 5, 17, 255]
+    got = batch_g1_mul(pts, ks)
+    for k, g in zip(ks, got):
+        assert g == host_mul(C.G1_GENERATOR, k), k
+
+
+def test_random_points_and_scalars():
+    pts = [host_mul(C.G1_GENERATOR, RNG.getrandbits(64) + 1) for _ in range(5)]
+    ks = [RNG.getrandbits(128) | 1 for _ in range(5)]
+    got = batch_g1_mul(pts, ks)
+    for pt, k, g in zip(pts, ks, got):
+        assert g == host_mul(pt, k)
+
+
+def test_full_width_scalars():
+    ks = [R - 1, R + 12345, (1 << 255) + 7]
+    pts = [C.G1_GENERATOR] * len(ks)
+    got = batch_g1_mul(pts, ks)
+    for k, g in zip(ks, got):
+        assert g == host_mul(C.G1_GENERATOR, k), hex(k)
+
+
+def test_zero_scalar_and_order_annihilation():
+    got = batch_g1_mul([C.G1_GENERATOR, C.G1_GENERATOR], [0, R])
+    assert got == [None, None]
+
+
+def test_empty_batch():
+    assert batch_g1_mul([], []) == []
