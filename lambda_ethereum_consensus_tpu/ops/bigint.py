@@ -1,31 +1,29 @@
-"""Batched 384-bit Montgomery arithmetic on device (JAX, int32 limbs).
+"""Batched 384-bit modular arithmetic on device (JAX, int32 limbs).
 
 The foundation of the device BLS path (SURVEY.md §7 hard-part #1: "381-bit
-field arithmetic must be limb-decomposed to fit TPU integer units").  Design:
+field arithmetic must be limb-decomposed to fit TPU integer units").  Design
+— every step is parallel or log-depth; there are no serial digit scans:
 
-- An Fq element is 32 limbs x 12 bits, little-endian, ``int32``; products of
-  canonical limbs are < 2^24 and a full 32-term accumulation stays < 2^29 —
-  exact in int32.
+- An Fq element is 32 limbs x 12 bits, little-endian, ``int32``, canonical
+  (limbs < 2^12, value < p).  Products of canonical limbs are < 2^24 and the
+  widest accumulation (33 terms, the Barrett q2 einsum) stays < 2^30 —
+  exact in int32 with 2x headroom.  Widening LIMB_BITS or adding limbs
+  breaks this bound; re-derive before changing either.
 - Multiplication: one einsum through a static one-hot tensor ``T[i,j,k]``
-  (i+j == k) produces the 63-limb double-width product for a whole batch at
-  once, then Montgomery REDC runs as a 32-step ``lax.scan`` over digits.
-  Overflow invariant: a limb enters the REDC window carrying at most the
-  product bound 32*(2^12-1)^2 (< 2^29) and accumulates up to 32 more m*p
-  additions of (2^12-1)^2 each plus carries — ~2^30 total, inside int32 with
-  a 2x margin.  Widening limbs past 12 bits breaks this; re-derive before
-  touching LIMB_BITS.
-- Values are kept in Montgomery form between operations and fully reduced on
-  export; everything is shape-static and branch-free, so the whole pipeline
-  jits and vmaps.
-
-Status (round 1): correctness-complete and oracle-validated; wall-clock on
-TPU is NOT yet competitive — the sequential carry chains (REDC digit scan,
-normalize/borrow scans) serialize on device.  The round-2 optimization path
-is parallel-prefix carry propagation, carry-save accumulation through the
-ladder, and much larger batch axes.
+  (i+j == k) yields the double-width product for the whole batch, then
+  **Barrett reduction** (floor(b^2k/p) precomputed) — two more einsums.
+- Carry propagation is exact and parallel: three bounded elementwise passes
+  shrink limbs to [0, 2^12] with residual carries in {0, 1}, then a
+  carry-lookahead (generate/propagate pairs combined with
+  ``lax.associative_scan``) finishes in log depth.  Borrow chains for
+  compare-and-subtract use the same machinery.
+- Negative intermediates are avoided with an all-(b-1)+1 bias: appending a
+  top limb of 1 and adding b-1 to every limb adds exactly b^n, which the
+  final truncation removes — so subtraction never produces negative limbs.
 
 Tests cross-check every op against host bigint arithmetic on the CPU
-backend (tests/unit/test_device_bigint.py).
+backend (tests/unit/test_device_bigint.py); the G1 ladder on top is checked
+against the host curve oracle.
 """
 
 from __future__ import annotations
@@ -37,11 +35,8 @@ from ..crypto.bls.fields import P
 LIMB_BITS = 12
 LIMB_MASK = (1 << LIMB_BITS) - 1
 NLIMBS = 32          # 32 * 12 = 384 bits
-NPROD = 2 * NLIMBS - 1
-R_MONT = 1 << (LIMB_BITS * NLIMBS)          # 2^384
-INV_R = pow(R_MONT, -1, P)
-# -p^{-1} mod 2^12
-P_INV_12 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+# Barrett constant: floor(b^(2k) / p) with b = 2^12, k = 32 -> 33 limbs
+MU = (1 << (LIMB_BITS * 2 * NLIMBS)) // P
 
 
 def to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
@@ -55,7 +50,7 @@ def to_limbs(x: int, n: int = NLIMBS) -> np.ndarray:
 
 
 def from_limbs(limbs) -> int:
-    """(NLIMBS,)-ish limbs -> int (host)."""
+    """limb array -> int (host)."""
     arr = np.asarray(limbs)
     x = 0
     for i in reversed(range(arr.shape[-1])):
@@ -63,25 +58,15 @@ def from_limbs(limbs) -> int:
     return x
 
 
-def to_mont_limbs(x: int) -> np.ndarray:
-    """int -> Montgomery-form limbs (host-side conversion)."""
-    return to_limbs((x * R_MONT) % P)
-
-
-def from_mont_limbs(limbs) -> int:
-    """Montgomery-form limbs -> int (host-side conversion)."""
-    return (from_limbs(limbs) * INV_R) % P
-
-
-def _onehot_conv_tensor() -> np.ndarray:
-    t = np.zeros((NLIMBS, NLIMBS, NPROD), dtype=np.int32)
-    for i in range(NLIMBS):
-        for j in range(NLIMBS):
+def _onehot_conv(n1: int, n2: int) -> np.ndarray:
+    """One-hot contraction tensor for an (n1)x(n2) limb product."""
+    t = np.zeros((n1, n2, n1 + n2 - 1), dtype=np.int32)
+    for i in range(n1):
+        for j in range(n2):
             t[i, j, i + j] = 1
     return t
 
 
-_CONV_T = _onehot_conv_tensor()
 _P_LIMBS = to_limbs(P)
 
 
@@ -92,92 +77,135 @@ def make_ops():
     import jax.numpy as jnp
     from jax import lax
 
-    conv_t = jnp.asarray(_CONV_T)
-    p_limbs = jnp.asarray(_P_LIMBS)            # (32,)
-    p_pad = jnp.concatenate([p_limbs, jnp.zeros(1, jnp.int32)])  # (33,)
+    p32 = jnp.asarray(_P_LIMBS)                              # (32,)
+    mu33 = jnp.asarray(to_limbs(MU, NLIMBS + 1))             # (33,)
+    conv_mul = jnp.asarray(_onehot_conv(NLIMBS, NLIMBS))     # a*b -> 63
+    conv_q = jnp.asarray(_onehot_conv(NLIMBS + 1, NLIMBS + 1))  # q1*mu -> 65
+    conv_qp = jnp.asarray(_onehot_conv(NLIMBS + 1, NLIMBS))     # q3*p -> 64
 
-    def _normalize(v):
-        """Exact carry propagation to canonical 12-bit limbs via scan
-        (value must be non-negative and fit the limb count)."""
+    def _passes(v, rounds):
+        """Bounded elementwise carry passes (non-negative input)."""
+        for _ in range(rounds):
+            carry = v >> LIMB_BITS
+            v = (v & LIMB_MASK) + jnp.concatenate(
+                [jnp.zeros_like(carry[..., :1]), carry[..., :-1]], axis=-1
+            )
+        return v
 
-        def step(carry, limb):
-            total = limb + carry
-            out = total & LIMB_MASK
-            return (total - out) >> LIMB_BITS, out
+    def _lookahead(g, p):
+        """Prefix-combine (generate, propagate) carry pairs in log depth;
+        returns the carry INTO each position (carry into position 0 is 0)."""
 
-        carry, limbs = lax.scan(step, jnp.zeros_like(v[..., 0]), jnp.moveaxis(v, -1, 0))
-        return jnp.moveaxis(limbs, 0, -1)
+        def combine(a, b):
+            ga, pa = a
+            gb, pb = b
+            return gb | (pb & ga), pa & pb
+
+        G, _ = lax.associative_scan(combine, (g, p), axis=-1)
+        # carry into i+1 is G[..., i]; shift right with 0 in front
+        return jnp.concatenate(
+            [jnp.zeros_like(G[..., :1]), G[..., :-1]], axis=-1
+        )
+
+    def normalize(v):
+        """Exact canonical form of a non-negative limb array (limbs < 2^30).
+
+        Three bounded passes bring limbs into [0, 2^12] with residual carries
+        in {0, 1}; a carry-lookahead finishes exactly.  The value must fit
+        the array width.
+        """
+        v = _passes(v, 3)
+        g = (v >> LIMB_BITS).astype(jnp.int32)       # in {0, 1}
+        p = (v == LIMB_MASK).astype(jnp.int32)
+        c = _lookahead(g, p)
+        return (v + c) & LIMB_MASK
 
     def _sub_if_ge(v, m):
-        """v - m when v >= m else v (borrow-chain compare; v, m canonical)."""
-
-        def step(borrow, pair):
-            ai, bi = pair
-            t = ai - bi - borrow
-            b_out = (t < 0).astype(jnp.int32)
-            return b_out, t + (b_out << LIMB_BITS)
-
+        """v - m where v >= m else v; exact borrow-lookahead compare
+        (v, m canonical, same width)."""
         m_b = jnp.broadcast_to(m, v.shape)
-        borrow, limbs = lax.scan(
-            step,
-            jnp.zeros_like(v[..., 0]),
-            (jnp.moveaxis(v, -1, 0), jnp.moveaxis(m_b, -1, 0)),
+        g = (v < m_b).astype(jnp.int32)
+        p = (v == m_b).astype(jnp.int32)
+        borrow = _lookahead(g, p)
+        # borrow OUT of the top limb = combined borrow across all limbs
+        diff = v - m_b - borrow
+        diff = jnp.where(diff < 0, diff + (1 << LIMB_BITS), diff)
+        top_g = (v[..., -1] < m_b[..., -1]) | (
+            (v[..., -1] == m_b[..., -1]) & (borrow[..., -1] == 1)
         )
-        diff = jnp.moveaxis(limbs, 0, -1)
-        return jnp.where(borrow[..., None] != 0, v, diff)
+        return jnp.where(top_g[..., None], v, diff)
 
-    def _redc(prod):
-        """Montgomery REDC of a (..., 63) double-width product ->
-        (..., 32) canonical limbs of (prod * 2^-384) mod p."""
-        # working window t of 33 limbs, shifted down one limb per step
-        t = prod[..., : NLIMBS + 1]
-        rest = prod[..., NLIMBS + 1 :]  # limbs that slide into the window
+    def _biased_diff(a, b):
+        """a - b for limb arrays of equal width n where the true value
+        satisfies -b^n < a-b: returns (a - b) mod b^n exactly, canonical.
 
-        def step(carryover, _):
-            t_cur, rest_cur = carryover
-            m = ((t_cur[..., 0] & LIMB_MASK) * P_INV_12) & LIMB_MASK
-            t_new = t_cur + m[..., None] * p_pad
-            c = t_new[..., 0] >> LIMB_BITS  # limb 0 is ≡ 0 mod 2^12 now
-            # shift window down one limb; slide the next product limb in
-            incoming = rest_cur[..., 0]
-            t_shifted = jnp.concatenate(
-                [t_new[..., 1:], incoming[..., None]], axis=-1
-            )
-            t_shifted = t_shifted.at[..., 0].add(c)
-            rest_next = jnp.concatenate(
-                [rest_cur[..., 1:], jnp.zeros_like(rest_cur[..., :1])], axis=-1
-            )
-            return (t_shifted, rest_next), None
+        Bias: a + (all (b-1) limbs) + 1 - b = a - b + b^n limb-wise
+        non-negative; normalize over n+1 limbs; drop the top limb (= the
+        added b^n, or the borrow indicator)."""
+        v = a + (LIMB_MASK - b)
+        v = jnp.concatenate([v, jnp.zeros_like(v[..., :1])], axis=-1)
+        v = v.at[..., 0].add(1)
+        v = normalize(v)
+        return v[..., :-1]
 
-        (t, _), _ = lax.scan(step, (t, rest), None, length=NLIMBS)
-        # t now holds (prod + sum m_i p 2^(12 i)) >> 384, value < 2p
-        t = _normalize(t)
-        t = _sub_if_ge(t, p_pad)
-        return t[..., :NLIMBS]
+    def _barrett(x64):
+        """Canonical (..., 64) double-width value -> x mod p, canonical
+        (..., 32).  Textbook Barrett (HAC 14.42) with b = 2^12, k = 32."""
+        q1 = x64[..., NLIMBS - 1 :]                  # 33 limbs
+        q2 = jnp.einsum(
+            "...i,j,ijk->...k", q1, mu33, conv_q,
+            preferred_element_type=jnp.int32,
+        )
+        q2 = normalize(
+            jnp.concatenate([q2, jnp.zeros_like(q2[..., :1])], axis=-1)
+        )                                            # 66 limbs canonical
+        q3 = q2[..., NLIMBS + 1 : 2 * NLIMBS + 2]    # 33 limbs
+        qp = jnp.einsum(
+            "...i,j,ijk->...k", q3, p32, conv_qp,
+            preferred_element_type=jnp.int32,
+        )
+        qp = normalize(
+            jnp.concatenate([qp, jnp.zeros_like(qp[..., :1])], axis=-1)
+        )                                            # 65 limbs canonical
+        # r = (x - q3*p) mod b^34; true r in [0, 3p) < b^34
+        width = NLIMBS + 2
+        r = _biased_diff(x64[..., :width], qp[..., :width])
+        r = _sub_if_ge(r, p_pad2)
+        r = _sub_if_ge(r, p_pad2)
+        return r[..., :NLIMBS]
 
-    def mul_mont(a, b):
-        """Montgomery product: (a*b*2^-384) mod p, canonical limbs."""
+    p_pad2 = jnp.concatenate([p32, jnp.zeros(2, jnp.int32)])  # (34,)
+    p_pad1 = jnp.concatenate([p32, jnp.zeros(1, jnp.int32)])  # (33,)
+
+    def mul_mod(a, b):
+        """(..., 32) x (..., 32) canonical -> (a*b) mod p canonical."""
         prod = jnp.einsum(
-            "...i,...j,ijk->...k", a, b, conv_t, preferred_element_type=jnp.int32
+            "...i,...j,ijk->...k", a, b, conv_mul,
+            preferred_element_type=jnp.int32,
         )
-        return _redc(prod)
+        x64 = normalize(
+            jnp.concatenate([prod, jnp.zeros_like(prod[..., :1])], axis=-1)
+        )
+        return _barrett(x64)
 
     def add_mod(a, b):
-        v = _normalize(
+        v = normalize(
             jnp.concatenate([a + b, jnp.zeros_like(a[..., :1])], axis=-1)
         )
-        v = _sub_if_ge(v, p_pad)
+        v = _sub_if_ge(v, p_pad1)
         return v[..., :NLIMBS]
 
     def sub_mod(a, b):
-        v = _normalize(
-            jnp.concatenate([a - b + p_limbs, jnp.zeros_like(a[..., :1])], axis=-1)
+        # a - b + p: bias keeps limbs non-negative; value in (0, 2p) < b^33
+        v = _biased_diff(
+            jnp.concatenate([a + p32, jnp.zeros_like(a[..., :1])], axis=-1),
+            jnp.concatenate([b, jnp.zeros_like(b[..., :1])], axis=-1),
         )
-        v = _sub_if_ge(v, p_pad)
+        v = _sub_if_ge(v, p_pad1)
         return v[..., :NLIMBS]
 
     return {
-        "mul_mont": jax.jit(mul_mont),
+        "mul_mod": jax.jit(mul_mod),
         "add_mod": jax.jit(add_mod),
         "sub_mod": jax.jit(sub_mod),
     }

@@ -4,7 +4,7 @@ The first stage of the device BLS path: the random-linear-combination batch
 verification (crypto/bls/batch.py) spends its time on many independent
 ~128-bit scalar multiplications — exactly a data-parallel ladder.  This
 module runs them as one ``lax.scan`` ladder ``vmap``-ed over the batch, on
-top of :mod:`.bigint`'s Montgomery limb arithmetic.
+top of :mod:`.bigint`'s Barrett limb arithmetic (plain canonical residues).
 
 Branch-free completeness: the addition step computes both the generic
 addition and the doubling result and selects by the (canonical-form) limb
@@ -39,11 +39,11 @@ def make_g1_ops():
     from jax import lax
 
     ops = BI.get_ops()
-    mul = ops["mul_mont"]
+    mul = ops["mul_mod"]
     add = ops["add_mod"]
     sub = ops["sub_mod"]
 
-    one_m = jnp.asarray(BI.to_mont_limbs(1))
+    one_l = jnp.asarray(BI.to_limbs(1))
     zero = jnp.zeros(BI.NLIMBS, jnp.int32)
 
     def dbl2(a):
@@ -55,7 +55,7 @@ def make_g1_ops():
     def is_zero(a):
         return jnp.all(a == 0, axis=-1)
 
-    # points: (X, Y, Z, inf) with X/Y/Z (..., 32) Montgomery limbs, inf bool
+    # points: (X, Y, Z, inf) with X/Y/Z (..., 32) canonical limbs, inf bool
     def jac_double(pt):
         x, y, z, inf = pt
         a = mul(x, x)
@@ -113,10 +113,10 @@ def make_g1_ops():
         return (out_x, out_y, out_z, out_inf)
 
     def ladder(base_xy, bits):
-        """(x, y) Montgomery-limb affine base + (SCALAR_BITS,) bits ->
+        """(x, y) canonical-limb affine base + (SCALAR_BITS,) bits ->
         Jacobian (X, Y, Z, inf) of bits * base."""
         bx, by = base_xy
-        base = (bx, by, one_m, jnp.zeros((), jnp.bool_))
+        base = (bx, by, one_l, jnp.zeros((), jnp.bool_))
         acc = (
             jnp.zeros_like(bx),
             jnp.zeros_like(by),
@@ -163,8 +163,8 @@ def batch_g1_mul(points: list, scalars: list) -> list:
     if not points:
         return []
     ops = _get_g1_ops()
-    bx = np.stack([BI.to_mont_limbs(x) for x, _ in points])
-    by = np.stack([BI.to_mont_limbs(y) for _, y in points])
+    bx = np.stack([BI.to_limbs(x) for x, _ in points])
+    by = np.stack([BI.to_limbs(y) for _, y in points])
     bits = np.stack([_scalar_bits(k) for k in scalars])
     X, Y, Z, inf = ops["ladder_batched"]((bx, by), bits)
     # bulk device->host transfer once, not per element
@@ -174,9 +174,9 @@ def batch_g1_mul(points: list, scalars: list) -> list:
         if bool(inf[i]):
             out.append(None)
             continue
-        xm = BI.from_mont_limbs(X[i])
-        ym = BI.from_mont_limbs(Y[i])
-        zm = BI.from_mont_limbs(Z[i])
+        xm = BI.from_limbs(X[i])
+        ym = BI.from_limbs(Y[i])
+        zm = BI.from_limbs(Z[i])
         zinv = pow(zm, P - 2, P)
         zinv2 = zinv * zinv % P
         out.append((xm * zinv2 % P, ym * zinv2 % P * zinv % P))

@@ -1,4 +1,4 @@
-"""Device 384-bit Montgomery arithmetic vs host bigint oracle (CPU backend)."""
+"""Device 384-bit Barrett arithmetic vs host bigint oracle (CPU backend)."""
 
 import random
 
@@ -20,38 +20,31 @@ def test_limb_roundtrip():
         assert BI.from_limbs(BI.to_limbs(x)) == x
 
 
-def test_mont_conversion_roundtrip():
-    x = rand_fq()
-    assert BI.from_mont_limbs(BI.to_mont_limbs(x)) == x
-
-
 @pytest.mark.parametrize("trial", range(4))
-def test_mul_mont_matches_host(trial):
+def test_mul_mod_matches_host(trial):
     ops = BI.get_ops()
     a, b = rand_fq(), rand_fq()
-    am = BI.to_mont_limbs(a)[None, :]
-    bm = BI.to_mont_limbs(b)[None, :]
-    out = np.asarray(ops["mul_mont"](am, bm))[0]
-    assert BI.from_mont_limbs(out) == a * b % P
+    out = np.asarray(ops["mul_mod"](BI.to_limbs(a)[None], BI.to_limbs(b)[None]))[0]
+    assert BI.from_limbs(out) == a * b % P
 
 
-def test_mul_mont_batched():
+def test_mul_mod_batched():
     ops = BI.get_ops()
     n = 16
     xs = [rand_fq() for _ in range(n)]
     ys = [rand_fq() for _ in range(n)]
-    am = np.stack([BI.to_mont_limbs(x) for x in xs])
-    bm = np.stack([BI.to_mont_limbs(y) for y in ys])
-    out = np.asarray(ops["mul_mont"](am, bm))
+    al = np.stack([BI.to_limbs(x) for x in xs])
+    bl = np.stack([BI.to_limbs(y) for y in ys])
+    out = np.asarray(ops["mul_mod"](al, bl))
     for i in range(n):
-        assert BI.from_mont_limbs(out[i]) == xs[i] * ys[i] % P
+        assert BI.from_limbs(out[i]) == xs[i] * ys[i] % P
 
 
 def test_add_sub_mod():
     ops = BI.get_ops()
     a, b = rand_fq(), rand_fq()
-    al = BI.to_limbs(a)[None, :]
-    bl = BI.to_limbs(b)[None, :]
+    al = BI.to_limbs(a)[None]
+    bl = BI.to_limbs(b)[None]
     assert BI.from_limbs(np.asarray(ops["add_mod"](al, bl))[0]) == (a + b) % P
     assert BI.from_limbs(np.asarray(ops["sub_mod"](al, bl))[0]) == (a - b) % P
     assert BI.from_limbs(np.asarray(ops["sub_mod"](bl, al))[0]) == (b - a) % P
@@ -59,9 +52,26 @@ def test_add_sub_mod():
 
 def test_edge_values():
     ops = BI.get_ops()
-    cases = [(0, 0), (1, 1), (P - 1, P - 1), (P - 1, 1), (0, rand_fq())]
+    cases = [(0, 0), (1, 1), (P - 1, P - 1), (P - 1, 1), (0, rand_fq()), (1, P - 1)]
     for a, b in cases:
-        am = BI.to_mont_limbs(a)[None, :]
-        bm = BI.to_mont_limbs(b)[None, :]
-        out = np.asarray(ops["mul_mont"](am, bm))[0]
-        assert BI.from_mont_limbs(out) == a * b % P, (a, b)
+        out = np.asarray(ops["mul_mod"](BI.to_limbs(a)[None], BI.to_limbs(b)[None]))[0]
+        assert BI.from_limbs(out) == a * b % P, (a, b)
+        s = np.asarray(ops["add_mod"](BI.to_limbs(a)[None], BI.to_limbs(b)[None]))[0]
+        assert BI.from_limbs(s) == (a + b) % P, (a, b)
+
+
+def test_stress_randomized():
+    """Wider randomized sweep — Barrett quotient-error corner coverage."""
+    ops = BI.get_ops()
+    n = 64
+    xs = [RNG.randrange(P) for _ in range(n)]
+    ys = [RNG.randrange(P) for _ in range(n)]
+    # bias some operands toward p-1 to stress the r < 3p corrections
+    for i in range(0, n, 4):
+        xs[i] = P - 1 - RNG.randrange(1 << 20)
+        ys[i] = P - 1 - RNG.randrange(1 << 20)
+    al = np.stack([BI.to_limbs(x) for x in xs])
+    bl = np.stack([BI.to_limbs(y) for y in ys])
+    out = np.asarray(ops["mul_mod"](al, bl))
+    for i in range(n):
+        assert BI.from_limbs(out[i]) == xs[i] * ys[i] % P, i
