@@ -25,12 +25,22 @@ from . import bigint as BI
 SCALAR_BITS = 256
 
 
-def _scalar_bits(k: int) -> np.ndarray:
-    """int -> (SCALAR_BITS,) int32 bits, MSB first."""
-    assert 0 <= k < (1 << SCALAR_BITS)
-    return np.array(
-        [(k >> (SCALAR_BITS - 1 - i)) & 1 for i in range(SCALAR_BITS)], np.int32
+def _scalar_bits_batch(ks: list) -> np.ndarray:
+    """ints -> (N, SCALAR_BITS) int32 bits, MSB first (vectorized)."""
+    raw = b"".join(int(k).to_bytes(SCALAR_BITS // 8, "big") for k in ks)
+    bits = np.unpackbits(np.frombuffer(raw, np.uint8))
+    return bits.reshape(len(ks), SCALAR_BITS).astype(np.int32)
+
+
+def _limbs_batch(xs: list) -> np.ndarray:
+    """ints -> (N, NLIMBS) int32 12-bit limbs (vectorized)."""
+    raw = b"".join(int(x).to_bytes(BI.NLIMBS * BI.LIMB_BITS // 8, "big") for x in xs)
+    bits = np.unpackbits(np.frombuffer(raw, np.uint8)).reshape(
+        len(xs), BI.NLIMBS, BI.LIMB_BITS
     )
+    weights = 1 << np.arange(BI.LIMB_BITS - 1, -1, -1, dtype=np.int32)
+    limbs_be = bits.astype(np.int32) @ weights  # (N, NLIMBS) most-significant first
+    return limbs_be[:, ::-1].copy()  # little-endian limb order
 
 
 def make_g1_ops():
@@ -163,21 +173,40 @@ def batch_g1_mul(points: list, scalars: list) -> list:
     if not points:
         return []
     ops = _get_g1_ops()
-    bx = np.stack([BI.to_limbs(x) for x, _ in points])
-    by = np.stack([BI.to_limbs(y) for _, y in points])
-    bits = np.stack([_scalar_bits(k) for k in scalars])
+    bx = _limbs_batch([x for x, _ in points])
+    by = _limbs_batch([y for _, y in points])
+    bits = _scalar_bits_batch(scalars)
     X, Y, Z, inf = ops["ladder_batched"]((bx, by), bits)
     # bulk device->host transfer once, not per element
     X, Y, Z, inf = (np.asarray(X), np.asarray(Y), np.asarray(Z), np.asarray(inf))
+    live = [i for i in range(len(points)) if not bool(inf[i])]
+    xs = {i: BI.from_limbs(X[i]) for i in live}
+    ys = {i: BI.from_limbs(Y[i]) for i in live}
+    zs = {i: BI.from_limbs(Z[i]) for i in live}
+    # Montgomery batch inversion of all z: one modexp for the whole batch
+    zinvs: dict[int, int] = {}
+    if live:
+        for i in live:
+            # z == 0 would poison the shared product below; the ladder's
+            # infinity flag makes it impossible — fail loudly, not batch-wide
+            assert zs[i] % P != 0, "finite ladder result with z == 0"
+        prefix = []
+        acc = 1
+        for i in live:
+            acc = acc * zs[i] % P
+            prefix.append(acc)
+        inv_all = pow(acc, P - 2, P)
+        for idx in range(len(live) - 1, -1, -1):
+            i = live[idx]
+            before = prefix[idx - 1] if idx > 0 else 1
+            zinvs[i] = inv_all * before % P
+            inv_all = inv_all * zs[i] % P
     out = []
     for i in range(len(points)):
-        if bool(inf[i]):
+        if i not in zinvs:
             out.append(None)
             continue
-        xm = BI.from_limbs(X[i])
-        ym = BI.from_limbs(Y[i])
-        zm = BI.from_limbs(Z[i])
-        zinv = pow(zm, P - 2, P)
+        zinv = zinvs[i]
         zinv2 = zinv * zinv % P
-        out.append((xm * zinv2 % P, ym * zinv2 % P * zinv % P))
+        out.append((xs[i] * zinv2 % P, ys[i] * zinv2 % P * zinv % P))
     return out
