@@ -23,6 +23,7 @@ exponentiation (already how :func:`..pairing.pairing_check` works).
 
 from __future__ import annotations
 
+import os
 import secrets
 from typing import Sequence
 
@@ -37,6 +38,24 @@ _COEFF_BITS = 128
 
 # entry: (g1 affine point, message bytes, g2 affine point)
 PointEntry = tuple
+
+
+def _scale_entries(entries, coeffs):
+    """``[(r_i * pk_i, r_i * sig_i)]`` — on device when ``BLS_DEVICE_MSM=1``
+    and the batch amortizes the dispatch (the TPU ladder beats the native
+    host path from a few hundred items up; see ops/bls_g1.py)."""
+    threshold = int(os.environ.get("BLS_DEVICE_MSM_MIN", "256"))
+    enabled = os.environ.get("BLS_DEVICE_MSM", "") not in ("", "0", "false")
+    if enabled and len(entries) >= threshold:
+        from ...ops.bls_g1 import batch_g1_mul
+        from ...ops.bls_g2 import batch_g2_mul
+
+        pks = batch_g1_mul([pk for pk, _, _ in entries], coeffs)
+        sigs = batch_g2_mul([sig for _, _, sig in entries], coeffs)
+        return pks, sigs
+    pks = [C.g1.multiply_raw(pk, r) for (pk, _, _), r in zip(entries, coeffs)]
+    sigs = [C.g2.multiply_raw(sig, r) for (_, _, sig), r in zip(entries, coeffs)]
+    return pks, sigs
 
 
 def verify_points(
@@ -58,15 +77,14 @@ def verify_points(
     if message_points is None:
         message_points = {}
     coeffs = [secrets.randbits(_COEFF_BITS) | 1 for _ in entries]
+    scaled_pks, scaled_sigs = _scale_entries(entries, coeffs)
     by_message: dict[bytes, C.AffinePoint] = {}
     sig_acc: C.AffinePoint = None
-    for (pk_pt, message, sig_pt), r in zip(entries, coeffs):
-        scaled_pk = C.g1.multiply_raw(pk_pt, r)
+    for (_, message, _), scaled_pk, scaled_sig in zip(entries, scaled_pks, scaled_sigs):
         prev = by_message.get(message)
         by_message[message] = (
             scaled_pk if prev is None else C.g1.affine_add(prev, scaled_pk)
         )
-        scaled_sig = C.g2.multiply_raw(sig_pt, r)
         sig_acc = scaled_sig if sig_acc is None else C.g2.affine_add(sig_acc, scaled_sig)
 
     pairs: list[tuple[C.AffinePoint, C.AffinePoint]] = []

@@ -46,109 +46,20 @@ def _limbs_batch(xs: list) -> np.ndarray:
 def make_g1_ops():
     import jax
     import jax.numpy as jnp
-    from jax import lax
+
+    from .ladder import make_ladder
 
     ops = BI.get_ops()
-    mul = ops["mul_mod"]
-    add = ops["add_mod"]
-    sub = ops["sub_mod"]
-
-    one_l = jnp.asarray(BI.to_limbs(1))
-    zero = jnp.zeros(BI.NLIMBS, jnp.int32)
-
-    def dbl2(a):
-        return add(a, a)
-
-    def eq_limbs(a, b):
-        return jnp.all(a == b, axis=-1)
-
-    def is_zero(a):
-        return jnp.all(a == 0, axis=-1)
-
-    # points: (X, Y, Z, inf) with X/Y/Z (..., 32) canonical limbs, inf bool
-    def jac_double(pt):
-        x, y, z, inf = pt
-        a = mul(x, x)
-        b = mul(y, y)
-        c = mul(b, b)
-        t = sub(sub(mul(add(x, b), add(x, b)), a), c)
-        d = dbl2(t)
-        e = add(dbl2(a), a)
-        f = mul(e, e)
-        x3 = sub(f, dbl2(d))
-        c8 = dbl2(dbl2(dbl2(c)))
-        y3 = sub(mul(e, sub(d, x3)), c8)
-        z3 = dbl2(mul(y, z))
-        # doubling a point with y == 0 would be the identity; BLS12-381 G1
-        # has no 2-torsion so that only happens at infinity, already flagged
-        return (x3, y3, z3, inf)
-
-    def jac_add(p, q):
-        """Complete addition: generic add, doubling and identity cases all
-        computed and selected branch-free."""
-        x1, y1, z1, inf1 = p
-        x2, y2, z2, inf2 = q
-        z1z1 = mul(z1, z1)
-        z2z2 = mul(z2, z2)
-        u1 = mul(x1, z2z2)
-        u2 = mul(x2, z1z1)
-        s1 = mul(mul(y1, z2), z2z2)
-        s2 = mul(mul(y2, z1), z1z1)
-        h = sub(u2, u1)
-        i = mul(dbl2(h), dbl2(h))
-        j = mul(h, i)
-        rr = dbl2(sub(s2, s1))
-        v = mul(u1, i)
-        x3 = sub(sub(mul(rr, rr), j), dbl2(v))
-        y3 = sub(mul(rr, sub(v, x3)), dbl2(mul(s1, j)))
-        z3 = mul(dbl2(mul(z1, z2)), h)
-
-        same_x = eq_limbs(u1, u2)
-        same_y = eq_limbs(s1, s2)
-        dx, dy, dz, dinf = jac_double(p)
-
-        def sel(mask, a, b):
-            return jnp.where(mask[..., None], a, b)
-
-        # doubling case (P == Q), cancellation case (P == -Q -> infinity)
-        out_x = sel(same_x & same_y, dx, x3)
-        out_y = sel(same_x & same_y, dy, y3)
-        out_z = sel(same_x & same_y, dz, z3)
-        out_inf = same_x & ~same_y
-        # identity operands
-        out_x = sel(inf1, x2, sel(inf2, x1, out_x))
-        out_y = sel(inf1, y2, sel(inf2, y1, out_y))
-        out_z = sel(inf1, z2, sel(inf2, z1, out_z))
-        out_inf = jnp.where(inf1, inf2, jnp.where(inf2, inf1, out_inf))
-        return (out_x, out_y, out_z, out_inf)
-
-    def ladder(base_xy, bits):
-        """(x, y) canonical-limb affine base + (SCALAR_BITS,) bits ->
-        Jacobian (X, Y, Z, inf) of bits * base."""
-        bx, by = base_xy
-        base = (bx, by, one_l, jnp.zeros((), jnp.bool_))
-        acc = (
-            jnp.zeros_like(bx),
-            jnp.zeros_like(by),
-            zero,
-            jnp.ones((), jnp.bool_),
-        )
-
-        def step(acc, bit):
-            acc = jac_double(acc)
-            added = jac_add(acc, base)
-            take = bit.astype(jnp.bool_)
-            out = (
-                jnp.where(take[..., None], added[0], acc[0]),
-                jnp.where(take[..., None], added[1], acc[1]),
-                jnp.where(take[..., None], added[2], acc[2]),
-                jnp.where(take, added[3], acc[3]),
-            )
-            return out, None
-
-        acc, _ = lax.scan(step, acc, bits)
-        return acc
-
+    field = {
+        "mul": ops["mul_mod"],
+        "add": ops["add_mod"],
+        "sub": ops["sub_mod"],
+        "one": jnp.asarray(BI.to_limbs(1)),
+        "zero": jnp.zeros(BI.NLIMBS, jnp.int32),
+        "eq": lambda a, b: jnp.all(a == b, axis=-1),
+        "felt_ndim": 1,
+    }
+    ladder = make_ladder(field, SCALAR_BITS)
     ladder_batched = jax.jit(jax.vmap(ladder, in_axes=((0, 0), 0)))
     return {"ladder_batched": ladder_batched}
 

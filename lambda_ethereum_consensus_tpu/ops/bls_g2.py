@@ -1,0 +1,114 @@
+"""Batched G2 scalar multiplication on device (Fq2 over limb arithmetic).
+
+The signature-side counterpart of :mod:`.bls_g1`: batch_verify's
+``r_i * sig_i`` multiplications run as the same field-generic ladder
+(:mod:`.ladder`) instantiated over Fq2 — elements are ``(..., 2, 32)`` limb
+arrays (c0, c1 with ``u^2 = -1``), with Karatsuba multiplication built from
+the scan-free Barrett base ops.  Twist curve parameters never enter the
+ladder (no on-curve logic), so the identical point formulas serve the twist.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import bigint as BI
+from .bls_g1 import SCALAR_BITS, _limbs_batch, _scalar_bits_batch
+
+
+def make_g2_ops():
+    import jax
+    import jax.numpy as jnp
+
+    from .ladder import make_ladder
+
+    ops = BI.get_ops()
+    mul1 = ops["mul_mod"]
+    add1 = ops["add_mod"]
+    sub1 = ops["sub_mod"]
+
+    def fq2_mul(a, b):
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        b0, b1 = b[..., 0, :], b[..., 1, :]
+        t0 = mul1(a0, b0)
+        t1 = mul1(a1, b1)
+        c0 = sub1(t0, t1)
+        c1 = sub1(sub1(mul1(add1(a0, a1), add1(b0, b1)), t0), t1)
+        return jnp.stack([c0, c1], axis=-2)
+
+    def fq2_add(a, b):
+        return jnp.stack(
+            [add1(a[..., 0, :], b[..., 0, :]), add1(a[..., 1, :], b[..., 1, :])],
+            axis=-2,
+        )
+
+    def fq2_sub(a, b):
+        return jnp.stack(
+            [sub1(a[..., 0, :], b[..., 0, :]), sub1(a[..., 1, :], b[..., 1, :])],
+            axis=-2,
+        )
+
+    field = {
+        "mul": fq2_mul,
+        "add": fq2_add,
+        "sub": fq2_sub,
+        "one": jnp.stack(
+            [jnp.asarray(BI.to_limbs(1)), jnp.zeros(BI.NLIMBS, jnp.int32)]
+        ),
+        "zero": jnp.zeros((2, BI.NLIMBS), jnp.int32),
+        "eq": lambda a, b: jnp.all(a == b, axis=(-1, -2)),
+        "felt_ndim": 2,
+    }
+    ladder = make_ladder(field, SCALAR_BITS)
+    ladder_batched = jax.jit(jax.vmap(ladder, in_axes=((0, 0), 0)))
+    return {"ladder_batched": ladder_batched}
+
+
+_G2_OPS = None
+
+
+def _get_g2_ops():
+    global _G2_OPS
+    if _G2_OPS is None:
+        _G2_OPS = make_g2_ops()
+    return _G2_OPS
+
+
+def _fq2_limbs_batch(values: list) -> np.ndarray:
+    """[(c0, c1) int pairs] -> (N, 2, 32) limb arrays."""
+    c0 = _limbs_batch([v[0] for v in values])
+    c1 = _limbs_batch([v[1] for v in values])
+    return np.stack([c0, c1], axis=1)
+
+
+def batch_g2_mul(points: list, scalars: list) -> list:
+    """Batched ``[k_i * Q_i]`` on device for G2 affine points.
+
+    ``points``: affine ``((x0, x1), (y0, y1))`` int tuples (no Nones);
+    ``scalars``: ints in [0, 2^256).  Returns the same tuple form or ``None``
+    for infinity results.
+    """
+    assert len(points) == len(scalars)
+    if not points:
+        return []
+    ops = _get_g2_ops()
+    bx = _fq2_limbs_batch([pt[0] for pt in points])
+    by = _fq2_limbs_batch([pt[1] for pt in points])
+    bits = _scalar_bits_batch(scalars)
+    X, Y, Z, inf = ops["ladder_batched"]((bx, by), bits)
+    X, Y, Z, inf = (np.asarray(X), np.asarray(Y), np.asarray(Z), np.asarray(inf))
+
+    def fq2_of(arr, i):
+        return (BI.from_limbs(arr[i, 0]), BI.from_limbs(arr[i, 1]))
+
+    # Jacobian -> affine through the host curve layer: fields.fq2_inv rides
+    # the native Montgomery powmod when built, so no duplicated Fq2 math here
+    from ..crypto.bls.curve import g2
+
+    out = []
+    for i in range(len(points)):
+        if bool(inf[i]):
+            out.append(None)
+            continue
+        out.append(g2.from_jacobian((fq2_of(X, i), fq2_of(Y, i), fq2_of(Z, i))))
+    return out

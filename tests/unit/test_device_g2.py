@@ -1,0 +1,45 @@
+"""Device G2 scalar multiplication vs the host curve oracle (CPU backend)."""
+
+import random
+
+from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
+from lambda_ethereum_consensus_tpu.crypto.bls.fields import R
+from lambda_ethereum_consensus_tpu.ops.bls_g2 import batch_g2_mul
+
+RNG = random.Random(67)
+
+
+def host_mul(pt, k):
+    return C.g2._multiply_py(pt, k)
+
+
+def test_g2_ladder_matches_host():
+    base2 = host_mul(C.G2_GENERATOR, 123456789)
+    pts = [C.G2_GENERATOR, base2, C.G2_GENERATOR, C.G2_GENERATOR, C.G2_GENERATOR]
+    ks = [1, RNG.getrandbits(128) | 1, RNG.getrandbits(200), 0, R]
+    got = batch_g2_mul(pts, ks)
+    for pt, k, g in zip(pts, ks, got):
+        want = host_mul(pt, k)
+        assert g == want, hex(k)
+    assert got[3] is None and got[4] is None
+
+
+def test_g2_empty_batch():
+    assert batch_g2_mul([], []) == []
+
+
+def test_batch_verify_through_device_msm(monkeypatch):
+    """The RLC batch verification with its scalar mults on device."""
+    from lambda_ethereum_consensus_tpu.crypto import bls
+
+    monkeypatch.setenv("BLS_DEVICE_MSM", "1")
+    monkeypatch.setenv("BLS_DEVICE_MSM_MIN", "1")
+    sks = [(i + 60).to_bytes(32, "big") for i in range(3)]
+    items = [
+        (bls.sk_to_pk(sk), b"device batch", bls.sign(sk, b"device batch"))
+        for sk in sks
+    ]
+    assert bls.batch_verify(items)
+    forged = list(items)
+    forged[1] = (forged[1][0], b"device batch", bls.sign(sks[0], b"x"))
+    assert not bls.batch_verify(forged)
