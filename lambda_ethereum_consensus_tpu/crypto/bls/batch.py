@@ -30,7 +30,7 @@ from typing import Sequence
 from . import curve as C
 from .curve import DeserializationError
 from .hash_to_curve import DST_POP, hash_to_g2
-from .pairing import pairing_check
+from .pairing import env_flag, pairing_check
 
 __all__ = ["batch_verify", "batch_verify_each_points", "verify_points"]
 
@@ -45,13 +45,13 @@ def _scale_entries(entries, coeffs):
     and the batch amortizes the dispatch (the TPU ladder beats the native
     host path from a few hundred items up; see ops/bls_g1.py)."""
     threshold = int(os.environ.get("BLS_DEVICE_MSM_MIN", "256"))
-    enabled = os.environ.get("BLS_DEVICE_MSM", "") not in ("", "0", "false")
-    if enabled and len(entries) >= threshold:
+    if env_flag("BLS_DEVICE_MSM") and len(entries) >= threshold:
         from ...ops.bls_g1 import batch_g1_mul
         from ...ops.bls_g2 import batch_g2_mul
 
-        pks = batch_g1_mul([pk for pk, _, _ in entries], coeffs)
-        sigs = batch_g2_mul([sig for _, _, sig in entries], coeffs)
+        # RLC coefficients are _COEFF_BITS wide: run the short ladder
+        pks = batch_g1_mul([pk for pk, _, _ in entries], coeffs, _COEFF_BITS)
+        sigs = batch_g2_mul([sig for _, _, sig in entries], coeffs, _COEFF_BITS)
         return pks, sigs
     pks = [C.g1.multiply_raw(pk, r) for (pk, _, _), r in zip(entries, coeffs)]
     sigs = [C.g2.multiply_raw(sig, r) for (_, _, sig), r in zip(entries, coeffs)]

@@ -20,6 +20,7 @@ out in pairings).  Design choices for a from-scratch host implementation:
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 from . import fields as F
@@ -148,8 +149,30 @@ def pairing(p: AffinePoint, q: AffinePoint) -> F.Fq12:
     return final_exponentiation(miller_loop(p, q))
 
 
+def env_flag(name: str) -> bool:
+    """Shared truthiness parse for the device-routing env flags."""
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "no", "off",
+    )
+
+
+def _device_pairing_enabled(n: int) -> bool:
+    """Route big pairing products to the batched device Miller loop
+    (ops/bls_pairing) — the RLC batch-verify shape: many pairs, one check.
+    Small checks stay on the native host path, which wins below the
+    device dispatch/transfer overhead."""
+    if not env_flag("BLS_DEVICE_PAIRING"):
+        return False
+    return n >= int(os.environ.get("BLS_DEVICE_PAIRING_MIN", "32"))
+
+
 def pairing_check(pairs: list[tuple[AffinePoint, AffinePoint]]) -> bool:
-    """True iff prod e(P_i, Q_i) == 1, with a single final exponentiation."""
+    """True iff prod e(P_i, Q_i) == 1, with a single final exponentiation.
+
+    Precondition: points must be in the prime-order subgroups (every
+    in-repo caller deserializes through the subgroup-checking decoders).
+    The branch-free device route relies on this — its unconditional step
+    formulas have no vertical-line handling, unlike the host loop."""
     live = []
     for p, q in pairs:
         if p is None or q is None:
@@ -159,6 +182,10 @@ def pairing_check(pairs: list[tuple[AffinePoint, AffinePoint]]) -> bool:
         live.append((p, q))
     if not live:
         return True
+    if _device_pairing_enabled(len(live)):
+        from ...ops.bls_pairing import pairing_product_is_one
+
+        return pairing_product_is_one(live)
     from . import native
 
     if native.available():

@@ -8,7 +8,8 @@ checkpoint (pulled-up tip) machinery.
 
 Layout: :mod:`.store` (the Store object + constructor), :mod:`.handlers`
 (``on_tick`` / ``on_block`` / ``on_attestation`` / ``on_attester_slashing``),
-:mod:`.head` (``get_head`` with batched vote-weight accumulation).
+:mod:`.head` (``get_head`` with batched vote-weight accumulation),
+:mod:`.tree` (incremental cached-head fork tree, ref: fork_choice/tree.ex).
 """
 
 from .handlers import (
@@ -20,9 +21,11 @@ from .handlers import (
 )
 from .head import get_head, get_weight
 from .store import ForkChoiceError, LatestMessage, Store, get_forkchoice_store
+from .tree import ForkTree
 
 __all__ = [
     "ForkChoiceError",
+    "ForkTree",
     "LatestMessage",
     "Store",
     "get_forkchoice_store",

@@ -1,0 +1,127 @@
+"""Incremental greedy-heaviest-subtree fork tree with a cached head.
+
+Equivalent of the reference's standalone fork-choice tree cache (ref:
+lib/lambda_ethereum_consensus/fork_choice/tree.ex:19-127): O(depth)
+weight propagation per update, O(1) head reads — the complement to the
+full LMD-GHOST recomputation in :mod:`.head`, for callers that need the
+head on every tick rather than on every attestation drain.
+
+Design differences from the reference GenServer: this is a plain host
+object (the runtime's single-controller loop owns it — ARCHITECTURE.md
+"actor -> owner loop" mapping) and weight deltas may be negative (vote
+moves subtract from the old target's chain), so each update re-picks the
+best child at every ancestor — O(depth x branching) per update, which for
+beacon-chain fork counts is indistinguishable from O(depth).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["ForkTree"]
+
+
+@dataclass
+class _Node:
+    root: bytes
+    parent: bytes | None
+    children: list[bytes] = field(default_factory=list)
+    # own + descendants' attestation weight
+    subtree_weight: int = 0
+    # child whose subtree this node's best chain descends into (None = leaf)
+    best_child: bytes | None = None
+    # deepest best-chain block under (or equal to) this node
+    best_descendant: bytes = b""
+
+    def __post_init__(self):
+        if not self.best_descendant:
+            self.best_descendant = self.root
+
+
+def _better(a_weight: int, a_root: bytes, b_weight: int, b_root: bytes) -> bool:
+    """Spec tie-break: heavier subtree wins, lexicographically larger root
+    breaks ties (mirrors get_head's max_by ordering)."""
+    return (a_weight, a_root) > (b_weight, b_root)
+
+
+class ForkTree:
+    def __init__(self, anchor_root: bytes):
+        self._nodes: dict[bytes, _Node] = {anchor_root: _Node(anchor_root, None)}
+        self._root = anchor_root
+
+    # ------------------------------------------------------------- reads
+    @property
+    def root(self) -> bytes:
+        return self._root
+
+    def head(self) -> bytes:
+        """O(1): cached best descendant of the tree root."""
+        return self._nodes[self._root].best_descendant
+
+    def __contains__(self, root: bytes) -> bool:
+        return root in self._nodes
+
+    def weight(self, root: bytes) -> int:
+        return self._nodes[root].subtree_weight
+
+    # ------------------------------------------------------------ writes
+    def add_block(self, root: bytes, parent_root: bytes) -> None:
+        """Insert a block under its parent; no-op if already present.
+        Raises KeyError for an unknown parent (callers queue orphans —
+        the PendingBlocks loop owns that concern)."""
+        if root in self._nodes:
+            return
+        parent = self._nodes[parent_root]
+        self._nodes[root] = _Node(root, parent_root)
+        parent.children.append(root)
+        # a fresh zero-weight leaf can still win the tie-break ordering
+        self._refresh_best_up(parent_root)
+
+    def add_weight(self, root: bytes, delta: int) -> None:
+        """Add attestation weight under ``root`` — the delta lands on every
+        ancestor's cumulative subtree weight — and re-cache best chains
+        along the path (O(depth))."""
+        cur: bytes | None = root
+        while cur is not None:
+            node = self._nodes[cur]
+            node.subtree_weight += delta
+            cur = node.parent
+        self._refresh_best_up(root)
+
+    def prune(self, new_root: bytes) -> None:
+        """Re-root at a finalized block, dropping everything outside its
+        subtree (ref analogue: fork-choice store restart on finality)."""
+        keep: set[bytes] = set()
+        stack = [new_root]
+        while stack:
+            r = stack.pop()
+            keep.add(r)
+            stack.extend(self._nodes[r].children)
+        self._nodes = {r: n for r, n in self._nodes.items() if r in keep}
+        node = self._nodes[new_root]
+        node.parent = None
+        self._root = new_root
+
+    # ---------------------------------------------------------- internal
+    def _best_of(self, node: _Node) -> tuple[bytes | None, bytes]:
+        """(best_child, best_descendant) recomputed from children."""
+        best = None
+        for c in node.children:
+            ch = self._nodes[c]
+            if best is None or _better(
+                ch.subtree_weight, c, self._nodes[best].subtree_weight, best
+            ):
+                best = c
+        if best is None:
+            return None, node.root
+        return best, self._nodes[best].best_descendant
+
+    def _refresh_best_up(self, root: bytes) -> None:
+        # Walk all the way to the tree root: even when a node's own best
+        # child is unchanged, its subtree weight may have, which can flip
+        # the choice at its parent.
+        cur: bytes | None = root
+        while cur is not None:
+            node = self._nodes[cur]
+            node.best_child, node.best_descendant = self._best_of(node)
+            cur = node.parent

@@ -1,0 +1,311 @@
+"""Batched Fq2/Fq6/Fq12 tower arithmetic on device (JAX, limb form).
+
+The extension-field layer of the device pairing (SURVEY.md §7 hard-part #1).
+Mirrors the host tower in ``crypto/bls/fields.py`` — same xi = 1 + u,
+v^3 = xi, w^2 = v construction, same Karatsuba interpolation — but every op
+is batched over arbitrary leading axes on top of the scan-free Barrett base
+field in :mod:`.bigint`.
+
+Layouts (little-endian 12-bit limbs, int32):
+
+- Fq:   ``(..., 32)``
+- Fq2:  ``(..., 2, 32)``            — (c0, c1), u^2 = -1
+- Fq6:  ``(..., 3, 2, 32)``         — (c0, c1, c2) over v
+- Fq12: ``(..., 2, 3, 2, 32)``      — (c0, c1) over w
+
+Inversion bottoms out in a batched Fermat powmod (a^(p-2)), a
+``lax.scan`` over the static exponent bits — O(log p) batched muls, no
+per-element host work.  Frobenius gamma constants are taken numerically
+from the host field module rather than transcribed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.bls import fields as F
+from . import bigint as BI
+
+__all__ = ["make_fq12_ops", "get_fq12_ops", "fq12_to_limbs", "fq12_from_limbs"]
+
+
+def fq2_to_limbs(a) -> np.ndarray:
+    return np.stack([BI.to_limbs(a[0]), BI.to_limbs(a[1])])
+
+
+def fq2_from_limbs(arr) -> tuple:
+    return (BI.from_limbs(arr[0]), BI.from_limbs(arr[1]))
+
+
+def fq12_to_limbs(f) -> np.ndarray:
+    """Host Fq12 tuple -> (2, 3, 2, 32) limb array."""
+    return np.stack(
+        [np.stack([fq2_to_limbs(c) for c in half]) for half in f]
+    )
+
+
+def fq12_from_limbs(arr) -> tuple:
+    """(2, 3, 2, 32) limb array -> host Fq12 tuple."""
+    return tuple(
+        tuple(fq2_from_limbs(arr[i, j]) for j in range(3)) for i in range(2)
+    )
+
+
+def _bits_lsb(e: int) -> np.ndarray:
+    return np.array([(e >> i) & 1 for i in range(e.bit_length())], np.int32)
+
+
+def make_fq12_ops():
+    """Build the device tower ops dict (jax imported lazily, repo pattern)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    base = BI.get_ops()
+    mul = base["mul_mod"]
+    add = base["add_mod"]
+    sub = base["sub_mod"]
+
+    zero_fq = np.zeros(BI.NLIMBS, np.int32)
+
+    def neg(a):
+        return sub(jnp.zeros_like(a), a)
+
+    # ------------------------------------------------------------- Fq2
+    def fq2(c0, c1):
+        return jnp.stack([c0, c1], axis=-2)
+
+    def fq2_mul(a, b):
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        b0, b1 = b[..., 0, :], b[..., 1, :]
+        t0 = mul(a0, b0)
+        t1 = mul(a1, b1)
+        c0 = sub(t0, t1)
+        c1 = sub(sub(mul(add(a0, a1), add(b0, b1)), t0), t1)
+        return fq2(c0, c1)
+
+    def fq2_sq(a):
+        # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u  — 2 muls
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        t = mul(add(a0, a1), sub(a0, a1))
+        m = mul(a0, a1)
+        return fq2(t, add(m, m))
+
+    def fq2_add(a, b):
+        return fq2(
+            add(a[..., 0, :], b[..., 0, :]), add(a[..., 1, :], b[..., 1, :])
+        )
+
+    def fq2_sub(a, b):
+        return fq2(
+            sub(a[..., 0, :], b[..., 0, :]), sub(a[..., 1, :], b[..., 1, :])
+        )
+
+    def fq2_neg(a):
+        return fq2_sub(jnp.zeros_like(a), a)
+
+    def fq2_conj(a):
+        return fq2(a[..., 0, :], neg(a[..., 1, :]))
+
+    def fq2_mul_by_xi(a):
+        # xi = 1 + u: (a0 - a1, a0 + a1)
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        return fq2(sub(a0, a1), add(a0, a1))
+
+    def fq2_scale_fp(a, s):
+        """Fq2 element times base-field scalar s (..., 32)."""
+        return fq2(mul(a[..., 0, :], s), mul(a[..., 1, :], s))
+
+    # Batched Fermat inversion: a^(p-2) by square-and-multiply over the
+    # static exponent bits (LSB-first scan).
+    _pm2_bits = jnp.asarray(_bits_lsb(F.P - 2))
+
+    def fp_inv(a):
+        one = jnp.broadcast_to(jnp.asarray(BI.to_limbs(1)), a.shape)
+
+        def body(carry, bit):
+            result, pw = carry
+            taken = mul(result, pw)
+            result = jnp.where(bit != 0, taken, result)
+            return (result, mul(pw, pw)), None
+
+        (result, _), _ = lax.scan(body, (one, a), _pm2_bits)
+        return result
+
+    def fq2_inv(a):
+        a0, a1 = a[..., 0, :], a[..., 1, :]
+        norm = add(mul(a0, a0), mul(a1, a1))
+        ninv = fp_inv(norm)
+        return fq2(mul(a0, ninv), neg(mul(a1, ninv)))
+
+    # ------------------------------------------------------------- Fq6
+    def fq6(c0, c1, c2):
+        return jnp.stack([c0, c1, c2], axis=-3)
+
+    def _fq6_parts(a):
+        return a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+
+    def fq6_add(a, b):
+        return fq6(*[fq2_add(x, y) for x, y in zip(_fq6_parts(a), _fq6_parts(b))])
+
+    def fq6_sub(a, b):
+        return fq6(*[fq2_sub(x, y) for x, y in zip(_fq6_parts(a), _fq6_parts(b))])
+
+    def fq6_neg(a):
+        return fq6_sub(jnp.zeros_like(a), a)
+
+    def fq6_mul(a, b):
+        # Devegili interpolation, mirrors fields.fq6_mul (6 fq2 muls)
+        a0, a1, a2 = _fq6_parts(a)
+        b0, b1, b2 = _fq6_parts(b)
+        t0 = fq2_mul(a0, b0)
+        t1 = fq2_mul(a1, b1)
+        t2 = fq2_mul(a2, b2)
+        c0 = fq2_add(
+            t0,
+            fq2_mul_by_xi(
+                fq2_sub(
+                    fq2_mul(fq2_add(a1, a2), fq2_add(b1, b2)), fq2_add(t1, t2)
+                )
+            ),
+        )
+        c1 = fq2_add(
+            fq2_sub(fq2_mul(fq2_add(a0, a1), fq2_add(b0, b1)), fq2_add(t0, t1)),
+            fq2_mul_by_xi(t2),
+        )
+        c2 = fq2_add(
+            fq2_sub(fq2_mul(fq2_add(a0, a2), fq2_add(b0, b2)), fq2_add(t0, t2)),
+            t1,
+        )
+        return fq6(c0, c1, c2)
+
+    def fq6_mul_by_v(a):
+        a0, a1, a2 = _fq6_parts(a)
+        return fq6(fq2_mul_by_xi(a2), a0, a1)
+
+    def fq6_sq(a):
+        return fq6_mul(a, a)
+
+    def fq6_inv(a):
+        a0, a1, a2 = _fq6_parts(a)
+        c0 = fq2_sub(fq2_sq(a0), fq2_mul_by_xi(fq2_mul(a1, a2)))
+        c1 = fq2_sub(fq2_mul_by_xi(fq2_sq(a2)), fq2_mul(a0, a1))
+        c2 = fq2_sub(fq2_sq(a1), fq2_mul(a0, a2))
+        t = fq2_add(
+            fq2_mul_by_xi(fq2_add(fq2_mul(a2, c1), fq2_mul(a1, c2))),
+            fq2_mul(a0, c0),
+        )
+        tinv = fq2_inv(t)
+        return fq6(fq2_mul(c0, tinv), fq2_mul(c1, tinv), fq2_mul(c2, tinv))
+
+    # ------------------------------------------------------------- Fq12
+    def fq12(c0, c1):
+        return jnp.stack([c0, c1], axis=-4)
+
+    def _fq12_parts(a):
+        return a[..., 0, :, :, :], a[..., 1, :, :, :]
+
+    def fq12_mul(a, b):
+        a0, a1 = _fq12_parts(a)
+        b0, b1 = _fq12_parts(b)
+        t0 = fq6_mul(a0, b0)
+        t1 = fq6_mul(a1, b1)
+        c0 = fq6_add(t0, fq6_mul_by_v(t1))
+        c1 = fq6_sub(
+            fq6_mul(fq6_add(a0, a1), fq6_add(b0, b1)), fq6_add(t0, t1)
+        )
+        return fq12(c0, c1)
+
+    def fq12_sq(a):
+        a0, a1 = _fq12_parts(a)
+        t = fq6_mul(a0, a1)
+        c0 = fq6_sub(
+            fq6_mul(fq6_add(a0, a1), fq6_add(a0, fq6_mul_by_v(a1))),
+            fq6_add(t, fq6_mul_by_v(t)),
+        )
+        return fq12(c0, fq6_add(t, t))
+
+    def fq12_conj(a):
+        a0, a1 = _fq12_parts(a)
+        return fq12(a0, fq6_neg(a1))
+
+    def fq12_inv(a):
+        a0, a1 = _fq12_parts(a)
+        t = fq6_sub(fq6_sq(a0), fq6_mul_by_v(fq6_sq(a1)))
+        tinv = fq6_inv(t)
+        return fq12(fq6_mul(a0, tinv), fq6_neg(fq6_mul(a1, tinv)))
+
+    # --------------------------------------------------- Frobenius maps
+    # Gamma constants lifted numerically from the host field module.
+    g6_1 = jnp.asarray(fq2_to_limbs(F._GAMMA6_1))
+    g6_2 = jnp.asarray(fq2_to_limbs(F._GAMMA6_2))
+    g12 = jnp.asarray(fq2_to_limbs(F._GAMMA12))
+
+    def fq6_frobenius(a):
+        a0, a1, a2 = _fq6_parts(a)
+        return fq6(
+            fq2_conj(a0),
+            fq2_mul(fq2_conj(a1), g6_1),
+            fq2_mul(fq2_conj(a2), g6_2),
+        )
+
+    def fq12_frobenius(a):
+        a0, a1 = _fq12_parts(a)
+        f0 = fq6_frobenius(a0)
+        f1 = fq6_frobenius(a1)
+        f1 = fq6(*[fq2_mul(c, g12) for c in _fq6_parts(f1)])
+        return fq12(f0, f1)
+
+    # Constant builders ---------------------------------------------------
+    one_fq2 = np.stack([BI.to_limbs(1), zero_fq])
+    one_fq6 = np.stack([one_fq2, np.zeros_like(one_fq2), np.zeros_like(one_fq2)])
+    one_fq12 = np.stack([one_fq6, np.zeros_like(one_fq6)])
+
+    def fq12_one(batch_shape=()):
+        return jnp.broadcast_to(
+            jnp.asarray(one_fq12), (*batch_shape, *one_fq12.shape)
+        )
+
+    def fq12_is_one(a):
+        """Boolean mask over leading axes."""
+        target = fq12_one(a.shape[:-4])
+        return jnp.all(a == target, axis=(-1, -2, -3, -4))
+
+    return {
+        "fq2_mul": fq2_mul,
+        "fq2_sq": fq2_sq,
+        "fq2_add": fq2_add,
+        "fq2_sub": fq2_sub,
+        "fq2_neg": fq2_neg,
+        "fq2_conj": fq2_conj,
+        "fq2_mul_by_xi": fq2_mul_by_xi,
+        "fq2_scale_fp": fq2_scale_fp,
+        "fq2_inv": fq2_inv,
+        "fp_inv": fp_inv,
+        "fq6_mul": fq6_mul,
+        "fq6_mul_by_v": fq6_mul_by_v,
+        "fq6_add": fq6_add,
+        "fq6_sub": fq6_sub,
+        "fq6_sq": fq6_sq,
+        "fq6_inv": fq6_inv,
+        "fq12_mul": fq12_mul,
+        "fq12_sq": fq12_sq,
+        "fq12_conj": fq12_conj,
+        "fq12_inv": fq12_inv,
+        "fq12_frobenius": fq12_frobenius,
+        "fq12_one": fq12_one,
+        "fq12_is_one": fq12_is_one,
+        "mul": mul,
+        "add": add,
+        "sub": sub,
+        "neg": neg,
+    }
+
+
+_FQ12_OPS = None
+
+
+def get_fq12_ops():
+    global _FQ12_OPS
+    if _FQ12_OPS is None:
+        _FQ12_OPS = make_fq12_ops()
+    return _FQ12_OPS

@@ -25,11 +25,11 @@ from . import bigint as BI
 SCALAR_BITS = 256
 
 
-def _scalar_bits_batch(ks: list) -> np.ndarray:
-    """ints -> (N, SCALAR_BITS) int32 bits, MSB first (vectorized)."""
-    raw = b"".join(int(k).to_bytes(SCALAR_BITS // 8, "big") for k in ks)
+def _scalar_bits_batch(ks: list, nbits: int = SCALAR_BITS) -> np.ndarray:
+    """ints -> (N, nbits) int32 bits, MSB first (vectorized)."""
+    raw = b"".join(int(k).to_bytes(nbits // 8, "big") for k in ks)
     bits = np.unpackbits(np.frombuffer(raw, np.uint8))
-    return bits.reshape(len(ks), SCALAR_BITS).astype(np.int32)
+    return bits.reshape(len(ks), nbits).astype(np.int32)
 
 
 def _limbs_batch(xs: list) -> np.ndarray:
@@ -43,7 +43,7 @@ def _limbs_batch(xs: list) -> np.ndarray:
     return limbs_be[:, ::-1].copy()  # little-endian limb order
 
 
-def make_g1_ops():
+def make_g1_ops(nbits: int = SCALAR_BITS):
     import jax
     import jax.numpy as jnp
 
@@ -59,35 +59,37 @@ def make_g1_ops():
         "eq": lambda a, b: jnp.all(a == b, axis=-1),
         "felt_ndim": 1,
     }
-    ladder = make_ladder(field, SCALAR_BITS)
+    ladder = make_ladder(field, nbits)
     ladder_batched = jax.jit(jax.vmap(ladder, in_axes=((0, 0), 0)))
     return {"ladder_batched": ladder_batched}
 
 
-_G1_OPS = None
+# one compiled ladder per scalar width (256 generic, 128 for RLC coefficients)
+_G1_OPS: dict = {}
 
 
-def _get_g1_ops():
-    global _G1_OPS
-    if _G1_OPS is None:
-        _G1_OPS = make_g1_ops()
-    return _G1_OPS
+def _get_g1_ops(nbits: int):
+    if nbits not in _G1_OPS:
+        _G1_OPS[nbits] = make_g1_ops(nbits)
+    return _G1_OPS[nbits]
 
 
-def batch_g1_mul(points: list, scalars: list) -> list:
+def batch_g1_mul(points: list, scalars: list, bits: int = SCALAR_BITS) -> list:
     """Batched scalar multiplication: ``[k_i * P_i]`` on device.
 
     ``points``: affine ``(x, y)`` int pairs (no Nones); ``scalars``: ints in
-    [0, 2^256).  Returns affine int pairs or ``None`` for infinity results.
+    [0, 2^bits) — callers with short scalars (the 128-bit RLC coefficients)
+    pass the width so the ladder runs half the steps.  Returns affine int
+    pairs or ``None`` for infinity results.
     """
     assert len(points) == len(scalars)
     if not points:
         return []
-    ops = _get_g1_ops()
+    ops = _get_g1_ops(bits)
     bx = _limbs_batch([x for x, _ in points])
     by = _limbs_batch([y for _, y in points])
-    bits = _scalar_bits_batch(scalars)
-    X, Y, Z, inf = ops["ladder_batched"]((bx, by), bits)
+    kbits = _scalar_bits_batch(scalars, bits)
+    X, Y, Z, inf = ops["ladder_batched"]((bx, by), kbits)
     # bulk device->host transfer once, not per element
     X, Y, Z, inf = (np.asarray(X), np.asarray(Y), np.asarray(Z), np.asarray(inf))
     live = [i for i in range(len(points)) if not bool(inf[i])]

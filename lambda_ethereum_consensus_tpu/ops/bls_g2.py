@@ -2,56 +2,46 @@
 
 The signature-side counterpart of :mod:`.bls_g1`: batch_verify's
 ``r_i * sig_i`` multiplications run as the same field-generic ladder
-(:mod:`.ladder`) instantiated over Fq2 — elements are ``(..., 2, 32)`` limb
-arrays (c0, c1 with ``u^2 = -1``), with Karatsuba multiplication built from
-the scan-free Barrett base ops.  Twist curve parameters never enter the
-ladder (no on-curve logic), so the identical point formulas serve the twist.
+(:mod:`.ladder`) instantiated over the shared Fq2 tower ops from
+:mod:`.bls_fq12` — elements are ``(..., 2, 32)`` limb arrays.  Twist curve
+parameters never enter the ladder (no on-curve logic), so the identical
+point formulas serve the twist.
+
+Host boundary: affine Fq2 int pairs in/out; the Jacobian -> affine
+conversion batch-inverts every z with ONE Fp modexp (Fq2 inverse =
+conjugate over Fp norm, norms inverted with the Montgomery prefix trick),
+mirroring the G1 path.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from ..crypto.bls import fields as F
+from ..crypto.bls.fields import P
 from . import bigint as BI
+from .bls_fq12 import get_fq12_ops
 from .bls_g1 import SCALAR_BITS, _limbs_batch, _scalar_bits_batch
 
 
-def make_g2_ops():
+def fq2_limbs_batch(values: list) -> np.ndarray:
+    """[(c0, c1) int pairs] -> (N, 2, 32) limb arrays (shared packer)."""
+    c0 = _limbs_batch([v[0] for v in values])
+    c1 = _limbs_batch([v[1] for v in values])
+    return np.stack([c0, c1], axis=1)
+
+
+def make_g2_ops(nbits: int = SCALAR_BITS):
     import jax
     import jax.numpy as jnp
 
     from .ladder import make_ladder
 
-    ops = BI.get_ops()
-    mul1 = ops["mul_mod"]
-    add1 = ops["add_mod"]
-    sub1 = ops["sub_mod"]
-
-    def fq2_mul(a, b):
-        a0, a1 = a[..., 0, :], a[..., 1, :]
-        b0, b1 = b[..., 0, :], b[..., 1, :]
-        t0 = mul1(a0, b0)
-        t1 = mul1(a1, b1)
-        c0 = sub1(t0, t1)
-        c1 = sub1(sub1(mul1(add1(a0, a1), add1(b0, b1)), t0), t1)
-        return jnp.stack([c0, c1], axis=-2)
-
-    def fq2_add(a, b):
-        return jnp.stack(
-            [add1(a[..., 0, :], b[..., 0, :]), add1(a[..., 1, :], b[..., 1, :])],
-            axis=-2,
-        )
-
-    def fq2_sub(a, b):
-        return jnp.stack(
-            [sub1(a[..., 0, :], b[..., 0, :]), sub1(a[..., 1, :], b[..., 1, :])],
-            axis=-2,
-        )
-
+    fq = get_fq12_ops()
     field = {
-        "mul": fq2_mul,
-        "add": fq2_add,
-        "sub": fq2_sub,
+        "mul": fq["fq2_mul"],
+        "add": fq["fq2_add"],
+        "sub": fq["fq2_sub"],
         "one": jnp.stack(
             [jnp.asarray(BI.to_limbs(1)), jnp.zeros(BI.NLIMBS, jnp.int32)]
         ),
@@ -59,56 +49,69 @@ def make_g2_ops():
         "eq": lambda a, b: jnp.all(a == b, axis=(-1, -2)),
         "felt_ndim": 2,
     }
-    ladder = make_ladder(field, SCALAR_BITS)
+    ladder = make_ladder(field, nbits)
     ladder_batched = jax.jit(jax.vmap(ladder, in_axes=((0, 0), 0)))
     return {"ladder_batched": ladder_batched}
 
 
-_G2_OPS = None
+_G2_OPS: dict = {}
 
 
-def _get_g2_ops():
-    global _G2_OPS
-    if _G2_OPS is None:
-        _G2_OPS = make_g2_ops()
-    return _G2_OPS
+def _get_g2_ops(nbits: int):
+    if nbits not in _G2_OPS:
+        _G2_OPS[nbits] = make_g2_ops(nbits)
+    return _G2_OPS[nbits]
 
 
-def _fq2_limbs_batch(values: list) -> np.ndarray:
-    """[(c0, c1) int pairs] -> (N, 2, 32) limb arrays."""
-    c0 = _limbs_batch([v[0] for v in values])
-    c1 = _limbs_batch([v[1] for v in values])
-    return np.stack([c0, c1], axis=1)
-
-
-def batch_g2_mul(points: list, scalars: list) -> list:
+def batch_g2_mul(points: list, scalars: list, bits: int = SCALAR_BITS) -> list:
     """Batched ``[k_i * Q_i]`` on device for G2 affine points.
 
     ``points``: affine ``((x0, x1), (y0, y1))`` int tuples (no Nones);
-    ``scalars``: ints in [0, 2^256).  Returns the same tuple form or ``None``
-    for infinity results.
+    ``scalars``: ints in [0, 2^bits).  Returns the same tuple form or
+    ``None`` for infinity results.
     """
     assert len(points) == len(scalars)
     if not points:
         return []
-    ops = _get_g2_ops()
-    bx = _fq2_limbs_batch([pt[0] for pt in points])
-    by = _fq2_limbs_batch([pt[1] for pt in points])
-    bits = _scalar_bits_batch(scalars)
-    X, Y, Z, inf = ops["ladder_batched"]((bx, by), bits)
+    ops = _get_g2_ops(bits)
+    bx = fq2_limbs_batch([pt[0] for pt in points])
+    by = fq2_limbs_batch([pt[1] for pt in points])
+    kbits = _scalar_bits_batch(scalars, bits)
+    X, Y, Z, inf = ops["ladder_batched"]((bx, by), kbits)
     X, Y, Z, inf = (np.asarray(X), np.asarray(Y), np.asarray(Z), np.asarray(inf))
 
     def fq2_of(arr, i):
         return (BI.from_limbs(arr[i, 0]), BI.from_limbs(arr[i, 1]))
 
-    # Jacobian -> affine through the host curve layer: fields.fq2_inv rides
-    # the native Montgomery powmod when built, so no duplicated Fq2 math here
-    from ..crypto.bls.curve import g2
-
+    live = [i for i in range(len(points)) if not bool(inf[i])]
+    zs = {i: fq2_of(Z, i) for i in live}
+    # Fq2 inverse via conjugate / Fp norm; all norms inverted with one
+    # modexp (Montgomery prefix products), as in batch_g1_mul
+    norms = {i: (zs[i][0] * zs[i][0] + zs[i][1] * zs[i][1]) % P for i in live}
+    zinvs: dict[int, tuple] = {}
+    if live:
+        for i in live:
+            assert norms[i] != 0, "finite ladder result with z == 0"
+        prefix = []
+        acc = 1
+        for i in live:
+            acc = acc * norms[i] % P
+            prefix.append(acc)
+        inv_all = pow(acc, P - 2, P)
+        for idx in range(len(live) - 1, -1, -1):
+            i = live[idx]
+            before = prefix[idx - 1] if idx > 0 else 1
+            ninv = inv_all * before % P
+            inv_all = inv_all * norms[i] % P
+            zinvs[i] = (zs[i][0] * ninv % P, (P - zs[i][1]) * ninv % P)
     out = []
     for i in range(len(points)):
-        if bool(inf[i]):
+        if i not in zinvs:
             out.append(None)
             continue
-        out.append(g2.from_jacobian((fq2_of(X, i), fq2_of(Y, i), fq2_of(Z, i))))
+        zinv2 = F.fq2_sq(zinvs[i])
+        zinv3 = F.fq2_mul(zinv2, zinvs[i])
+        out.append(
+            (F.fq2_mul(fq2_of(X, i), zinv2), F.fq2_mul(fq2_of(Y, i), zinv3))
+        )
     return out

@@ -1,0 +1,316 @@
+"""Batched BLS12-381 optimal-ate pairing on device (JAX over limb towers).
+
+The device counterpart of ``crypto/bls/pairing.py`` and the lockstep C++
+Miller loop in ``native/bls381/bls381.cpp`` (SURVEY.md §7 hard-part #1:
+"batched pairing under vmap ... Miller loops + shared final
+exponentiation").  Same line-slot convention as the native backend — the
+line through the running twist point r evaluated at P = (px, py), scaled
+by xi, lives at tower slots w^0 / w^3 / w^5:
+
+    l = (py*xi) * w^0 + (lambda*x_r - y_r) * w^3 + (-lambda*px) * w^5
+
+— but where the native path stays affine and shares one Montgomery batch
+inversion per step (a serial host trick), the device loop clears
+denominators into homogeneous projective coordinates (X, Y, Z): scaling a
+line by any Fq2 factor is legal because subfield factors die in the final
+exponentiation's p^6-1 part, so each step is inversion-free and the whole
+batch advances in lockstep under one ``lax.scan``.
+
+Exceptional cases (vertical lines, doubling-as-addition) cannot occur for
+the inputs this module accepts: subgroup-checked points of prime order R
+with the loop scalar |x| << R, infinities filtered by the caller — so the
+step formulas are used unconditionally and the kernel stays branch-free.
+
+Final exponentiation mirrors the host addition chain (cubed hard part,
+``crypto/bls/pairing.py:124-138``) with ``a^|x|`` as a scan over the static
+parameter bits; inversion is the batched Fermat powmod from
+:mod:`.bls_fq12`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.bls.fields import BLS_X
+from . import bigint as BI
+from . import bls_fq12 as FQ
+from .bls_g1 import _limbs_batch
+
+__all__ = [
+    "make_pairing_ops",
+    "miller_loop_batch",
+    "pairing_product_is_one",
+    "pairing_products_are_one",
+]
+
+# MSB-first bits of |x| after the leading 1 (63 entries), shared by the
+# Miller loop and a^x — identical to the host/native loop order.
+_X_BITS = np.array([int(b) for b in bin(BLS_X)[3:]], np.int32)
+
+# w-power -> (c1?, v-power) tower slot, per w^2 = v, v^3 = xi.
+_W_SLOTS = [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2)]
+
+
+def make_pairing_ops():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ops = FQ.get_fq12_ops()
+    f2m, f2s = ops["fq2_mul"], ops["fq2_sq"]
+    f2a, f2sub = ops["fq2_add"], ops["fq2_sub"]
+    f2neg, f2xi = ops["fq2_neg"], ops["fq2_mul_by_xi"]
+    f2fp = ops["fq2_scale_fp"]
+    f12m, f12sq = ops["fq12_mul"], ops["fq12_sq"]
+    f12conj, f12inv = ops["fq12_conj"], ops["fq12_inv"]
+    f12frob = ops["fq12_frobenius"]
+
+    bits = jnp.asarray(_X_BITS)
+
+    def _slots(f):
+        """Fq12 (..., 2, 3, 2, 32) -> list of 6 Fq2 slots in w-power order."""
+        return [f[..., i, j, :, :] for (i, j) in _W_SLOTS]
+
+    def _from_slots(s):
+        c0 = jnp.stack([s[0], s[2], s[4]], axis=-3)
+        c1 = jnp.stack([s[1], s[3], s[5]], axis=-3)
+        return jnp.stack([c0, c1], axis=-4)
+
+    def mul_sparse035(f, l0, l3, l5):
+        """f *= l0 + l3 w^3 + l5 w^5 — 18 fq2 muls, mirrors the native
+        fq12_mul_sparse slot convolution with w^6 = xi wrap."""
+        fs = _slots(f)
+        out = [None] * 6
+        for i in range(6):
+            for pw, c in ((0, l0), (3, l3), (5, l5)):
+                k = i + pw
+                prod = f2m(fs[i], c)
+                if k >= 6:
+                    k -= 6
+                    prod = f2xi(prod)
+                out[k] = prod if out[k] is None else f2a(out[k], prod)
+        return _from_slots(out)
+
+    def dbl_step(f, X, Y, Z, px, py):
+        """Projective doubling + line (EFD dbl-2007-bl, a = 0; line terms
+        share w3/s/Rr with the point update)."""
+        XX = f2s(X)
+        w3 = f2a(f2a(XX, XX), XX)
+        t = f2m(Y, Z)
+        s = f2a(t, t)
+        ss = f2s(s)
+        sss = f2m(s, ss)
+        Rr = f2m(Y, s)
+        RR = f2s(Rr)
+        B = f2m(X, Rr)
+        B = f2a(B, B)
+        h = f2sub(f2s(w3), f2a(B, B))
+        Xn = f2m(h, s)
+        Yn = f2sub(f2m(w3, f2sub(B, h)), f2a(RR, RR))
+        Zn = sss
+        # line at the pre-update point, scaled by (2y) * Z^3
+        l0 = f2fp(f2xi(f2m(s, Z)), py)
+        l3 = f2sub(f2m(X, w3), Rr)
+        l5 = f2neg(f2fp(f2m(w3, Z), px))
+        return mul_sparse035(f, l0, l3, l5), Xn, Yn, Zn
+
+    def add_step(f, X, Y, Z, qx, qy, px, py):
+        """Mixed addition of the affine base Q + line (EFD madd-1998-cmo),
+        line scaled by (qx - x_r) * Z."""
+        u = f2sub(f2m(qy, Z), Y)
+        v = f2sub(f2m(qx, Z), X)
+        uu = f2s(u)
+        vv = f2s(v)
+        vvv = f2m(v, vv)
+        Rm = f2m(vv, X)
+        A = f2sub(f2sub(f2m(uu, Z), vvv), f2a(Rm, Rm))
+        Xn = f2m(v, A)
+        Yn = f2sub(f2m(u, f2sub(Rm, A)), f2m(vvv, Y))
+        Zn = f2m(vvv, Z)
+        l0 = f2fp(f2xi(f2m(v, Z)), py)
+        l3 = f2sub(f2m(u, X), f2m(v, Y))
+        l5 = f2neg(f2fp(f2m(u, Z), px))
+        return mul_sparse035(f, l0, l3, l5), Xn, Yn, Zn
+
+    def miller(px, py, qx, qy):
+        """Batched Miller loop.  px/py: (..., 32) Fp; qx/qy: (..., 2, 32)
+        Fq2 twist coordinates.  Returns f: (..., 2, 3, 2, 32)."""
+        f = ops["fq12_one"](px.shape[:-1])
+        X, Y = qx, qy
+        Z = jnp.broadcast_to(
+            jnp.stack([jnp.asarray(BI.to_limbs(1)), jnp.zeros(BI.NLIMBS, jnp.int32)]),
+            qx.shape,
+        )
+
+        def body(carry, bit):
+            f, X, Y, Z = carry
+            f = f12sq(f)
+            f, X, Y, Z = dbl_step(f, X, Y, Z, px, py)
+
+            def with_add(op):
+                return add_step(op[0], op[1], op[2], op[3], qx, qy, px, py)
+
+            f, X, Y, Z = lax.cond(
+                bit != 0, with_add, lambda op: op, (f, X, Y, Z)
+            )
+            return (f, X, Y, Z), None
+
+        (f, _, _, _), _ = lax.scan(body, (f, X, Y, Z), bits)
+        return f12conj(f)  # negative BLS parameter
+
+    def pow_x_abs(a):
+        """a^|x| by square-and-multiply over the static parameter bits.
+        (Callers conjugate for the negative sign — on the cyclotomic
+        subgroup, where every use of this lives.)"""
+
+        def body(acc, bit):
+            acc = f12sq(acc)
+            acc = lax.cond(bit != 0, lambda t: f12m(t, a), lambda t: t, acc)
+            return acc, None
+
+        acc, _ = lax.scan(body, a, bits)
+        return acc
+
+    def easy_part(f):
+        """f^((p^6-1)(p^2+1))."""
+        f = f12m(f12conj(f), f12inv(f))
+        return f12m(f12frob(f12frob(f)), f)
+
+    def masked_product(f, mask):
+        """(..., K, fq12) + (..., K) live mask -> (..., fq12): padded
+        lanes become the identity, then a log-depth product over K."""
+        one = ops["fq12_one"](f.shape[:-4])
+        m = mask[..., None, None, None, None]
+        f = jnp.where(m, f, one)
+        k = f.shape[-5]
+        while k > 1:
+            if k % 2:
+                f = jnp.concatenate(
+                    [f, ops["fq12_one"]((*f.shape[:-5], 1))], axis=-5
+                )
+                k += 1
+            f = f12m(f[..., 0::2, :, :, :, :], f[..., 1::2, :, :, :, :])
+            k //= 2
+        return f[..., 0, :, :, :, :]
+
+    # The final exponentiation is composed on the host from these small
+    # jitted pieces rather than jitted whole: the fully-unrolled chain is
+    # a single XLA program big enough to exhaust compiler memory on the
+    # CPU backend, while each piece here is at most one scan body deep.
+    jits = {
+        "miller": jax.jit(miller),
+        "pow_x_abs": jax.jit(pow_x_abs),
+        "easy_part": jax.jit(easy_part),
+        "masked_product": jax.jit(masked_product),
+        "mul": jax.jit(f12m),
+        "sq": jax.jit(f12sq),
+        "conj": jax.jit(f12conj),
+        "frob": jax.jit(f12frob),
+        "is_one": jax.jit(ops["fq12_is_one"]),
+    }
+
+    def pow_x(a):
+        return jits["conj"](jits["pow_x_abs"](a))
+
+    def final_exp(f):
+        """Host-composed mirror of the host-side addition chain
+        (crypto/bls/pairing.py:124-138): easy part, then the cubed hard
+        part — every step a cached device dispatch."""
+        mul, conj, frob, sq = (
+            jits["mul"],
+            jits["conj"],
+            jits["frob"],
+            jits["sq"],
+        )
+        m = jits["easy_part"](f)
+        a = mul(pow_x(m), conj(m))
+        b = mul(pow_x(a), conj(a))
+        c = mul(pow_x(b), frob(b))
+        d = mul(mul(pow_x(pow_x(c)), frob(frob(c))), conj(c))
+        return mul(d, mul(sq(m), m))
+
+    def check_tail(f, mask):
+        """(G, K, fq12) Miller outputs + (G, K) live mask -> (G,) bools."""
+        return jits["is_one"](final_exp(jits["masked_product"](f, mask)))
+
+    jits["final_exp"] = final_exp
+    jits["check_tail"] = check_tail
+    return jits
+
+
+_OPS = None
+
+
+def _get_ops():
+    global _OPS
+    if _OPS is None:
+        _OPS = make_pairing_ops()
+    return _OPS
+
+
+def _pack_pairs(pairs):
+    """[(G1 affine, G2 affine)] -> (px, py, qx, qy) limb batches."""
+    from .bls_g2 import fq2_limbs_batch
+
+    px = _limbs_batch([p[0] for p, _ in pairs])
+    py = _limbs_batch([p[1] for p, _ in pairs])
+    qx = fq2_limbs_batch([q[0] for _, q in pairs])
+    qy = fq2_limbs_batch([q[1] for _, q in pairs])
+    return px, py, qx, qy
+
+
+def _pow2_pad(n: int) -> int:
+    k = 1
+    while k < n:
+        k *= 2
+    return k
+
+
+# A fixed valid pad pair (the generators); padded lanes are masked to the
+# identity after the Miller loop, so their value never matters — they only
+# keep shapes in a small set of power-of-two sizes.
+def _pad_pairs(pairs, target):
+    from ..crypto.bls.curve import G1_GENERATOR, G2_GENERATOR
+
+    return list(pairs) + [(G1_GENERATOR, G2_GENERATOR)] * (target - len(pairs))
+
+
+def miller_loop_batch(pairs):
+    """Batched Miller loops on device -> list of host Fq12 tuples.
+
+    ``pairs``: affine, non-infinity, subgroup-checked (P in G1, Q in G2).
+    """
+    if not pairs:
+        return []
+    n = len(pairs)
+    padded = _pad_pairs(pairs, _pow2_pad(n))
+    f = _get_ops()["miller"](*_pack_pairs(padded))
+    f = np.asarray(f)
+    return [FQ.fq12_from_limbs(f[i]) for i in range(n)]
+
+
+def pairing_product_is_one(pairs) -> bool:
+    """Single check: prod e(P_i, Q_i) == 1, fully on device."""
+    return pairing_products_are_one([pairs])[0]
+
+
+def pairing_products_are_one(checks) -> list[bool]:
+    """Batched pairing-product checks (one bool per inner pair list)."""
+    if not checks:
+        return []
+    kmax = _pow2_pad(max(len(c) for c in checks))
+    g = _pow2_pad(len(checks))
+    flat = []
+    mask = np.zeros((g, kmax), bool)
+    for i in range(g):
+        chk = checks[i] if i < len(checks) else []
+        mask[i, : len(chk)] = True
+        flat.extend(_pad_pairs(chk, kmax))
+    ops = _get_ops()
+    f = ops["miller"](*_pack_pairs(flat))  # (g*kmax, fq12)
+    f = f.reshape(g, kmax, *f.shape[1:])
+
+    import jax.numpy as jnp
+
+    ok = ops["check_tail"](f, jnp.asarray(mask))
+    return [bool(v) for v in np.asarray(ok)[: len(checks)]]

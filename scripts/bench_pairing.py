@@ -1,0 +1,82 @@
+"""Device pairing benchmark: batched Miller loops + product checks.
+
+Measures the ops/bls_pairing path (BASELINE.md scenario 3 shape: one
+RLC pairing-product check over many pairs) against the native C++
+lockstep Miller loop — the host baseline standing in for the reference's
+blst-backed bls_nif (ref: native/bls_nif/src/lib.rs).
+
+Usage: python scripts/bench_pairing.py [batch ...]
+Prints one JSON line per batch size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+
+from lambda_ethereum_consensus_tpu.crypto.bls import curve as C, native
+from lambda_ethereum_consensus_tpu.ops import bls_pairing as DP
+
+
+def make_check(n: int):
+    """A valid n+1-pair product: sum_i e(a_i P, Q) * e(-(sum a_i) P, Q) = 1."""
+    coeffs = [secrets.randbits(96) for _ in range(n)]
+    pairs = [
+        (C.g1.multiply_raw(C.G1_GENERATOR, a), C.G2_GENERATOR) for a in coeffs
+    ]
+    total = sum(coeffs)
+    pairs.append(
+        (C.g1.affine_neg(C.g1.multiply_raw(C.G1_GENERATOR, total)), C.G2_GENERATOR)
+    )
+    return pairs
+
+
+def main() -> None:
+    batches = [int(a) for a in sys.argv[1:]] or [32, 128, 512]
+    for n in batches:
+        pairs = make_check(n - 1)  # n pairs total
+        ok = DP.pairing_product_is_one(pairs)  # compile
+        assert ok
+        t0 = time.perf_counter()
+        iters = 3
+        for _ in range(iters):
+            assert DP.pairing_product_is_one(pairs)
+        dt = (time.perf_counter() - t0) / iters
+        dev_rate = n / dt
+
+        nat_rate = None
+        if native.available():
+            t0 = time.perf_counter()
+            assert native.pairing_check(pairs)
+            nat_dt = time.perf_counter() - t0
+            nat_rate = n / nat_dt
+        print(
+            json.dumps(
+                {
+                    "metric": "pairing_product_check",
+                    "batch": n,
+                    "device_pairs_per_s": round(dev_rate, 1),
+                    "device_ms": round(dt * 1e3, 1),
+                    "native_pairs_per_s": round(nat_rate, 1) if nat_rate else None,
+                    "backend": jax.default_backend(),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

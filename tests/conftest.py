@@ -24,6 +24,19 @@ if "jax" in sys.modules:
 
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: the device crypto kernels (pairing, ladder)
+# take minutes to compile; cache them across test runs.  Env-var config so
+# tests that never touch jax don't pay its import here; the config.update
+# below covers the sitecustomize-preimported case.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "10")
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+
 import pytest  # noqa: E402
 
 from lambda_ethereum_consensus_tpu.config import (  # noqa: E402

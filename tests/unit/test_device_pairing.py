@@ -1,0 +1,88 @@
+"""Device pairing vs the host oracle (CPU backend).
+
+The raw device Miller output differs from the host's by Fq2 subfield
+factors (projective line scaling), so Miller comparisons go through a
+final exponentiation — exactly the invariance the scaling relies on.
+"""
+
+import random
+
+from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
+from lambda_ethereum_consensus_tpu.crypto.bls import fields as F
+from lambda_ethereum_consensus_tpu.ops import bls_fq12 as FQ
+from lambda_ethereum_consensus_tpu.ops import bls_pairing as DP
+
+RNG = random.Random(71)
+
+
+def _rand_fq12():
+    return tuple(
+        tuple((RNG.randrange(F.P), RNG.randrange(F.P)) for _ in range(3))
+        for _ in range(2)
+    )
+
+
+def test_fq12_tower_matches_host():
+    import jax.numpy as jnp
+    import numpy as np
+
+    ops = FQ.get_fq12_ops()
+    a, b = _rand_fq12(), _rand_fq12()
+    da = jnp.asarray(FQ.fq12_to_limbs(a))
+    db = jnp.asarray(FQ.fq12_to_limbs(b))
+
+    def back(x):
+        return FQ.fq12_from_limbs(np.asarray(x))
+
+    assert back(ops["fq12_mul"](da, db)) == F.fq12_mul(a, b)
+    assert back(ops["fq12_sq"](da)) == F.fq12_sq(a)
+    assert back(ops["fq12_inv"](da)) == F.fq12_inv(a)
+    assert back(ops["fq12_frobenius"](da)) == F.fq12_frobenius(a)
+    # batched shapes broadcast through the tower
+    batch = jnp.stack([da, db])
+    got = np.asarray(ops["fq12_mul"](batch, batch))
+    assert FQ.fq12_from_limbs(got[0]) == F.fq12_mul(a, a)
+    assert FQ.fq12_from_limbs(got[1]) == F.fq12_mul(b, b)
+
+
+def test_miller_matches_host_after_final_exp():
+    from lambda_ethereum_consensus_tpu.crypto.bls.pairing import (
+        final_exponentiation,
+        miller_loop,
+    )
+
+    k = RNG.getrandbits(64)
+    pairs = [
+        (C.G1_GENERATOR, C.G2_GENERATOR),
+        (
+            C.g1.multiply_raw(C.G1_GENERATOR, k),
+            C.g2.multiply_raw(C.G2_GENERATOR, k + 7),
+        ),
+    ]
+    dev = DP.miller_loop_batch(pairs)
+    for got, (p, q) in zip(dev, pairs):
+        assert final_exponentiation(got) == final_exponentiation(
+            miller_loop(p, q)
+        )
+
+
+def test_device_product_check_bilinearity():
+    a = RNG.getrandbits(128)
+    aP = C.g1.multiply_raw(C.G1_GENERATOR, a)
+    aQ = C.g2.multiply_raw(C.G2_GENERATOR, a)
+    negP = C.g1.affine_neg(C.G1_GENERATOR)
+    assert DP.pairing_product_is_one([(aP, C.G2_GENERATOR), (negP, aQ)])
+    # corrupt one side: the product is no longer the identity
+    bad = C.g1.multiply_raw(C.G1_GENERATOR, a + 1)
+    assert not DP.pairing_product_is_one([(bad, C.G2_GENERATOR), (negP, aQ)])
+
+
+def test_device_multi_check_batch():
+    ks = [RNG.getrandbits(96) for _ in range(3)]
+    negP = C.g1.affine_neg(C.G1_GENERATOR)
+    checks = []
+    for i, k in enumerate(ks):
+        aP = C.g1.multiply_raw(C.G1_GENERATOR, k + i % 2)  # odd i corrupted
+        aQ = C.g2.multiply_raw(C.G2_GENERATOR, k)
+        checks.append([(aP, C.G2_GENERATOR), (negP, aQ)])
+    assert DP.pairing_products_are_one(checks) == [True, False, True]

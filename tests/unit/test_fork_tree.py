@@ -1,0 +1,89 @@
+"""ForkTree cached-head semantics (ref: test/unit/tree_test.exs)."""
+
+from lambda_ethereum_consensus_tpu.fork_choice.tree import ForkTree
+
+A, B, C, D, E = (bytes([i]) * 32 for i in range(1, 6))
+
+
+def test_head_extends_longest_chain_without_votes():
+    t = ForkTree(A)
+    t.add_block(B, A)
+    t.add_block(C, B)
+    assert t.head() == C
+
+
+def test_weight_moves_head_between_forks():
+    t = ForkTree(A)
+    t.add_block(B, A)  # fork 1
+    t.add_block(C, A)  # fork 2
+    t.add_weight(B, 10)
+    assert t.head() == B
+    t.add_weight(C, 25)
+    assert t.head() == C
+    # deeper chain under the heavy fork wins over the fork point itself
+    t.add_block(D, C)
+    assert t.head() == D
+
+
+def test_deep_weight_reaches_fork_choice():
+    # weight landing below the fork point must count for the whole branch
+    t = ForkTree(A)
+    t.add_block(B, A)
+    t.add_block(C, A)
+    t.add_block(D, C)
+    t.add_weight(D, 10)
+    assert t.weight(C) == 10  # cumulative subtree weight
+    assert t.head() == D
+
+
+def test_new_sibling_wins_tie_break_immediately():
+    t = ForkTree(A)
+    t.add_block(B, A)
+    assert t.head() == B
+    t.add_block(C, A)  # zero weight, but lexicographically larger
+    assert t.head() == C
+
+
+def test_tie_breaks_on_larger_root():
+    t = ForkTree(A)
+    t.add_block(B, A)
+    t.add_block(C, A)
+    t.add_weight(B, 5)
+    t.add_weight(C, 5)
+    assert t.head() == C  # equal weight: lexicographically larger root
+
+
+def test_negative_delta_rescans_best_child():
+    t = ForkTree(A)
+    t.add_block(B, A)
+    t.add_block(C, A)
+    t.add_weight(B, 10)
+    t.add_weight(C, 6)
+    assert t.head() == B
+    t.add_weight(B, -8)  # vote moved away
+    assert t.head() == C
+
+
+def test_prune_reroots():
+    t = ForkTree(A)
+    t.add_block(B, A)
+    t.add_block(C, A)
+    t.add_block(D, B)
+    t.add_weight(D, 3)
+    t.prune(B)
+    assert t.root == B
+    assert t.head() == D
+    assert C not in t
+    assert t.weight(D) == 3
+
+
+def test_duplicate_and_unknown_parent():
+    t = ForkTree(A)
+    t.add_block(B, A)
+    t.add_block(B, A)  # idempotent
+    assert t.head() == B
+    try:
+        t.add_block(D, E)
+        raise AssertionError("unknown parent must raise")
+    except KeyError:
+        pass
