@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 from typing import Optional, Tuple
 
+from ...utils.env import env_flag
 from . import fields as F
 from .curve import AffinePoint, g1, g2
 from .fields import BLS_X, BLS_X_IS_NEG, P, R
@@ -147,13 +148,6 @@ def final_exponentiation_naive(f: F.Fq12) -> F.Fq12:
 def pairing(p: AffinePoint, q: AffinePoint) -> F.Fq12:
     """e(P, Q) for P in G1, Q in G2 (up to the fixed cube; see module doc)."""
     return final_exponentiation(miller_loop(p, q))
-
-
-def env_flag(name: str) -> bool:
-    """Shared truthiness parse for the device-routing env flags."""
-    return os.environ.get(name, "").strip().lower() not in (
-        "", "0", "false", "no", "off",
-    )
 
 
 def _device_pairing_enabled(n: int) -> bool:

@@ -2,16 +2,18 @@
 
 The extension-field layer of the device pairing (SURVEY.md §7 hard-part #1).
 Mirrors the host tower in ``crypto/bls/fields.py`` — same xi = 1 + u,
-v^3 = xi, w^2 = v construction, same Karatsuba interpolation — but every op
-is batched over arbitrary leading axes on top of the scan-free Barrett base
-field in :mod:`.bigint`.
+v^3 = xi, w^2 = v construction, same Karatsuba interpolation — with the
+formulas written once and instantiated over two layouts:
 
-Layouts (little-endian 12-bit limbs, int32):
-
-- Fq:   ``(..., 32)``
-- Fq2:  ``(..., 2, 32)``            — (c0, c1), u^2 = -1
-- Fq6:  ``(..., 3, 2, 32)``         — (c0, c1, c2) over v
-- Fq12: ``(..., 2, 3, 2, 32)``      — (c0, c1) over w
+- **batch layout** (``get_fq12_ops``): Fq ``(..., 32)``, Fq2
+  ``(..., 2, 32)``, Fq6 ``(..., 3, 2, 32)``, Fq12 ``(..., 2, 3, 2, 32)``
+  — batch axes leading, einsum/Barrett base ops (:mod:`.bigint`); used
+  under ``vmap`` and on the CPU backend.
+- **plane layout** (``get_fq12_plane_ops``): limb planes outermost and
+  batch last — Fq ``(32, B)``, Fq2 ``(32, 2, B)``, Fq6 ``(32, 3, 2, B)``,
+  Fq12 ``(32, 2, 3, 2, B)`` — fused Pallas kernels
+  (:mod:`.bigint_pallas`); tower components always slice on axis 1 and
+  per-element masks broadcast against trailing batch axes for free.
 
 Inversion bottoms out in a batched Fermat powmod (a^(p-2)), a
 ``lax.scan`` over the static exponent bits — O(log p) batched muls, no
@@ -26,7 +28,13 @@ import numpy as np
 from ..crypto.bls import fields as F
 from . import bigint as BI
 
-__all__ = ["make_fq12_ops", "get_fq12_ops", "fq12_to_limbs", "fq12_from_limbs"]
+__all__ = [
+    "make_fq12_ops",
+    "get_fq12_ops",
+    "get_fq12_plane_ops",
+    "fq12_to_limbs",
+    "fq12_from_limbs",
+]
 
 
 def fq2_to_limbs(a) -> np.ndarray:
@@ -38,7 +46,7 @@ def fq2_from_limbs(arr) -> tuple:
 
 
 def fq12_to_limbs(f) -> np.ndarray:
-    """Host Fq12 tuple -> (2, 3, 2, 32) limb array."""
+    """Host Fq12 tuple -> (2, 3, 2, 32) limb array (batch layout)."""
     return np.stack(
         [np.stack([fq2_to_limbs(c) for c in half]) for half in f]
     )
@@ -55,28 +63,151 @@ def _bits_lsb(e: int) -> np.ndarray:
     return np.array([(e >> i) & 1 for i in range(e.bit_length())], np.int32)
 
 
-def make_fq12_ops():
-    """Build the device tower ops dict (jax imported lazily, repo pattern)."""
+class _BatchLayout:
+    """Batch axes leading; tower components on trailing axes."""
+
+    # trailing offset of the component axis per tower level
+    _OFF = {2: 2, 6: 3, 12: 4}
+
+    def part(self, level, a, i):
+        idx = (Ellipsis, i) + (slice(None),) * (self._OFF[level] - 1)
+        return a[idx]
+
+    def stack(self, level, parts):
+        import jax.numpy as jnp
+
+        return jnp.stack(parts, axis=-self._OFF[level])
+
+    def fq_const(self, value, like):
+        import jax.numpy as jnp
+
+        return jnp.broadcast_to(jnp.asarray(BI.to_limbs(value)), like.shape)
+
+    def np_fq2(self, c):  # host Fq2 tuple -> broadcastable device constant
+        import jax.numpy as jnp
+
+        return jnp.asarray(fq2_to_limbs(c))
+
+    def one_fq12(self):
+        one2 = np.stack([BI.to_limbs(1), np.zeros(BI.NLIMBS, np.int32)])
+        one6 = np.stack([one2, np.zeros_like(one2), np.zeros_like(one2)])
+        return np.stack([one6, np.zeros_like(one6)])
+
+    def broadcast_fq12(self, const, batch_shape):
+        import jax.numpy as jnp
+
+        return jnp.broadcast_to(
+            jnp.asarray(const), (*batch_shape, *const.shape)
+        )
+
+    def batch_shape(self, f):
+        return f.shape[:-4]
+
+    def fq_batch_shape(self, a):
+        return a.shape[:-1]
+
+    def expand_mask(self, m):
+        return m[..., None, None, None, None]
+
+    def kslice(self, f, sl):
+        """Slice the innermost batch axis of an Fq12 batch."""
+        return f[..., sl, :, :, :, :]
+
+    def kconcat(self, parts):
+        import jax.numpy as jnp
+
+        return jnp.concatenate(parts, axis=-5)
+
+    def ksize(self, f):
+        return f.shape[-5]
+
+    elem_axes = (-1, -2, -3, -4)
+
+
+class _PlaneLayout:
+    """Limb planes outermost, batch last; components always on axis 1."""
+
+    def part(self, level, a, i):
+        return a[:, i]
+
+    def stack(self, level, parts):
+        import jax.numpy as jnp
+
+        return jnp.stack(parts, axis=1)
+
+    def fq_const(self, value, like):
+        import jax.numpy as jnp
+
+        v = BI.to_limbs(value).reshape((BI.NLIMBS,) + (1,) * (like.ndim - 1))
+        return jnp.broadcast_to(jnp.asarray(v), like.shape)
+
+    def np_fq2(self, c):
+        import jax.numpy as jnp
+
+        # (32, 2, 1): trailing singleton broadcasts over the batch
+        return jnp.asarray(fq2_to_limbs(c).T[:, :, None])
+
+    def one_fq12(self):
+        one = np.zeros((BI.NLIMBS, 2, 3, 2), np.int32)
+        one[:, 0, 0, 0] = BI.to_limbs(1)
+        return one
+
+    def broadcast_fq12(self, const, batch_shape):
+        import jax.numpy as jnp
+
+        c = const.reshape(const.shape + (1,) * len(batch_shape))
+        return jnp.broadcast_to(
+            jnp.asarray(c), const.shape + tuple(batch_shape)
+        )
+
+    def batch_shape(self, f):
+        return f.shape[4:]
+
+    def fq_batch_shape(self, a):
+        return a.shape[1:]
+
+    def expand_mask(self, m):
+        return m  # trailing batch axes: masks broadcast as-is
+
+    def kslice(self, f, sl):
+        return f[..., sl]
+
+    def kconcat(self, parts):
+        import jax.numpy as jnp
+
+        return jnp.concatenate(parts, axis=-1)
+
+    def ksize(self, f):
+        return f.shape[-1]
+
+    elem_axes = (0, 1, 2, 3)
+
+
+def make_fq12_ops(base=None, lay=None):
+    """Build the device tower ops dict over a base-field ops dict and a
+    layout adapter (defaults: einsum base ops, batch layout)."""
     import jax.numpy as jnp
     from jax import lax
 
-    base = BI.get_ops()
+    lay = lay or _BatchLayout()
+    base = base or BI.get_ops()
     mul = base["mul_mod"]
     add = base["add_mod"]
     sub = base["sub_mod"]
-
-    zero_fq = np.zeros(BI.NLIMBS, np.int32)
 
     def neg(a):
         return sub(jnp.zeros_like(a), a)
 
     # ------------------------------------------------------------- Fq2
     def fq2(c0, c1):
-        return jnp.stack([c0, c1], axis=-2)
+        return lay.stack(2, [c0, c1])
+
+    def _p2(a):
+        return lay.part(2, a, 0), lay.part(2, a, 1)
 
     def fq2_mul(a, b):
-        a0, a1 = a[..., 0, :], a[..., 1, :]
-        b0, b1 = b[..., 0, :], b[..., 1, :]
+        a0, a1 = _p2(a)
+        b0, b1 = _p2(b)
         t0 = mul(a0, b0)
         t1 = mul(a1, b1)
         c0 = sub(t0, t1)
@@ -85,42 +216,44 @@ def make_fq12_ops():
 
     def fq2_sq(a):
         # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u  — 2 muls
-        a0, a1 = a[..., 0, :], a[..., 1, :]
+        a0, a1 = _p2(a)
         t = mul(add(a0, a1), sub(a0, a1))
         m = mul(a0, a1)
         return fq2(t, add(m, m))
 
     def fq2_add(a, b):
-        return fq2(
-            add(a[..., 0, :], b[..., 0, :]), add(a[..., 1, :], b[..., 1, :])
-        )
+        a0, a1 = _p2(a)
+        b0, b1 = _p2(b)
+        return fq2(add(a0, b0), add(a1, b1))
 
     def fq2_sub(a, b):
-        return fq2(
-            sub(a[..., 0, :], b[..., 0, :]), sub(a[..., 1, :], b[..., 1, :])
-        )
+        a0, a1 = _p2(a)
+        b0, b1 = _p2(b)
+        return fq2(sub(a0, b0), sub(a1, b1))
 
     def fq2_neg(a):
         return fq2_sub(jnp.zeros_like(a), a)
 
     def fq2_conj(a):
-        return fq2(a[..., 0, :], neg(a[..., 1, :]))
+        a0, a1 = _p2(a)
+        return fq2(a0, neg(a1))
 
     def fq2_mul_by_xi(a):
         # xi = 1 + u: (a0 - a1, a0 + a1)
-        a0, a1 = a[..., 0, :], a[..., 1, :]
+        a0, a1 = _p2(a)
         return fq2(sub(a0, a1), add(a0, a1))
 
     def fq2_scale_fp(a, s):
-        """Fq2 element times base-field scalar s (..., 32)."""
-        return fq2(mul(a[..., 0, :], s), mul(a[..., 1, :], s))
+        """Fq2 element times base-field scalar s."""
+        a0, a1 = _p2(a)
+        return fq2(mul(a0, s), mul(a1, s))
 
     # Batched Fermat inversion: a^(p-2) by square-and-multiply over the
     # static exponent bits (LSB-first scan).
     _pm2_bits = jnp.asarray(_bits_lsb(F.P - 2))
 
     def fp_inv(a):
-        one = jnp.broadcast_to(jnp.asarray(BI.to_limbs(1)), a.shape)
+        one = lay.fq_const(1, a)
 
         def body(carry, bit):
             result, pw = carry
@@ -132,31 +265,31 @@ def make_fq12_ops():
         return result
 
     def fq2_inv(a):
-        a0, a1 = a[..., 0, :], a[..., 1, :]
+        a0, a1 = _p2(a)
         norm = add(mul(a0, a0), mul(a1, a1))
         ninv = fp_inv(norm)
         return fq2(mul(a0, ninv), neg(mul(a1, ninv)))
 
     # ------------------------------------------------------------- Fq6
     def fq6(c0, c1, c2):
-        return jnp.stack([c0, c1, c2], axis=-3)
+        return lay.stack(6, [c0, c1, c2])
 
-    def _fq6_parts(a):
-        return a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    def _p6(a):
+        return lay.part(6, a, 0), lay.part(6, a, 1), lay.part(6, a, 2)
 
     def fq6_add(a, b):
-        return fq6(*[fq2_add(x, y) for x, y in zip(_fq6_parts(a), _fq6_parts(b))])
+        return fq6(*[fq2_add(x, y) for x, y in zip(_p6(a), _p6(b))])
 
     def fq6_sub(a, b):
-        return fq6(*[fq2_sub(x, y) for x, y in zip(_fq6_parts(a), _fq6_parts(b))])
+        return fq6(*[fq2_sub(x, y) for x, y in zip(_p6(a), _p6(b))])
 
     def fq6_neg(a):
         return fq6_sub(jnp.zeros_like(a), a)
 
     def fq6_mul(a, b):
         # Devegili interpolation, mirrors fields.fq6_mul (6 fq2 muls)
-        a0, a1, a2 = _fq6_parts(a)
-        b0, b1, b2 = _fq6_parts(b)
+        a0, a1, a2 = _p6(a)
+        b0, b1, b2 = _p6(b)
         t0 = fq2_mul(a0, b0)
         t1 = fq2_mul(a1, b1)
         t2 = fq2_mul(a2, b2)
@@ -179,14 +312,14 @@ def make_fq12_ops():
         return fq6(c0, c1, c2)
 
     def fq6_mul_by_v(a):
-        a0, a1, a2 = _fq6_parts(a)
+        a0, a1, a2 = _p6(a)
         return fq6(fq2_mul_by_xi(a2), a0, a1)
 
     def fq6_sq(a):
         return fq6_mul(a, a)
 
     def fq6_inv(a):
-        a0, a1, a2 = _fq6_parts(a)
+        a0, a1, a2 = _p6(a)
         c0 = fq2_sub(fq2_sq(a0), fq2_mul_by_xi(fq2_mul(a1, a2)))
         c1 = fq2_sub(fq2_mul_by_xi(fq2_sq(a2)), fq2_mul(a0, a1))
         c2 = fq2_sub(fq2_sq(a1), fq2_mul(a0, a2))
@@ -199,14 +332,14 @@ def make_fq12_ops():
 
     # ------------------------------------------------------------- Fq12
     def fq12(c0, c1):
-        return jnp.stack([c0, c1], axis=-4)
+        return lay.stack(12, [c0, c1])
 
-    def _fq12_parts(a):
-        return a[..., 0, :, :, :], a[..., 1, :, :, :]
+    def _p12(a):
+        return lay.part(12, a, 0), lay.part(12, a, 1)
 
     def fq12_mul(a, b):
-        a0, a1 = _fq12_parts(a)
-        b0, b1 = _fq12_parts(b)
+        a0, a1 = _p12(a)
+        b0, b1 = _p12(b)
         t0 = fq6_mul(a0, b0)
         t1 = fq6_mul(a1, b1)
         c0 = fq6_add(t0, fq6_mul_by_v(t1))
@@ -216,7 +349,7 @@ def make_fq12_ops():
         return fq12(c0, c1)
 
     def fq12_sq(a):
-        a0, a1 = _fq12_parts(a)
+        a0, a1 = _p12(a)
         t = fq6_mul(a0, a1)
         c0 = fq6_sub(
             fq6_mul(fq6_add(a0, a1), fq6_add(a0, fq6_mul_by_v(a1))),
@@ -225,23 +358,23 @@ def make_fq12_ops():
         return fq12(c0, fq6_add(t, t))
 
     def fq12_conj(a):
-        a0, a1 = _fq12_parts(a)
+        a0, a1 = _p12(a)
         return fq12(a0, fq6_neg(a1))
 
     def fq12_inv(a):
-        a0, a1 = _fq12_parts(a)
+        a0, a1 = _p12(a)
         t = fq6_sub(fq6_sq(a0), fq6_mul_by_v(fq6_sq(a1)))
         tinv = fq6_inv(t)
         return fq12(fq6_mul(a0, tinv), fq6_neg(fq6_mul(a1, tinv)))
 
     # --------------------------------------------------- Frobenius maps
     # Gamma constants lifted numerically from the host field module.
-    g6_1 = jnp.asarray(fq2_to_limbs(F._GAMMA6_1))
-    g6_2 = jnp.asarray(fq2_to_limbs(F._GAMMA6_2))
-    g12 = jnp.asarray(fq2_to_limbs(F._GAMMA12))
+    g6_1 = lay.np_fq2(F._GAMMA6_1)
+    g6_2 = lay.np_fq2(F._GAMMA6_2)
+    g12 = lay.np_fq2(F._GAMMA12)
 
     def fq6_frobenius(a):
-        a0, a1, a2 = _fq6_parts(a)
+        a0, a1, a2 = _p6(a)
         return fq6(
             fq2_conj(a0),
             fq2_mul(fq2_conj(a1), g6_1),
@@ -249,26 +382,21 @@ def make_fq12_ops():
         )
 
     def fq12_frobenius(a):
-        a0, a1 = _fq12_parts(a)
+        a0, a1 = _p12(a)
         f0 = fq6_frobenius(a0)
         f1 = fq6_frobenius(a1)
-        f1 = fq6(*[fq2_mul(c, g12) for c in _fq6_parts(f1)])
+        f1 = fq6(*[fq2_mul(c, g12) for c in _p6(f1)])
         return fq12(f0, f1)
 
-    # Constant builders ---------------------------------------------------
-    one_fq2 = np.stack([BI.to_limbs(1), zero_fq])
-    one_fq6 = np.stack([one_fq2, np.zeros_like(one_fq2), np.zeros_like(one_fq2)])
-    one_fq12 = np.stack([one_fq6, np.zeros_like(one_fq6)])
+    one_fq12 = lay.one_fq12()
 
     def fq12_one(batch_shape=()):
-        return jnp.broadcast_to(
-            jnp.asarray(one_fq12), (*batch_shape, *one_fq12.shape)
-        )
+        return lay.broadcast_fq12(one_fq12, batch_shape)
 
     def fq12_is_one(a):
-        """Boolean mask over leading axes."""
-        target = fq12_one(a.shape[:-4])
-        return jnp.all(a == target, axis=(-1, -2, -3, -4))
+        """Boolean mask over the batch axes."""
+        target = fq12_one(lay.batch_shape(a))
+        return jnp.all(a == target, axis=lay.elem_axes)
 
     return {
         "fq2_mul": fq2_mul,
@@ -298,10 +426,12 @@ def make_fq12_ops():
         "add": add,
         "sub": sub,
         "neg": neg,
+        "layout": lay,
     }
 
 
 _FQ12_OPS = None
+_FQ12_PLANE_OPS: dict = {}
 
 
 def get_fq12_ops():
@@ -309,3 +439,14 @@ def get_fq12_ops():
     if _FQ12_OPS is None:
         _FQ12_OPS = make_fq12_ops()
     return _FQ12_OPS
+
+
+def get_fq12_plane_ops(interpret: bool = False):
+    """Plane-layout tower over the fused Pallas base kernels."""
+    if interpret not in _FQ12_PLANE_OPS:
+        from .bigint_pallas import make_plane_ops
+
+        _FQ12_PLANE_OPS[interpret] = make_fq12_ops(
+            base=make_plane_ops(interpret=interpret), lay=_PlaneLayout()
+        )
+    return _FQ12_PLANE_OPS[interpret]

@@ -63,46 +63,138 @@ def _get_g2_ops(nbits: int):
     return _G2_OPS[nbits]
 
 
-def batch_g2_mul(points: list, scalars: list, bits: int = SCALAR_BITS) -> list:
+def make_g2_plane_ops(nbits: int = SCALAR_BITS, interpret: bool = False):
+    """Plane-layout G2 ladder: Fq2 elements are ``(32, 2, B)`` limb
+    planes over the fused Pallas kernels — same field-generic ladder, no
+    vmap (the batch is the trailing axis)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .bls_fq12 import get_fq12_plane_ops
+    from .ladder import make_ladder
+
+    fq = get_fq12_plane_ops(interpret)
+    one = np.zeros((BI.NLIMBS, 2, 1), np.int32)
+    one[:, 0, 0] = BI.to_limbs(1)
+    field = {
+        "mul": fq["fq2_mul"],
+        "add": fq["fq2_add"],
+        "sub": fq["fq2_sub"],
+        "one": jnp.asarray(one),
+        "zero": jnp.zeros((BI.NLIMBS, 2, 1), jnp.int32),
+        "eq": lambda a, b: jnp.all(a == b, axis=(0, 1)),
+        "felt_ndim": 0,
+        "flags": lambda bx: jnp.zeros(bx.shape[2:], jnp.bool_),
+    }
+    ladder = make_ladder(field, nbits)
+
+    def packed(base_xy, bits):
+        X, Y, Z, inf = ladder(base_xy, bits)
+        flat = jnp.concatenate(
+            [
+                X.reshape(2 * BI.NLIMBS, -1),
+                Y.reshape(2 * BI.NLIMBS, -1),
+                Z.reshape(2 * BI.NLIMBS, -1),
+                inf[None].astype(jnp.int32),
+            ],
+            axis=0,
+        )
+        return flat
+
+    # interpret mode stays unjitted (see make_g1_plane_ops)
+    return {"ladder_packed": packed if interpret else jax.jit(packed)}
+
+
+_G2_PLANE_OPS: dict = {}
+
+
+def _get_g2_plane_ops(nbits: int, interpret: bool = False):
+    key = (nbits, interpret)
+    if key not in _G2_PLANE_OPS:
+        _G2_PLANE_OPS[key] = make_g2_plane_ops(nbits, interpret)
+    return _G2_PLANE_OPS[key]
+
+
+def batch_g2_mul(
+    points: list,
+    scalars: list,
+    bits: int = SCALAR_BITS,
+    planes: bool | None = None,
+    interpret: bool = False,
+) -> list:
     """Batched ``[k_i * Q_i]`` on device for G2 affine points.
 
     ``points``: affine ``((x0, x1), (y0, y1))`` int tuples (no Nones);
     ``scalars``: ints in [0, 2^bits).  Returns the same tuple form or
     ``None`` for infinity results.
     """
+    from .bls_g1 import _PLANE_QUANTUM, _ints_batch, _use_planes
+
     assert len(points) == len(scalars)
     if not points:
         return []
-    ops = _get_g2_ops(bits)
+    n = len(points)
     bx = fq2_limbs_batch([pt[0] for pt in points])
     by = fq2_limbs_batch([pt[1] for pt in points])
-    kbits = _scalar_bits_batch(scalars, bits)
-    X, Y, Z, inf = ops["ladder_batched"]((bx, by), kbits)
-    X, Y, Z, inf = (np.asarray(X), np.asarray(Y), np.asarray(Z), np.asarray(inf))
+    if planes is None:
+        planes = _use_planes()
+    if planes:
+        import jax.numpy as jnp
+
+        pad = -n % _PLANE_QUANTUM
+        if pad:
+            # any Fq2 pad values work: padded lanes are dropped below
+            bx = np.concatenate([bx, np.repeat(fq2_limbs_batch([(1, 0)]), pad, 0)])
+            by = np.concatenate([by, np.repeat(fq2_limbs_batch([(2, 0)]), pad, 0)])
+        kbits = _scalar_bits_batch(list(scalars) + [1] * pad, bits)
+        ops = _get_g2_plane_ops(bits, interpret)
+        packed = np.asarray(
+            ops["ladder_packed"](
+                (
+                    jnp.asarray(np.ascontiguousarray(bx.transpose(2, 1, 0))),
+                    jnp.asarray(np.ascontiguousarray(by.transpose(2, 1, 0))),
+                ),
+                jnp.asarray(kbits.T),
+            )
+        )
+        nl = 2 * BI.NLIMBS
+        X = packed[:nl].reshape(BI.NLIMBS, 2, -1).transpose(2, 1, 0)
+        Y = packed[nl : 2 * nl].reshape(BI.NLIMBS, 2, -1).transpose(2, 1, 0)
+        Z = packed[2 * nl : 3 * nl].reshape(BI.NLIMBS, 2, -1).transpose(2, 1, 0)
+        inf = packed[3 * nl].astype(bool)
+        X, Y, Z = (np.ascontiguousarray(v[:n]) for v in (X, Y, Z))
+    else:
+        ops = _get_g2_ops(bits)
+        kbits = _scalar_bits_batch(scalars, bits)
+        X, Y, Z, inf = ops["ladder_batched"]((bx, by), kbits)
+        X, Y, Z, inf = (
+            np.asarray(X),
+            np.asarray(Y),
+            np.asarray(Z),
+            np.asarray(inf),
+        )
+
+    conv = {
+        id(arr): (_ints_batch(arr[:, 0]), _ints_batch(arr[:, 1]))
+        for arr in (X, Y, Z)
+    }
 
     def fq2_of(arr, i):
-        return (BI.from_limbs(arr[i, 0]), BI.from_limbs(arr[i, 1]))
+        c0, c1 = conv[id(arr)]
+        return (c0[i], c1[i])
 
     live = [i for i in range(len(points)) if not bool(inf[i])]
     zs = {i: fq2_of(Z, i) for i in live}
     # Fq2 inverse via conjugate / Fp norm; all norms inverted with one
-    # modexp (Montgomery prefix products), as in batch_g1_mul
-    norms = {i: (zs[i][0] * zs[i][0] + zs[i][1] * zs[i][1]) % P for i in live}
+    # modexp (batch_inv_mod, shared with batch_g1_mul)
+    from .bls_g1 import batch_inv_mod
+
     zinvs: dict[int, tuple] = {}
     if live:
-        for i in live:
-            assert norms[i] != 0, "finite ladder result with z == 0"
-        prefix = []
-        acc = 1
-        for i in live:
-            acc = acc * norms[i] % P
-            prefix.append(acc)
-        inv_all = pow(acc, P - 2, P)
-        for idx in range(len(live) - 1, -1, -1):
-            i = live[idx]
-            before = prefix[idx - 1] if idx > 0 else 1
-            ninv = inv_all * before % P
-            inv_all = inv_all * norms[i] % P
+        norms = [
+            (zs[i][0] * zs[i][0] + zs[i][1] * zs[i][1]) % P for i in live
+        ]
+        for i, ninv in zip(live, batch_inv_mod(norms, P)):
             zinvs[i] = (zs[i][0] * ninv % P, (P - zs[i][1]) * ninv % P)
     out = []
     for i in range(len(points)):

@@ -22,9 +22,13 @@ with the loop scalar |x| << R, infinities filtered by the caller — so the
 step formulas are used unconditionally and the kernel stays branch-free.
 
 Final exponentiation mirrors the host addition chain (cubed hard part,
-``crypto/bls/pairing.py:124-138``) with ``a^|x|`` as a scan over the static
+``crypto/bls/pairing.py``) with ``a^|x|`` as a scan over the static
 parameter bits; inversion is the batched Fermat powmod from
 :mod:`.bls_fq12`.
+
+Two instantiations (same code, different layout adapters — see
+:mod:`.bls_fq12`): the batch-leading einsum stack (CPU backend, oracle
+tests) and the limb-plane Pallas stack (TPU fast path).
 """
 
 from __future__ import annotations
@@ -34,7 +38,7 @@ import numpy as np
 from ..crypto.bls.fields import BLS_X
 from . import bigint as BI
 from . import bls_fq12 as FQ
-from .bls_g1 import _limbs_batch
+from .bls_g1 import _ints_batch, _limbs_batch, _use_planes
 
 __all__ = [
     "make_pairing_ops",
@@ -51,12 +55,13 @@ _X_BITS = np.array([int(b) for b in bin(BLS_X)[3:]], np.int32)
 _W_SLOTS = [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2)]
 
 
-def make_pairing_ops():
+def make_pairing_ops(plane: bool = False, interpret: bool = False):
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    ops = FQ.get_fq12_ops()
+    ops = FQ.get_fq12_plane_ops(interpret) if plane else FQ.get_fq12_ops()
+    lay = ops["layout"]
     f2m, f2s = ops["fq2_mul"], ops["fq2_sq"]
     f2a, f2sub = ops["fq2_add"], ops["fq2_sub"]
     f2neg, f2xi = ops["fq2_neg"], ops["fq2_mul_by_xi"]
@@ -68,13 +73,15 @@ def make_pairing_ops():
     bits = jnp.asarray(_X_BITS)
 
     def _slots(f):
-        """Fq12 (..., 2, 3, 2, 32) -> list of 6 Fq2 slots in w-power order."""
-        return [f[..., i, j, :, :] for (i, j) in _W_SLOTS]
+        """Fq12 -> list of 6 Fq2 slots in w-power order."""
+        return [
+            lay.part(6, lay.part(12, f, i), j) for (i, j) in _W_SLOTS
+        ]
 
     def _from_slots(s):
-        c0 = jnp.stack([s[0], s[2], s[4]], axis=-3)
-        c1 = jnp.stack([s[1], s[3], s[5]], axis=-3)
-        return jnp.stack([c0, c1], axis=-4)
+        c0 = lay.stack(6, [s[0], s[2], s[4]])
+        c1 = lay.stack(6, [s[1], s[3], s[5]])
+        return lay.stack(12, [c0, c1])
 
     def mul_sparse035(f, l0, l3, l5):
         """f *= l0 + l3 w^3 + l5 w^5 — 18 fq2 muls, mirrors the native
@@ -133,14 +140,11 @@ def make_pairing_ops():
         return mul_sparse035(f, l0, l3, l5), Xn, Yn, Zn
 
     def miller(px, py, qx, qy):
-        """Batched Miller loop.  px/py: (..., 32) Fp; qx/qy: (..., 2, 32)
-        Fq2 twist coordinates.  Returns f: (..., 2, 3, 2, 32)."""
-        f = ops["fq12_one"](px.shape[:-1])
+        """Batched Miller loop.  Fp operands px/py and Fq2 twist
+        coordinates qx/qy in the instantiation's layout; returns f."""
+        f = ops["fq12_one"](lay.fq_batch_shape(px))
         X, Y = qx, qy
-        Z = jnp.broadcast_to(
-            jnp.stack([jnp.asarray(BI.to_limbs(1)), jnp.zeros(BI.NLIMBS, jnp.int32)]),
-            qx.shape,
-        )
+        Z = jnp.broadcast_to(lay.np_fq2((1, 0)), qx.shape)
 
         def body(carry, bit):
             f, X, Y, Z = carry
@@ -177,21 +181,19 @@ def make_pairing_ops():
         return f12m(f12frob(f12frob(f)), f)
 
     def masked_product(f, mask):
-        """(..., K, fq12) + (..., K) live mask -> (..., fq12): padded
-        lanes become the identity, then a log-depth product over K."""
-        one = ops["fq12_one"](f.shape[:-4])
-        m = mask[..., None, None, None, None]
-        f = jnp.where(m, f, one)
-        k = f.shape[-5]
+        """Fq12 batch with a K grouping axis innermost + live mask ->
+        product over K; padded lanes become the identity."""
+        one = ops["fq12_one"](lay.batch_shape(f))
+        f = jnp.where(lay.expand_mask(mask), f, one)
+        k = lay.ksize(f)
         while k > 1:
             if k % 2:
-                f = jnp.concatenate(
-                    [f, ops["fq12_one"]((*f.shape[:-5], 1))], axis=-5
-                )
+                pad_shape = (*lay.batch_shape(f)[:-1], 1)
+                f = lay.kconcat([f, ops["fq12_one"](pad_shape)])
                 k += 1
-            f = f12m(f[..., 0::2, :, :, :, :], f[..., 1::2, :, :, :, :])
+            f = f12m(lay.kslice(f, slice(0, None, 2)), lay.kslice(f, slice(1, None, 2)))
             k //= 2
-        return f[..., 0, :, :, :, :]
+        return lay.kslice(f, 0)
 
     # The final exponentiation is composed on the host from these small
     # jitted pieces rather than jitted whole: the fully-unrolled chain is
@@ -214,8 +216,8 @@ def make_pairing_ops():
 
     def final_exp(f):
         """Host-composed mirror of the host-side addition chain
-        (crypto/bls/pairing.py:124-138): easy part, then the cubed hard
-        part — every step a cached device dispatch."""
+        (crypto/bls/pairing.py): easy part, then the cubed hard part —
+        every step a cached device dispatch."""
         mul, conj, frob, sq = (
             jits["mul"],
             jits["conj"],
@@ -230,33 +232,22 @@ def make_pairing_ops():
         return mul(d, mul(sq(m), m))
 
     def check_tail(f, mask):
-        """(G, K, fq12) Miller outputs + (G, K) live mask -> (G,) bools."""
+        """Miller outputs grouped (batch..., K) + live mask -> bools."""
         return jits["is_one"](final_exp(jits["masked_product"](f, mask)))
 
     jits["final_exp"] = final_exp
     jits["check_tail"] = check_tail
+    jits["layout"] = lay
     return jits
 
 
-_OPS = None
+_OPS: dict = {}
 
 
-def _get_ops():
-    global _OPS
-    if _OPS is None:
-        _OPS = make_pairing_ops()
-    return _OPS
-
-
-def _pack_pairs(pairs):
-    """[(G1 affine, G2 affine)] -> (px, py, qx, qy) limb batches."""
-    from .bls_g2 import fq2_limbs_batch
-
-    px = _limbs_batch([p[0] for p, _ in pairs])
-    py = _limbs_batch([p[1] for p, _ in pairs])
-    qx = fq2_limbs_batch([q[0] for _, q in pairs])
-    qy = fq2_limbs_batch([q[1] for _, q in pairs])
-    return px, py, qx, qy
+def _get_ops(plane: bool = False):
+    if plane not in _OPS:
+        _OPS[plane] = make_pairing_ops(plane)
+    return _OPS[plane]
 
 
 def _pow2_pad(n: int) -> int:
@@ -275,17 +266,66 @@ def _pad_pairs(pairs, target):
     return list(pairs) + [(G1_GENERATOR, G2_GENERATOR)] * (target - len(pairs))
 
 
-def miller_loop_batch(pairs):
+def _fq2_batch(values) -> np.ndarray:
+    from .bls_g2 import fq2_limbs_batch
+
+    return fq2_limbs_batch(values)
+
+
+def _pack_pairs(pairs, plane: bool):
+    """[(G1 affine, G2 affine)] -> (px, py, qx, qy) in the layout."""
+    px = _limbs_batch([p[0] for p, _ in pairs])
+    py = _limbs_batch([p[1] for p, _ in pairs])
+    qx = _fq2_batch([q[0] for _, q in pairs])
+    qy = _fq2_batch([q[1] for _, q in pairs])
+    if plane:
+        px, py = px.T.copy(), py.T.copy()
+        qx = np.ascontiguousarray(qx.transpose(2, 1, 0))
+        qy = np.ascontiguousarray(qy.transpose(2, 1, 0))
+    return px, py, qx, qy
+
+
+def _fq12_tuples_from_planes(f: np.ndarray, n: int) -> list:
+    """(32, 2, 3, 2, B) plane Fq12 batch -> host tuples for the first n."""
+    out = []
+    slot_ints = {
+        (i, j, k): _ints_batch(np.ascontiguousarray(f[:, i, j, k, :n].T))
+        for i in range(2)
+        for j in range(3)
+        for k in range(2)
+    }
+    for e in range(n):
+        out.append(
+            tuple(
+                tuple(
+                    (slot_ints[(i, j, 0)][e], slot_ints[(i, j, 1)][e])
+                    for j in range(3)
+                )
+                for i in range(2)
+            )
+        )
+    return out
+
+
+def miller_loop_batch(pairs, plane: bool | None = None):
     """Batched Miller loops on device -> list of host Fq12 tuples.
 
     ``pairs``: affine, non-infinity, subgroup-checked (P in G1, Q in G2).
     """
     if not pairs:
         return []
+    import jax.numpy as jnp
+
+    if plane is None:
+        plane = _use_planes()
     n = len(pairs)
     padded = _pad_pairs(pairs, _pow2_pad(n))
-    f = _get_ops()["miller"](*_pack_pairs(padded))
+    f = _get_ops(plane)["miller"](
+        *[jnp.asarray(x) for x in _pack_pairs(padded, plane)]
+    )
     f = np.asarray(f)
+    if plane:
+        return _fq12_tuples_from_planes(f, n)
     return [FQ.fq12_from_limbs(f[i]) for i in range(n)]
 
 
@@ -294,10 +334,12 @@ def pairing_product_is_one(pairs) -> bool:
     return pairing_products_are_one([pairs])[0]
 
 
-def pairing_products_are_one(checks) -> list[bool]:
+def pairing_products_are_one(checks, plane: bool | None = None) -> list[bool]:
     """Batched pairing-product checks (one bool per inner pair list)."""
     if not checks:
         return []
+    if plane is None:
+        plane = _use_planes()
     kmax = _pow2_pad(max(len(c) for c in checks))
     g = _pow2_pad(len(checks))
     flat = []
@@ -306,11 +348,13 @@ def pairing_products_are_one(checks) -> list[bool]:
         chk = checks[i] if i < len(checks) else []
         mask[i, : len(chk)] = True
         flat.extend(_pad_pairs(chk, kmax))
-    ops = _get_ops()
-    f = ops["miller"](*_pack_pairs(flat))  # (g*kmax, fq12)
-    f = f.reshape(g, kmax, *f.shape[1:])
-
     import jax.numpy as jnp
 
+    ops = _get_ops(plane)
+    f = ops["miller"](*[jnp.asarray(x) for x in _pack_pairs(flat, plane)])
+    if plane:
+        f = f.reshape(*f.shape[:-1], g, kmax)
+    else:
+        f = f.reshape(g, kmax, *f.shape[1:])
     ok = ops["check_tail"](f, jnp.asarray(mask))
     return [bool(v) for v in np.asarray(ok)[: len(checks)]]

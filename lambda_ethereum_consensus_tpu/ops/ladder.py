@@ -18,6 +18,12 @@ def make_ladder(field, scalar_bits: int):
 
     Returns ``ladder(base_xy, bits)`` mapping an affine base (limb form) and
     an MSB-first bit vector to the Jacobian ``(X, Y, Z, inf)`` result.
+
+    Layout-generic: the vmapped batch-leading stack uses scalar infinity
+    flags and per-element bit vectors; the plane (batch-last) stack passes
+    ``flags`` in the field dict to get (B,)-shaped flags and scans bit
+    ROWS — the point formulas are identical because every select
+    broadcasts against trailing element axes.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -29,6 +35,7 @@ def make_ladder(field, scalar_bits: int):
     one = field["one"]
     zero = field["zero"]
     felt_ndim = field["felt_ndim"]
+    flags0 = field.get("flags", lambda bx: jnp.zeros((), jnp.bool_))
 
     def expand(mask):
         for _ in range(felt_ndim):
@@ -96,12 +103,13 @@ def make_ladder(field, scalar_bits: int):
 
     def ladder(base_xy, bits):
         bx, by = base_xy
-        base = (bx, by, one, jnp.zeros((), jnp.bool_))
+        inf0 = flags0(bx)
+        base = (bx, by, jnp.broadcast_to(one, bx.shape), inf0)
         acc = (
             jnp.zeros_like(bx),
             jnp.zeros_like(by),
-            zero,
-            jnp.ones((), jnp.bool_),
+            jnp.broadcast_to(zero, bx.shape),
+            jnp.ones_like(inf0),
         )
 
         def step(acc, bit):

@@ -1,0 +1,16 @@
+"""Environment-flag parsing shared by the device-routing switches
+(BLS_DEVICE_MSM, BLS_DEVICE_PAIRING, BIGINT_NO_PALLAS, ...)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_flag"]
+
+
+def env_flag(name: str) -> bool:
+    """One truthiness parse for every routing flag, so spellings like
+    ``off``/``False`` never enable a path by accident."""
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "no", "off",
+    )

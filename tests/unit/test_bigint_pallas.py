@@ -1,0 +1,154 @@
+"""Pallas plane-layout field kernels vs host int arithmetic.
+
+Runs the kernels in Pallas interpret mode on the CPU backend, one tile
+(B = 1024) — the TPU fast path is the same kernel code compiled by
+Mosaic, oracle-checked on hardware via the plane-ladder probes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from lambda_ethereum_consensus_tpu.crypto.bls.fields import P
+from lambda_ethereum_consensus_tpu.ops import bigint_pallas as BP
+
+RNG = random.Random(91)
+B_TILE = BP.SUBLANES * BP.LANES  # one grid tile
+
+
+def _rand_elems(n):
+    xs = [RNG.randrange(P) for _ in range(n)]
+    # exercise carry edges: top-heavy and tiny values
+    xs[0] = P - 1
+    xs[1] = 0
+    xs[2] = 1
+    return xs
+
+
+@pytest.fixture(scope="module")
+def plane_ops():
+    return BP.make_plane_ops(interpret=True)
+
+
+def _planes(xs):
+    """(32, B) 2-D plane layout — the shape the ladder field ops use."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(BP.to_planes(xs, B_TILE // BP.LANES)).reshape(32, -1)
+
+
+def test_mul_mod_kernel_matches_host(plane_ops):
+    xs, ys = _rand_elems(8), _rand_elems(8)[::-1]
+    out = plane_ops["mul_mod"](_planes(xs), _planes(ys))
+    got = BP.from_planes(np.asarray(out), 8)
+    assert got == [(x * y) % P for x, y in zip(xs, ys)]
+
+
+def test_add_sub_kernels_match_host(plane_ops):
+    xs, ys = _rand_elems(8), _rand_elems(8)[::-1]
+    pa, pb = _planes(xs), _planes(ys)
+    got_add = BP.from_planes(np.asarray(plane_ops["add_mod"](pa, pb)), 8)
+    assert got_add == [(x + y) % P for x, y in zip(xs, ys)]
+    got_sub = BP.from_planes(np.asarray(plane_ops["sub_mod"](pa, pb)), 8)
+    assert got_sub == [(x - y) % P for x, y in zip(xs, ys)]
+
+
+def test_plane_fq2_tower_matches_host():
+    import jax.numpy as jnp
+
+    from lambda_ethereum_consensus_tpu.crypto.bls import fields as F
+    from lambda_ethereum_consensus_tpu.ops.bls_fq12 import get_fq12_plane_ops
+
+    fq = get_fq12_plane_ops(interpret=True)
+    a = (RNG.randrange(P), RNG.randrange(P))
+    b = (RNG.randrange(P), RNG.randrange(P))
+
+    def fq2_planes(v):
+        import numpy as np_
+
+        arr = np_.stack([BP.to_planes([c], 1) for c in v], axis=1)
+        return jnp.asarray(arr.reshape(32, 2, -1))
+
+    got = np.asarray(fq["fq2_mul"](fq2_planes(a), fq2_planes(b)))
+    want = F.fq2_mul(a, b)
+    from lambda_ethereum_consensus_tpu.ops.bls_g1 import _ints_batch
+
+    got_t = tuple(_ints_batch(got[:, i, :1].T)[0] for i in range(2))
+    assert got_t == want
+
+
+def test_plane_marshalling_round_trip(monkeypatch):
+    """The plane pack -> packed-ladder -> unpack -> affine pipeline with a
+    stub ladder computing the k in {0, 1} cases in pure jnp: validates
+    every transpose/reshape/row-offset and the batch affine conversion
+    without paying an interpret-mode scalar ladder (each eager interpret
+    kernel call costs >30s on CPU; the real ladder math is oracle-checked
+    at kernel level here and end-to-end on TPU)."""
+    import jax.numpy as jnp
+
+    from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
+    from lambda_ethereum_consensus_tpu.ops import bls_g1, bls_g2
+
+    def fake_g1(nbits, interpret=False):
+        def packed(base_xy, bits):
+            bx, by = base_xy
+            inf = ~jnp.any(bits != 0, axis=0)  # k == 0 -> infinity
+            one = jnp.broadcast_to(
+                jnp.asarray(BP.to_planes([1], 8).reshape(32, -1)[:, :1]), bx.shape
+            )
+            return jnp.concatenate(
+                [bx, by, one, inf[None].astype(jnp.int32)], axis=0
+            )
+
+        return {"ladder_packed": packed}
+
+    monkeypatch.setattr(bls_g1, "_get_g1_plane_ops", fake_g1)
+    ks = [1, 0, 1, 1]
+    got = bls_g1.batch_g1_mul([C.G1_GENERATOR] * 4, ks, bits=8, planes=True)
+    assert got[1] is None
+    for k, g in zip(ks, got):
+        if k:
+            assert g == C.G1_GENERATOR
+
+    def fake_g2(nbits, interpret=False):
+        def packed(base_xy, bits):
+            bx, by = base_xy  # (32, 2, B)
+            inf = ~jnp.any(bits != 0, axis=0)
+            one = jnp.zeros_like(bx)
+            one = one.at[:, 0, :].set(
+                jnp.broadcast_to(
+                    jnp.asarray(BP.to_planes([1], 8).reshape(32, -1)[:, :1]),
+                    bx[:, 0, :].shape,
+                )
+            )
+            n = bx.shape[0] * 2
+            return jnp.concatenate(
+                [
+                    bx.reshape(n, -1),
+                    by.reshape(n, -1),
+                    one.reshape(n, -1),
+                    inf[None].astype(jnp.int32),
+                ],
+                axis=0,
+            )
+
+        return {"ladder_packed": packed}
+
+    monkeypatch.setattr(bls_g2, "_get_g2_plane_ops", fake_g2)
+    got2 = bls_g2.batch_g2_mul([C.G2_GENERATOR] * 4, ks, bits=8, planes=True)
+    assert got2[1] is None
+    for k, g in zip(ks, got2):
+        if k:
+            assert g == C.G2_GENERATOR
+
+
+def test_broadcast_constant_operand(plane_ops):
+    import jax.numpy as jnp
+
+    from lambda_ethereum_consensus_tpu.ops import bigint as BI
+
+    xs = _rand_elems(8)
+    one = jnp.asarray(BI.to_limbs(1)[:, None])  # (32, 1) broadcasts to (32, B)
+    out = plane_ops["mul_mod"](_planes(xs), one)
+    assert BP.from_planes(np.asarray(out), 8) == xs
