@@ -15,6 +15,11 @@ native:
 test: native
 	python -m pytest tests/ -q -m "not spectest"
 
+# Opt-in heavy lane: multi-GB / multi-minute XLA CPU compiles of the
+# einsum-stack device pairing oracle tests (see test_device_pairing.py).
+test-heavy: native
+	BLS_HEAVY_TESTS=1 python -m pytest tests/unit/test_device_pairing.py -q
+
 # Conformance vectors (ref: Makefile:60-100). Requires network egress.
 spec-vectors:
 	mkdir -p $(VENDOR)

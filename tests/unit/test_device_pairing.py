@@ -3,9 +3,25 @@
 The raw device Miller output differs from the host's by Fq2 subfield
 factors (projective line scaling), so Miller comparisons go through a
 final exponentiation — exactly the invariance the scaling relies on.
+
+The Miller-loop and product-check tests compile multi-minute XLA CPU
+programs whose compile peaks tens of GB of RAM on a small box, so they
+are opt-in via BLS_HEAVY_TESTS=1 (CI keeps the tower test; the Miller
+loop, product check, and the Pallas plane stack are oracle-verified on
+real TPU hardware each round — see ARCHITECTURE.md "Measured").
 """
 
 import random
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.utils.env import env_flag
+
+heavy = pytest.mark.skipif(
+    not env_flag("BLS_HEAVY_TESTS"),
+    reason="einsum-stack pairing compile needs tens of GB / many minutes "
+    "on CPU; set BLS_HEAVY_TESTS=1 (TPU-verified otherwise)",
+)
 
 from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
 from lambda_ethereum_consensus_tpu.crypto.bls import fields as F
@@ -45,6 +61,7 @@ def test_fq12_tower_matches_host():
     assert FQ.fq12_from_limbs(got[1]) == F.fq12_mul(b, b)
 
 
+@heavy
 def test_miller_matches_host_after_final_exp():
     from lambda_ethereum_consensus_tpu.crypto.bls.pairing import (
         final_exponentiation,
@@ -66,6 +83,7 @@ def test_miller_matches_host_after_final_exp():
         )
 
 
+@heavy
 def test_device_product_check_bilinearity():
     a = RNG.getrandbits(128)
     aP = C.g1.multiply_raw(C.G1_GENERATOR, a)
@@ -77,6 +95,7 @@ def test_device_product_check_bilinearity():
     assert not DP.pairing_product_is_one([(bad, C.G2_GENERATOR), (negP, aQ)])
 
 
+@heavy
 def test_device_multi_check_batch():
     ks = [RNG.getrandbits(96) for _ in range(3)]
     negP = C.g1.affine_neg(C.G1_GENERATOR)
