@@ -9,12 +9,12 @@ Setting JAX_PLATFORMS / XLA_FLAGS must happen before jax initializes.
 import os
 import sys
 
-# Keep subprocesses spawned by tests on the CPU backend too.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Keep subprocesses spawned by tests on the CPU backend too.  Single source
+# of truth for the virtual-mesh env lives next to the driver entry points.
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from __graft_entry__ import virtual_cpu_env  # noqa: E402
+
+virtual_cpu_env(8, os.environ)
 
 # On axon machines sitecustomize imports jax at interpreter startup, which
 # snapshots JAX_PLATFORMS before this file runs — env mutation alone is a

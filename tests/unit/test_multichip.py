@@ -1,0 +1,46 @@
+"""The driver's multi-chip dryrun, exercised in CI on the virtual CPU mesh.
+
+Mirrors the reference's multi-node-on-one-machine testing discipline
+(ref: test/unit/libp2p_port_test.exs:30-50 runs two libp2p hosts over
+loopback); here the analogue is the sharded-compute path run on the
+conftest-forced 8-device CPU mesh every CI run — the exact program the
+driver records in MULTICHIP_r*.json.
+"""
+
+import jax
+import pytest
+
+import __graft_entry__ as graft
+
+
+def _require_devices(n: int):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} jax devices (conftest forces 8 on CPU)")
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_dryrun_multichip_impl_on_virtual_mesh(n_devices):
+    _require_devices(n_devices)
+    # Raises (assert inside: sharded root == single-device root) on any
+    # divergence between the shard_map program and the replicated tree.
+    graft._dryrun_multichip_impl(n_devices)
+
+
+def test_dryrun_multichip_public_entrypoint():
+    """The driver calls this exact function on an arbitrary box; it must
+    succeed even when the live backend has fewer devices (subprocess
+    fallback) — regression test for round 1's MULTICHIP ok=false.
+
+    conftest forces exactly 8 devices, so n_devices=16 deliberately
+    overshoots the live backend and drives the subprocess-fallback branch
+    (the round-1 failure mode); n_devices=8 covers the direct path above.
+    """
+    assert len(jax.devices()) < 16, "precondition: must exercise the fallback"
+    graft.dryrun_multichip(16)
+
+
+def test_entry_compiles_and_runs():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    out.block_until_ready()
+    assert out.shape == (4096, 8)
