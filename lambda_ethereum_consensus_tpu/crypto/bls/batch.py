@@ -27,6 +27,7 @@ import os
 import secrets
 from typing import Sequence
 
+from ...utils.env import device_default
 from . import curve as C
 from .curve import DeserializationError
 from .hash_to_curve import DST_POP, hash_to_g2
@@ -40,12 +41,28 @@ _COEFF_BITS = 128
 PointEntry = tuple
 
 
+def _chain_enabled(n: int) -> bool:
+    """Route whole RLC checks through the chained device pipeline
+    (:mod:`...ops.bls_batch` — ladders, group sums, Miller, final exp all
+    on device, one boolean pulled back).  Default ON on TPU hosts
+    (opt-out ``BLS_NO_DEVICE``), force-enable anywhere with
+    ``BLS_DEVICE_CHAIN=1``."""
+    threshold = int(os.environ.get("BLS_DEVICE_CHAIN_MIN", "128"))
+    if n < threshold:
+        return False
+    return env_flag("BLS_DEVICE_CHAIN") or device_default()
+
+
 def _scale_entries(entries, coeffs):
-    """``[(r_i * pk_i, r_i * sig_i)]`` — on device when ``BLS_DEVICE_MSM=1``
-    and the batch amortizes the dispatch (the TPU ladder beats the native
-    host path from a few hundred items up; see ops/bls_g1.py)."""
+    """``[(r_i * pk_i, r_i * sig_i)]`` — on device when the batch
+    amortizes the dispatch (the TPU ladder beats the native host path from
+    a few hundred items up; see ops/bls_g1.py).  Device routing is on by
+    default on TPU hosts (``BLS_NO_DEVICE`` opts out); ``BLS_DEVICE_MSM=1``
+    force-enables elsewhere."""
     threshold = int(os.environ.get("BLS_DEVICE_MSM_MIN", "256"))
-    if env_flag("BLS_DEVICE_MSM") and len(entries) >= threshold:
+    if (env_flag("BLS_DEVICE_MSM") or device_default()) and len(
+        entries
+    ) >= threshold:
         from ...ops.bls_g1 import batch_g1_mul
         from ...ops.bls_g2 import batch_g2_mul
 
@@ -77,6 +94,25 @@ def verify_points(
     if message_points is None:
         message_points = {}
     coeffs = [secrets.randbits(_COEFF_BITS) | 1 for _ in entries]
+    if _chain_enabled(len(entries)):
+        from ...ops.bls_batch import chain_verify
+
+        group_of: dict[bytes, int] = {}
+        h_points = []
+        gids = []
+        for _, message, _ in entries:
+            g = group_of.get(message)
+            if g is None:
+                g = group_of[message] = len(h_points)
+                h = message_points.get((message, dst))
+                if h is None:
+                    h = message_points[(message, dst)] = hash_to_g2(message, dst)
+                h_points.append(h)
+            gids.append(g)
+        packed = [
+            (pk, sig, r) for (pk, _, sig), r in zip(entries, coeffs)
+        ]
+        return chain_verify([(packed, h_points, gids)])[0]
     scaled_pks, scaled_sigs = _scale_entries(entries, coeffs)
     by_message: dict[bytes, C.AffinePoint] = {}
     sig_acc: C.AffinePoint = None

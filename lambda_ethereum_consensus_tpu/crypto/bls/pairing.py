@@ -154,8 +154,12 @@ def _device_pairing_enabled(n: int) -> bool:
     """Route big pairing products to the batched device Miller loop
     (ops/bls_pairing) — the RLC batch-verify shape: many pairs, one check.
     Small checks stay on the native host path, which wins below the
-    device dispatch/transfer overhead."""
-    if not env_flag("BLS_DEVICE_PAIRING"):
+    device dispatch/transfer overhead.  Default ON on TPU hosts
+    (``BLS_NO_DEVICE`` opts out); ``BLS_DEVICE_PAIRING=1`` force-enables
+    elsewhere."""
+    from ...utils.env import device_default
+
+    if not (env_flag("BLS_DEVICE_PAIRING") or device_default()):
         return False
     return n >= int(os.environ.get("BLS_DEVICE_PAIRING_MIN", "32"))
 

@@ -193,21 +193,56 @@ def _plane_call(kernel, a, b, interpret: bool):
     return out.reshape(_N, mp)[:, :m].reshape(shape)
 
 
-def make_plane_ops(interpret: bool = False):
-    """mul/add/sub over ``(32, ..., B)`` with ``prod(.., B) % 1024 == 0``
-    after broadcasting — the Pallas tile quantum.  All three ops are fused
-    kernels (the XLA carry-lookahead path costs more than the Pallas
-    serial sweep once multiplication stops dominating).  ``interpret=True``
-    runs the kernels in Pallas interpret mode (CPU tests)."""
+def make_plane_ops(interpret: bool = False, pallas_interpret: bool = False):
+    """mul/add/sub over ``(32, ..., B)`` plane-layout operands.
+
+    Default: fused Pallas kernels, ``prod(.., B) % 1024 == 0`` after
+    broadcasting (tile quantum handled internally).
+
+    ``interpret=True`` is the CPU-testable mode: plane semantics served by
+    the jitted einsum/Barrett path (:mod:`.bigint`) through layout
+    transposes — fast enough to drive the full plane ladder/pairing/chain
+    stacks in CI.  The Pallas kernel *statements* get their own CPU
+    coverage via ``pallas_interpret=True`` (true Pallas interpret mode,
+    per-tile Python execution — kernel unit tests only; far too slow for
+    the composite stacks).
+    """
+    if interpret and not pallas_interpret:
+        import jax
+        import jax.numpy as jnp
+
+        eins = BI.get_ops()
+
+        def _lift(op):
+            # One jitted program per op/shape: the moveaxis/broadcast
+            # wrappers would otherwise multiply eager-dispatch overhead
+            # ~6x across the hundreds of thousands of field ops a chained
+            # verify issues.
+            @jax.jit
+            def f(a, b):
+                shape = jnp.broadcast_shapes(a.shape, b.shape)
+                a2 = jnp.moveaxis(jnp.broadcast_to(a, shape), 0, -1)
+                b2 = jnp.moveaxis(jnp.broadcast_to(b, shape), 0, -1)
+                return jnp.moveaxis(op(a2, b2), -1, 0)
+
+            return f
+
+        return {
+            "mul_mod": _lift(eins["mul_mod"]),
+            "add_mod": _lift(eins["add_mod"]),
+            "sub_mod": _lift(eins["sub_mod"]),
+        }
+
+    run_interpret = pallas_interpret
 
     def _mul(a, b):
-        return _plane_call(_mul_mod_kernel, a, b, interpret)
+        return _plane_call(_mul_mod_kernel, a, b, run_interpret)
 
     def _add(a, b):
-        return _plane_call(_add_mod_kernel, a, b, interpret)
+        return _plane_call(_add_mod_kernel, a, b, run_interpret)
 
     def _sub(a, b):
-        return _plane_call(_sub_mod_kernel, a, b, interpret)
+        return _plane_call(_sub_mod_kernel, a, b, run_interpret)
 
     return {"mul_mod": _mul, "add_mod": _add, "sub_mod": _sub}
 

@@ -1,0 +1,314 @@
+"""Chained device RLC batch verification (the whole check on device).
+
+Round 1 ran each device stage through the host: ladder -> pull affine ints
+-> host group adds -> repack -> Miller -> check.  Every pull costs a fixed
+~0.4 s on a tunneled TPU, so the kernel speed never reached the API.  This
+module chains every stage ON DEVICE — the host packs limb planes once and
+pulls back C booleans:
+
+    ladders (r_i * pk_i, r_i * sig_i)           [128-bit plane ladders]
+    -> gather into (check, group, slot) rectangles
+    -> Jacobian tree reductions (group pk sums, per-check sig sum)
+    -> batched Fermat normalization (Jacobian -> affine, no host inversion)
+    -> Miller loop over (check, group+1) pairs    [ops/bls_pairing]
+    -> masked per-check product, shared final exponentiation, == 1
+
+Grouping by message mirrors ``crypto/bls/batch.py::verify_points`` (ref:
+native/bls_nif/src/lib.rs:14-158 — the blst aggregate-verify API this
+replaces): the pairing count per check is ``#distinct messages + 1``.
+
+Infinity semantics: a group sum or signature sum that reduces to the point
+at infinity contributes e(inf, Q) = 1, which the device path realizes by
+masking that Miller slot to the Fq12 identity — the same value the true
+pairing would take, so masking is semantics, not approximation.  Dead
+(padding) slots use the same mask.
+
+Shapes are padded to a small set (batch to the 1024-lane plane quantum,
+slots/groups to powers of two) so jit caches stay warm across drains.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.bls import curve as C
+from ..crypto.bls.batch import _COEFF_BITS  # single soundness-width source
+from . import bigint as BI
+from .bls_g1 import _limbs_batch, _scalar_bits_batch, _use_planes, g1_plane_field
+from .bls_g2 import fq2_limbs_batch, g2_plane_field
+from .bls_pairing import _pow2_pad as _pow2
+
+__all__ = ["chain_verify", "aggregate_g1_chain"]
+
+_QUANTUM = 1024  # plane kernel tile quantum (sublanes x lanes)
+
+
+def _g1_planes(points) -> tuple[np.ndarray, np.ndarray]:
+    """[(x, y)] -> two (32, N) plane arrays."""
+    bx = _limbs_batch([p[0] for p in points])
+    by = _limbs_batch([p[1] for p in points])
+    return np.ascontiguousarray(bx.T), np.ascontiguousarray(by.T)
+
+
+def _g2_planes(points) -> tuple[np.ndarray, np.ndarray]:
+    """[((x0,x1),(y0,y1))] -> two (32, 2, N) plane arrays."""
+    bx = fq2_limbs_batch([p[0] for p in points])
+    by = fq2_limbs_batch([p[1] for p in points])
+    return (
+        np.ascontiguousarray(bx.transpose(2, 1, 0)),
+        np.ascontiguousarray(by.transpose(2, 1, 0)),
+    )
+
+
+def make_chain_ops(interpret: bool = False):
+    """Build (and cache) the chained-stage functions for one backend mode."""
+    import jax
+    import jax.numpy as jnp
+
+    from .bls_fq12 import get_fq12_plane_ops
+    from .bls_pairing import _get_ops as get_pairing_ops
+    from .ladder import make_jacobian_ops
+
+    fq = get_fq12_plane_ops(interpret)
+    g1f = g1_plane_field(interpret)
+    g2f = g2_plane_field(interpret)
+    g1j = make_jacobian_ops(g1f, _COEFF_BITS, eager=interpret)
+    g2j = make_jacobian_ops(g2f, _COEFF_BITS, eager=interpret)
+    pairing = get_pairing_ops(plane=True, interpret=interpret)
+    wrap = (lambda f: f) if interpret else jax.jit
+
+    def ladder_g1(bx, by, kbits, live):
+        X, Y, Z, inf = g1j["ladder"]((bx, by), kbits)
+        return X, Y, Z, inf | ~live
+
+    def ladder_g2(bx, by, kbits, live):
+        X, Y, Z, inf = g2j["ladder"]((bx, by), kbits)
+        return X, Y, Z, inf | ~live
+
+    def _tree_reduce(jac, pt):
+        """Reduce the trailing axis (a power of two) by pairwise jac_add."""
+        X, Y, Z, inf = pt
+        while X.shape[-1] > 1:
+            a = (X[..., ::2], Y[..., ::2], Z[..., ::2], inf[..., ::2])
+            b = (X[..., 1::2], Y[..., 1::2], Z[..., 1::2], inf[..., 1::2])
+            X, Y, Z, inf = jac["jac_add"](a, b)
+        return X[..., 0], Y[..., 0], Z[..., 0], inf[..., 0]
+
+    def _norm_g1(X, Y, Z):
+        """Jacobian -> affine via batched Fermat inversion (z=0 -> (0,0))."""
+        zi = fq["fp_inv"](Z)
+        zi2 = fq["mul"](zi, zi)
+        return fq["mul"](X, zi2), fq["mul"](Y, fq["mul"](zi2, zi))
+
+    def _norm_g2(X, Y, Z):
+        zi = fq["fq2_inv"](Z)
+        zi2 = fq["fq2_mul"](zi, zi)
+        return fq["fq2_mul"](X, zi2), fq["fq2_mul"](Y, fq["fq2_mul"](zi2, zi))
+
+    # -G1 generator, the fixed P of the signature-sum pair.
+    _ng = C.g1.affine_neg(C.G1_GENERATOR)
+    neg_g1_x = jnp.asarray(BI.to_limbs(_ng[0])[:, None, None])  # (32,1,1)
+    neg_g1_y = jnp.asarray(BI.to_limbs(_ng[1])[:, None, None])
+
+    def prep(jac1, jac2, idx_g1, idx_sig, h_x, h_y, static_live):
+        """Gather + reduce + normalize + pack the Miller batch.
+
+        jac1/jac2: ladder outputs over the flat entry batch.
+        idx_g1: (c, m1, s) int32 entry indices per (check, group, slot);
+        idx_sig: (c, e) indices per (check, slot); dead slots point at an
+        entry whose inf flag is set.  h_x/h_y: (32, 2, c, m1) hashed
+        message points; static_live: (c, m) host liveness (m = m1 + 1,
+        slot m-1 is the signature pair).
+        """
+        c, m1, s = idx_g1.shape
+        X, Y, Z, inf = jac1
+        g = (
+            jnp.take(X, idx_g1.reshape(-1), axis=1).reshape(-1, c, m1, s),
+            jnp.take(Y, idx_g1.reshape(-1), axis=1).reshape(-1, c, m1, s),
+            jnp.take(Z, idx_g1.reshape(-1), axis=1).reshape(-1, c, m1, s),
+            jnp.take(inf, idx_g1.reshape(-1), axis=0).reshape(c, m1, s),
+        )
+        gX, gY, gZ, ginf = _tree_reduce(g1j, g)  # (32, c, m1), (c, m1)
+        px_g, py_g = _norm_g1(gX, gY, gZ)
+
+        X2, Y2, Z2, inf2 = jac2
+        e = idx_sig.shape[1]
+        s2 = (
+            jnp.take(X2, idx_sig.reshape(-1), axis=2).reshape(-1, 2, c, e),
+            jnp.take(Y2, idx_sig.reshape(-1), axis=2).reshape(-1, 2, c, e),
+            jnp.take(Z2, idx_sig.reshape(-1), axis=2).reshape(-1, 2, c, e),
+            jnp.take(inf2, idx_sig.reshape(-1), axis=0).reshape(c, e),
+        )
+        sX, sY, sZ, sinf = _tree_reduce(g2j, s2)  # (32, 2, c), (c,)
+        qx_s, qy_s = _norm_g2(sX, sY, sZ)
+
+        # Pack the (c, m) Miller batch: groups in slots 0..m1-1, the
+        # signature pair last.
+        px = jnp.concatenate([px_g, jnp.broadcast_to(neg_g1_x, (32, c, 1))], -1)
+        py = jnp.concatenate([py_g, jnp.broadcast_to(neg_g1_y, (32, c, 1))], -1)
+        qx = jnp.concatenate([h_x, qx_s[..., None]], -1)
+        qy = jnp.concatenate([h_y, qy_s[..., None]], -1)
+        inf_all = jnp.concatenate([ginf, sinf[:, None]], -1)  # (c, m)
+        mask = static_live & ~inf_all
+        return px, py, qx, qy, mask
+
+    def aggregate_g1(bx, by):
+        inf = jnp.zeros(bx.shape[1:], jnp.bool_)
+        z = jnp.broadcast_to(
+            jnp.asarray(BI.to_limbs(1)).reshape(32, *([1] * (bx.ndim - 1))),
+            bx.shape,
+        )
+        X, Y, Z, _ = _tree_reduce(g1j, (bx, by, z, inf))
+        return _norm_g1(X, Y, Z)
+
+    return {
+        "ladder_g1": wrap(ladder_g1),
+        "ladder_g2": wrap(ladder_g2),
+        "prep": wrap(prep),
+        "aggregate_g1": wrap(aggregate_g1),
+        "miller": pairing["miller"],
+        "check_tail": pairing["check_tail"],
+        "tree_reduce": _tree_reduce,
+        "norm_g1": _norm_g1,
+        "g1j": g1j,
+        "g2j": g2j,
+        "wrap": wrap,
+    }
+
+
+_CHAIN_OPS: dict = {}
+
+
+def _get_chain_ops(interpret: bool = False):
+    if interpret not in _CHAIN_OPS:
+        _CHAIN_OPS[interpret] = make_chain_ops(interpret)
+    return _CHAIN_OPS[interpret]
+
+
+def chain_verify(
+    checks, interpret: bool | None = None, coeff_bits: int = _COEFF_BITS
+) -> list[bool]:
+    """Verify C independent RLC pairing-product checks in one device chain.
+
+    Each check is ``(entries, h_points, group_ids)``:
+
+    - ``entries``: list of ``(pk_xy, sig_xy, coeff)`` — G1 affine int pair,
+      G2 affine Fq2 pair, RLC coefficient in [1, 2^coeff_bits).
+      ``coeff_bits`` is 128 for production soundness (~2^-128 forgery
+      slip); tests shorten it to cut ladder steps.
+    - ``h_points``: G2 affine int pairs, one per message group.
+    - ``group_ids``: per-entry group index into ``h_points``.
+
+    Returns one bool per check:  prod_g e(sum_{i in g} r_i pk_i, H_g)
+    * e(-g1, sum_i r_i sig_i) == 1.  Points must be on-curve and
+    subgroup-checked by the caller (decoders do this); entries with
+    infinity points must be filtered by the caller.
+    """
+    import jax.numpy as jnp
+
+    if interpret is None:
+        # Pallas plane kernels need a real TPU (and honor the
+        # BIGINT_NO_PALLAS kill-switch like every other plane router);
+        # everywhere else the same chain runs through the CPU-testable
+        # einsum delegation.
+        interpret = not _use_planes()
+
+    n_checks = len(checks)
+    if n_checks == 0:
+        return []
+
+    flat_pk, flat_sig, flat_coeff = [], [], []
+    offsets = []
+    for entries, _, _ in checks:
+        offsets.append(len(flat_pk))
+        for pk, sig, coeff in entries:
+            flat_pk.append(pk)
+            flat_sig.append(sig)
+            flat_coeff.append(coeff)
+    n = len(flat_pk)
+    # B > n always: index n is the canonical dead slot (live=False -> inf).
+    # The 1024-lane quantum only matters for the Pallas tiles; the
+    # CPU-testable mode keeps batches tiny.
+    q = _QUANTUM if not interpret else 8
+    b = (n // q + 1) * q
+    dead = n
+
+    max_groups = max(max((len(h) for _, h, _ in checks), default=1), 1)
+    m1 = _pow2(max_groups + 1) - 1  # groups per check; slot m1 is the sig pair
+    max_slot = 1
+    for entries, h_points, group_ids in checks:
+        counts = [0] * len(h_points)
+        for g in group_ids:
+            counts[g] += 1
+        if counts:
+            max_slot = max(max_slot, max(counts))
+    s = _pow2(max_slot)
+    e = _pow2(max((len(c[0]) for c in checks), default=1) or 1)
+
+    idx_g1 = np.full((n_checks, m1, s), dead, np.int32)
+    idx_sig = np.full((n_checks, e), dead, np.int32)
+    static_live = np.zeros((n_checks, m1 + 1), bool)
+    for ci, (entries, h_points, group_ids) in enumerate(checks):
+        fill = [0] * len(h_points)
+        for ei, g in enumerate(group_ids):
+            idx_g1[ci, g, fill[g]] = offsets[ci] + ei
+            fill[g] += 1
+        for ei in range(len(entries)):
+            idx_sig[ci, ei] = offsets[ci] + ei
+        static_live[ci, : len(h_points)] = [c > 0 for c in fill]
+        static_live[ci, m1] = len(entries) > 0
+
+    # Pack the hashed message points as (32, 2, C, m1); dead slots reuse
+    # the generator (masked out after the Miller loop).
+    h_points_padded = []
+    for ci, (_, h_points, _) in enumerate(checks):
+        row = list(h_points) + [C.G2_GENERATOR] * (m1 - len(h_points))
+        h_points_padded.extend(row)
+    hx, hy = _g2_planes(h_points_padded)
+    hx = hx.reshape(32, 2, n_checks, m1)
+    hy = hy.reshape(32, 2, n_checks, m1)
+
+    # Flat entry planes, padded with the generator at dead slots.
+    pad = b - n
+    pkx, pky = _g1_planes(flat_pk + [C.G1_GENERATOR] * pad)
+    sgx, sgy = _g2_planes(flat_sig + [C.G2_GENERATOR] * pad)
+    kbits = _scalar_bits_batch(flat_coeff + [1] * pad, coeff_bits).T
+    live = np.zeros(b, bool)
+    live[:n] = True
+
+    ops = _get_chain_ops(interpret)
+    jac1 = ops["ladder_g1"](
+        jnp.asarray(pkx), jnp.asarray(pky), jnp.asarray(kbits), jnp.asarray(live)
+    )
+    jac2 = ops["ladder_g2"](
+        jnp.asarray(sgx), jnp.asarray(sgy), jnp.asarray(kbits), jnp.asarray(live)
+    )
+    px, py, qx, qy, mask = ops["prep"](
+        jac1,
+        jac2,
+        jnp.asarray(idx_g1),
+        jnp.asarray(idx_sig),
+        jnp.asarray(hx),
+        jnp.asarray(hy),
+        jnp.asarray(static_live),
+    )
+    # miller preserves the (C, m) batch shape; the group axis is already
+    # innermost, exactly what check_tail's masked product reduces.
+    f = ops["miller"](px, py, qx, qy)
+    ok = ops["check_tail"](f, mask)
+    return [bool(v) for v in np.asarray(ok)]
+
+
+def aggregate_g1_chain(points_planes, interpret: bool | None = None):
+    """Tree-reduce G1 points on device: (32, ..., K) -> affine (32, ...).
+
+    The committee-aggregation stage (eth_fast_aggregate_verify's pubkey
+    sum, ref lib/bls.ex:7-50): K affine points per lane reduce to one
+    affine point with no host inversion.  Input planes must carry no
+    infinities (callers validate pubkeys); output lanes that reduce to
+    infinity come back as (0, 0).
+    """
+    if interpret is None:
+        interpret = not _use_planes()
+    ops = _get_chain_ops(interpret)
+    return ops["aggregate_g1"](*points_planes)

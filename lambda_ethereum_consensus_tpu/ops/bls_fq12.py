@@ -88,6 +88,12 @@ class _BatchLayout:
 
         return jnp.asarray(fq2_to_limbs(c))
 
+    def fq2_like(self, c, like):
+        """Fq2 constant broadcast to ``like``'s shape (any batch rank)."""
+        import jax.numpy as jnp
+
+        return jnp.broadcast_to(jnp.asarray(fq2_to_limbs(c)), like.shape)
+
     def one_fq12(self):
         one2 = np.stack([BI.to_limbs(1), np.zeros(BI.NLIMBS, np.int32)])
         one6 = np.stack([one2, np.zeros_like(one2), np.zeros_like(one2)])
@@ -144,8 +150,19 @@ class _PlaneLayout:
     def np_fq2(self, c):
         import jax.numpy as jnp
 
-        # (32, 2, 1): trailing singleton broadcasts over the batch
+        # (32, 2, 1): trailing singleton broadcasts over ONE batch axis
+        # (the Frobenius constants, applied after products collapse the
+        # group axis); multi-axis batches use fq2_like.
         return jnp.asarray(fq2_to_limbs(c).T[:, :, None])
+
+    def fq2_like(self, c, like):
+        """Fq2 constant broadcast to ``like`` (rank-safe for any number of
+        trailing batch axes — np_fq2's single trailing singleton is not)."""
+        import jax.numpy as jnp
+
+        arr = fq2_to_limbs(c).T  # (32, 2)
+        arr = arr.reshape(arr.shape + (1,) * (like.ndim - arr.ndim))
+        return jnp.broadcast_to(jnp.asarray(arr), like.shape)
 
     def one_fq12(self):
         one = np.zeros((BI.NLIMBS, 2, 3, 2), np.int32)
@@ -183,9 +200,14 @@ class _PlaneLayout:
     elem_axes = (0, 1, 2, 3)
 
 
-def make_fq12_ops(base=None, lay=None):
+def make_fq12_ops(base=None, lay=None, eager: bool = False):
     """Build the device tower ops dict over a base-field ops dict and a
-    layout adapter (defaults: einsum base ops, batch layout)."""
+    layout adapter (defaults: einsum base ops, batch layout).
+
+    ``eager=True``: run the Fermat-inversion exponent loop as host Python
+    instead of ``lax.scan`` (CPU-test mode — staging the 381-step scan
+    body is a heavyweight CPU compile; eager per-op dispatch is cheap).
+    """
     import jax.numpy as jnp
     from jax import lax
 
@@ -250,9 +272,21 @@ def make_fq12_ops(base=None, lay=None):
 
     # Batched Fermat inversion: a^(p-2) by square-and-multiply over the
     # static exponent bits (LSB-first scan).
-    _pm2_bits = jnp.asarray(_bits_lsb(F.P - 2))
+    _pm2_host_bits = _bits_lsb(F.P - 2)
+    _pm2_bits = jnp.asarray(_pm2_host_bits)
 
     def fp_inv(a):
+        if eager:
+            # static exponent: skip the zero-bit multiplies outright
+            result, pw = lay.fq_const(1, a), a
+            n = len(_pm2_host_bits)
+            for i, bit in enumerate(_pm2_host_bits):
+                if bit:
+                    result = mul(result, pw)
+                if i + 1 < n:
+                    pw = mul(pw, pw)
+            return result
+
         one = lay.fq_const(1, a)
 
         def body(carry, bit):
@@ -398,6 +432,24 @@ def make_fq12_ops(base=None, lay=None):
         target = fq12_one(lay.batch_shape(a))
         return jnp.all(a == target, axis=lay.elem_axes)
 
+    if eager:
+        # CPU-test mode granularity: one compiled program per Fq2 op —
+        # small enough to compile in seconds, big enough that the
+        # higher tower levels cost ~1 host dispatch per Fq2 op instead
+        # of ~8 per base op.  (Whole-Fq12 or step-level composites take
+        # minutes to compile on the CPU backend; per-base-op dispatch
+        # made the chain ~6x slower end to end.)
+        import jax
+
+        fq2_mul = jax.jit(fq2_mul)
+        fq2_sq = jax.jit(fq2_sq)
+        fq2_add = jax.jit(fq2_add)
+        fq2_sub = jax.jit(fq2_sub)
+        fq2_neg = jax.jit(fq2_neg)
+        fq2_conj = jax.jit(fq2_conj)
+        fq2_mul_by_xi = jax.jit(fq2_mul_by_xi)
+        fq2_scale_fp = jax.jit(fq2_scale_fp)
+
     return {
         "fq2_mul": fq2_mul,
         "fq2_sq": fq2_sq,
@@ -442,11 +494,17 @@ def get_fq12_ops():
 
 
 def get_fq12_plane_ops(interpret: bool = False):
-    """Plane-layout tower over the fused Pallas base kernels."""
+    """Plane-layout tower over the fused Pallas base kernels.
+
+    ``interpret=True`` is the CPU-test mode end to end: einsum-delegated
+    base ops and eager (scan-free) exponent loops.
+    """
     if interpret not in _FQ12_PLANE_OPS:
         from .bigint_pallas import make_plane_ops
 
         _FQ12_PLANE_OPS[interpret] = make_fq12_ops(
-            base=make_plane_ops(interpret=interpret), lay=_PlaneLayout()
+            base=make_plane_ops(interpret=interpret),
+            lay=_PlaneLayout(),
+            eager=interpret,
         )
     return _FQ12_PLANE_OPS[interpret]

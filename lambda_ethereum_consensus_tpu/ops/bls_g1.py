@@ -87,18 +87,16 @@ def _get_g1_ops(nbits: int):
     return _G1_OPS[nbits]
 
 
-def make_g1_plane_ops(nbits: int = SCALAR_BITS, interpret: bool = False):
-    """Plane-layout ladder: elements are ``(32, B)`` limb planes, batch
-    last, multiplication through the fused Pallas kernel
-    (:mod:`.bigint_pallas`) — no vmap; the batch IS the trailing axis."""
-    import jax
+def g1_plane_field(interpret: bool = False) -> dict:
+    """The plane-layout Fq field dict (elements ``(32, ...B)``, batch
+    trailing) consumed by :mod:`.ladder` — shared by the standalone plane
+    ladder below and the chained batch-verify pipeline (:mod:`.bls_batch`)."""
     import jax.numpy as jnp
 
     from .bigint_pallas import make_plane_ops
-    from .ladder import make_ladder
 
     ops = make_plane_ops(interpret=interpret)
-    field = {
+    return {
         "mul": ops["mul_mod"],
         "add": ops["add_mod"],
         "sub": ops["sub_mod"],
@@ -108,7 +106,18 @@ def make_g1_plane_ops(nbits: int = SCALAR_BITS, interpret: bool = False):
         "felt_ndim": 0,
         "flags": lambda bx: jnp.zeros(bx.shape[1:], jnp.bool_),
     }
-    ladder = make_ladder(field, nbits)
+
+
+def make_g1_plane_ops(nbits: int = SCALAR_BITS, interpret: bool = False):
+    """Plane-layout ladder: elements are ``(32, B)`` limb planes, batch
+    last, multiplication through the fused Pallas kernel
+    (:mod:`.bigint_pallas`) — no vmap; the batch IS the trailing axis."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ladder import make_ladder
+
+    ladder = make_ladder(g1_plane_field(interpret), nbits, eager=interpret)
 
     def packed(base_xy, bits):
         # one output array -> one device->host pull (each distinct array

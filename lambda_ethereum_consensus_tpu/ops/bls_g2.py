@@ -63,20 +63,17 @@ def _get_g2_ops(nbits: int):
     return _G2_OPS[nbits]
 
 
-def make_g2_plane_ops(nbits: int = SCALAR_BITS, interpret: bool = False):
-    """Plane-layout G2 ladder: Fq2 elements are ``(32, 2, B)`` limb
-    planes over the fused Pallas kernels — same field-generic ladder, no
-    vmap (the batch is the trailing axis)."""
-    import jax
+def g2_plane_field(interpret: bool = False) -> dict:
+    """Plane-layout Fq2 field dict (elements ``(32, 2, ...B)``) for
+    :mod:`.ladder` — shared by the plane ladder and :mod:`.bls_batch`."""
     import jax.numpy as jnp
 
     from .bls_fq12 import get_fq12_plane_ops
-    from .ladder import make_ladder
 
     fq = get_fq12_plane_ops(interpret)
     one = np.zeros((BI.NLIMBS, 2, 1), np.int32)
     one[:, 0, 0] = BI.to_limbs(1)
-    field = {
+    return {
         "mul": fq["fq2_mul"],
         "add": fq["fq2_add"],
         "sub": fq["fq2_sub"],
@@ -86,7 +83,18 @@ def make_g2_plane_ops(nbits: int = SCALAR_BITS, interpret: bool = False):
         "felt_ndim": 0,
         "flags": lambda bx: jnp.zeros(bx.shape[2:], jnp.bool_),
     }
-    ladder = make_ladder(field, nbits)
+
+
+def make_g2_plane_ops(nbits: int = SCALAR_BITS, interpret: bool = False):
+    """Plane-layout G2 ladder: Fq2 elements are ``(32, 2, B)`` limb
+    planes over the fused Pallas kernels — same field-generic ladder, no
+    vmap (the batch is the trailing axis)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ladder import make_ladder
+
+    ladder = make_ladder(g2_plane_field(interpret), nbits, eager=interpret)
 
     def packed(base_xy, bits):
         X, Y, Z, inf = ladder(base_xy, bits)

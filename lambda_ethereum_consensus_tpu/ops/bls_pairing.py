@@ -144,7 +144,19 @@ def make_pairing_ops(plane: bool = False, interpret: bool = False):
         coordinates qx/qy in the instantiation's layout; returns f."""
         f = ops["fq12_one"](lay.fq_batch_shape(px))
         X, Y = qx, qy
-        Z = jnp.broadcast_to(lay.np_fq2((1, 0)), qx.shape)
+        Z = lay.fq2_like((1, 0), qx)
+
+        if interpret:
+            # CPU-test mode: the loop bits are STATIC — unroll as host
+            # Python (no lax.cond/scan staging, no giant CPU compile;
+            # the tower ops dispatch small fq2-level jits), skipping the
+            # add step on zero bits entirely.
+            for bit in _X_BITS.tolist():
+                f = f12sq(f)
+                f, X, Y, Z = dbl_step(f, X, Y, Z, px, py)
+                if bit:
+                    f, X, Y, Z = add_step(f, X, Y, Z, qx, qy, px, py)
+            return f12conj(f)
 
         def body(carry, bit):
             f, X, Y, Z = carry
@@ -166,6 +178,13 @@ def make_pairing_ops(plane: bool = False, interpret: bool = False):
         """a^|x| by square-and-multiply over the static parameter bits.
         (Callers conjugate for the negative sign — on the cyclotomic
         subgroup, where every use of this lives.)"""
+        if interpret:
+            acc = a
+            for bit in _X_BITS.tolist():
+                acc = f12sq(acc)
+                if bit:
+                    acc = f12m(acc, a)
+            return acc
 
         def body(acc, bit):
             acc = f12sq(acc)
@@ -199,16 +218,21 @@ def make_pairing_ops(plane: bool = False, interpret: bool = False):
     # jitted pieces rather than jitted whole: the fully-unrolled chain is
     # a single XLA program big enough to exhaust compiler memory on the
     # CPU backend, while each piece here is at most one scan body deep.
+    # In interpret mode (CPU tests) the LOOP-carrying pieces (miller,
+    # pow_x_abs, easy_part via fp_inv, masked_product) stay host-composed
+    # — staging their loops is exactly the giant-compile failure mode —
+    # while the straight-line pieces still jit (one dispatch each).
+    wrap = (lambda f: f) if interpret else jax.jit
     jits = {
-        "miller": jax.jit(miller),
-        "pow_x_abs": jax.jit(pow_x_abs),
-        "easy_part": jax.jit(easy_part),
-        "masked_product": jax.jit(masked_product),
-        "mul": jax.jit(f12m),
-        "sq": jax.jit(f12sq),
-        "conj": jax.jit(f12conj),
-        "frob": jax.jit(f12frob),
-        "is_one": jax.jit(ops["fq12_is_one"]),
+        "miller": wrap(miller),
+        "pow_x_abs": wrap(pow_x_abs),
+        "easy_part": wrap(easy_part),
+        "masked_product": wrap(masked_product),
+        "mul": wrap(f12m),
+        "sq": wrap(f12sq),
+        "conj": wrap(f12conj),
+        "frob": wrap(f12frob),
+        "is_one": wrap(ops["fq12_is_one"]),
     }
 
     def pow_x(a):
@@ -244,10 +268,11 @@ def make_pairing_ops(plane: bool = False, interpret: bool = False):
 _OPS: dict = {}
 
 
-def _get_ops(plane: bool = False):
-    if plane not in _OPS:
-        _OPS[plane] = make_pairing_ops(plane)
-    return _OPS[plane]
+def _get_ops(plane: bool = False, interpret: bool = False):
+    key = (plane, interpret)
+    if key not in _OPS:
+        _OPS[key] = make_pairing_ops(plane, interpret)
+    return _OPS[key]
 
 
 def _pow2_pad(n: int) -> int:

@@ -11,19 +11,33 @@ groups and jits/vmaps cleanly.
 from __future__ import annotations
 
 
-def make_ladder(field, scalar_bits: int):
+def make_ladder(field, scalar_bits: int, eager: bool = False):
+    """Backward-compatible wrapper: the ladder from :func:`make_jacobian_ops`."""
+    return make_jacobian_ops(field, scalar_bits, eager)["ladder"]
+
+
+def make_jacobian_ops(field, scalar_bits: int = 0, eager: bool = False):
     """``field``: dict with ``mul/add/sub`` (jitted, batched), ``one``,
     ``zero`` (unbatched element constants), ``eq(a, b) -> bool mask`` and
     ``felt_ndim`` (trailing axes per element: 1 for Fq, 2 for Fq2).
 
-    Returns ``ladder(base_xy, bits)`` mapping an affine base (limb form) and
-    an MSB-first bit vector to the Jacobian ``(X, Y, Z, inf)`` result.
+    Returns ``{"jac_add", "jac_double", "ladder"}``: complete branch-free
+    Jacobian point ops over ``(x, y, z, inf)`` tuples, plus the
+    double-and-add ladder ``ladder(base_xy, bits)`` mapping an affine base
+    (limb form) and an MSB-first bit vector to the Jacobian result.  The
+    standalone ``jac_add`` is what the chained batch-verify pipeline's
+    tree reductions (group sums, aggregate pubkeys) consume.
 
     Layout-generic: the vmapped batch-leading stack uses scalar infinity
     flags and per-element bit vectors; the plane (batch-last) stack passes
     ``flags`` in the field dict to get (B,)-shaped flags and scans bit
     ROWS — the point formulas are identical because every select
     broadcasts against trailing element axes.
+
+    ``eager=True`` runs the ladder as a host Python loop of per-op
+    dispatches instead of ``lax.scan`` — the CPU-test mode, where staging
+    the scan body would compile a giant XLA program (round 1's 17 GB CPU
+    compiles) while eager dispatch of the small per-op jits is cheap.
     """
     import jax.numpy as jnp
     from jax import lax
@@ -101,6 +115,17 @@ def make_ladder(field, scalar_bits: int):
         out_inf = jnp.where(inf1, inf2, jnp.where(inf2, inf1, out_inf))
         return (out_x, out_y, out_z, out_inf)
 
+    def _step(acc, bit, base):
+        acc = jac_double(acc)
+        added = jac_add(acc, base)
+        take = bit.astype(jnp.bool_)
+        return (
+            jnp.where(expand(take), added[0], acc[0]),
+            jnp.where(expand(take), added[1], acc[1]),
+            jnp.where(expand(take), added[2], acc[2]),
+            jnp.where(take, added[3], acc[3]),
+        )
+
     def ladder(base_xy, bits):
         bx, by = base_xy
         inf0 = flags0(bx)
@@ -112,19 +137,17 @@ def make_ladder(field, scalar_bits: int):
             jnp.ones_like(inf0),
         )
 
-        def step(acc, bit):
-            acc = jac_double(acc)
-            added = jac_add(acc, base)
-            take = bit.astype(jnp.bool_)
-            out = (
-                jnp.where(expand(take), added[0], acc[0]),
-                jnp.where(expand(take), added[1], acc[1]),
-                jnp.where(expand(take), added[2], acc[2]),
-                jnp.where(take, added[3], acc[3]),
-            )
-            return out, None
+        if eager:
+            # host loop, per-op dispatch of the field's (jitted) ops —
+            # staging the scan body is the giant-CPU-compile failure mode
+            for i in range(bits.shape[0]):
+                acc = _step(acc, bits[i], base)
+            return acc
+
+        def step(carry, bit):
+            return _step(carry, bit, base), None
 
         acc, _ = lax.scan(step, acc, bits)
         return acc
 
-    return ladder
+    return {"jac_add": jac_add, "jac_double": jac_double, "ladder": ladder}

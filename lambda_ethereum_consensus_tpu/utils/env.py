@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["env_flag"]
+__all__ = ["env_flag", "device_default"]
 
 
 def env_flag(name: str) -> bool:
@@ -14,3 +14,32 @@ def env_flag(name: str) -> bool:
     return os.environ.get(name, "").strip().lower() not in (
         "", "0", "false", "no", "off",
     )
+
+
+_DEVICE_DEFAULT: bool | None = None
+
+
+def device_default() -> bool:
+    """Device crypto routing polarity: ON by default on a TPU host, off
+    elsewhere; ``BLS_NO_DEVICE=1`` opts out, per-path flags
+    (``BLS_DEVICE_MSM=1`` etc.) still force-enable on any backend.
+
+    A node started on TPU hardware dispatches its hot paths to the chip
+    with no configuration — the TPU is the engine, not a sidecar.
+
+    Memoized, and CPU-pinned processes (``JAX_PLATFORMS`` without tpu)
+    short-circuit without ever importing jax — a pure-host node must not
+    pay XLA backend init inside its verification path.
+    """
+    global _DEVICE_DEFAULT
+    if env_flag("BLS_NO_DEVICE"):
+        return False
+    if _DEVICE_DEFAULT is None:
+        platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+        if platforms and "tpu" not in platforms:
+            _DEVICE_DEFAULT = False
+        else:
+            import jax
+
+            _DEVICE_DEFAULT = jax.default_backend() == "tpu"
+    return _DEVICE_DEFAULT

@@ -28,7 +28,10 @@ def _rand_elems(n):
 
 @pytest.fixture(scope="module")
 def plane_ops():
-    return BP.make_plane_ops(interpret=True)
+    # True Pallas interpret mode: this fixture exists to cover the KERNEL
+    # statements on CPU (interpret=True alone now delegates to the einsum
+    # path for speed — see make_plane_ops).
+    return BP.make_plane_ops(pallas_interpret=True)
 
 
 def _planes(xs):
