@@ -60,9 +60,11 @@ def _scale_entries(entries, coeffs):
     default on TPU hosts (``BLS_NO_DEVICE`` opts out); ``BLS_DEVICE_MSM=1``
     force-enables elsewhere."""
     threshold = int(os.environ.get("BLS_DEVICE_MSM_MIN", "256"))
-    if (env_flag("BLS_DEVICE_MSM") or device_default()) and len(
-        entries
-    ) >= threshold:
+    # size gate FIRST: small batches must not pay device_default()'s
+    # one-time jax import on non-TPU hosts
+    if len(entries) >= threshold and (
+        env_flag("BLS_DEVICE_MSM") or device_default()
+    ):
         from ...ops.bls_g1 import batch_g1_mul
         from ...ops.bls_g2 import batch_g2_mul
 

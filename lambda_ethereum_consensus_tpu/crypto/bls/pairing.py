@@ -159,9 +159,11 @@ def _device_pairing_enabled(n: int) -> bool:
     elsewhere."""
     from ...utils.env import device_default
 
-    if not (env_flag("BLS_DEVICE_PAIRING") or device_default()):
+    # size gate FIRST: small checks must not pay device_default()'s
+    # one-time jax import on non-TPU hosts
+    if n < int(os.environ.get("BLS_DEVICE_PAIRING_MIN", "32")):
         return False
-    return n >= int(os.environ.get("BLS_DEVICE_PAIRING_MIN", "32"))
+    return env_flag("BLS_DEVICE_PAIRING") or device_default()
 
 
 def pairing_check(pairs: list[tuple[AffinePoint, AffinePoint]]) -> bool:

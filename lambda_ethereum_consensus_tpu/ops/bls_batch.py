@@ -34,13 +34,17 @@ import numpy as np
 from ..crypto.bls import curve as C
 from ..crypto.bls.batch import _COEFF_BITS  # single soundness-width source
 from . import bigint as BI
-from .bls_g1 import _limbs_batch, _scalar_bits_batch, _use_planes, g1_plane_field
+from .bls_g1 import (
+    _limbs_batch,
+    _PLANE_QUANTUM as _QUANTUM,
+    _scalar_bits_batch,
+    _use_planes,
+    g1_plane_field,
+)
 from .bls_g2 import fq2_limbs_batch, g2_plane_field
 from .bls_pairing import _pow2_pad as _pow2
 
 __all__ = ["chain_verify", "aggregate_g1_chain"]
-
-_QUANTUM = 1024  # plane kernel tile quantum (sublanes x lanes)
 
 
 def _g1_planes(points) -> tuple[np.ndarray, np.ndarray]:
@@ -72,8 +76,8 @@ def make_chain_ops(interpret: bool = False):
     fq = get_fq12_plane_ops(interpret)
     g1f = g1_plane_field(interpret)
     g2f = g2_plane_field(interpret)
-    g1j = make_jacobian_ops(g1f, _COEFF_BITS, eager=interpret)
-    g2j = make_jacobian_ops(g2f, _COEFF_BITS, eager=interpret)
+    g1j = make_jacobian_ops(g1f, eager=interpret)
+    g2j = make_jacobian_ops(g2f, eager=interpret)
     pairing = get_pairing_ops(plane=True, interpret=interpret)
     wrap = (lambda f: f) if interpret else jax.jit
 
@@ -153,7 +157,19 @@ def make_chain_ops(interpret: bool = False):
         return px, py, qx, qy, mask
 
     def aggregate_g1(bx, by):
-        inf = jnp.zeros(bx.shape[1:], jnp.bool_)
+        # pad the reduce axis to a power of two with infinity entries —
+        # _tree_reduce's pairwise halving would silently broadcast (and
+        # double-count) an odd split otherwise
+        k = bx.shape[-1]
+        kp = _pow2(k)
+        pad = [(0, 0)] * (bx.ndim - 1) + [(0, kp - k)]
+        bx = jnp.pad(bx, pad)
+        by = jnp.pad(by, pad)
+        inf = jnp.pad(
+            jnp.zeros(bx.shape[1:-1] + (k,), jnp.bool_),
+            [(0, 0)] * (bx.ndim - 2) + [(0, kp - k)],
+            constant_values=True,
+        )
         z = jnp.broadcast_to(
             jnp.asarray(BI.to_limbs(1)).reshape(32, *([1] * (bx.ndim - 1))),
             bx.shape,

@@ -11,12 +11,17 @@ groups and jits/vmaps cleanly.
 from __future__ import annotations
 
 
-def make_ladder(field, scalar_bits: int, eager: bool = False):
-    """Backward-compatible wrapper: the ladder from :func:`make_jacobian_ops`."""
-    return make_jacobian_ops(field, scalar_bits, eager)["ladder"]
+def make_ladder(field, scalar_bits: int = 0, eager: bool = False):
+    """Backward-compatible wrapper: the ladder from :func:`make_jacobian_ops`.
+
+    ``scalar_bits`` is informational only — the ladder's step count comes
+    from the bit array it is given at call time.
+    """
+    del scalar_bits
+    return make_jacobian_ops(field, eager)["ladder"]
 
 
-def make_jacobian_ops(field, scalar_bits: int = 0, eager: bool = False):
+def make_jacobian_ops(field, eager: bool = False):
     """``field``: dict with ``mul/add/sub`` (jitted, batched), ``one``,
     ``zero`` (unbatched element constants), ``eq(a, b) -> bool mask`` and
     ``felt_ndim`` (trailing axes per element: 1 for Fq, 2 for Fq2).

@@ -57,10 +57,11 @@ def test_chain_verify_valid_invalid_empty(hs):
     assert res == [True, False, True, True]
 
 
-def test_aggregate_g1_chain_matches_host_sum():
+@pytest.mark.parametrize("k", [8, 3])  # k=3: non-pow2 pads with infinity
+def test_aggregate_g1_chain_matches_host_sum(k):
     pts = [
         C.g1.multiply_raw(C.G1_GENERATOR, secrets.randbits(96) | 1)
-        for _ in range(8)
+        for _ in range(k)
     ]
     expect = None
     for p in pts:
@@ -68,7 +69,7 @@ def test_aggregate_g1_chain_matches_host_sum():
 
     px, py = BB._g1_planes(pts)
     ax, ay = BB.aggregate_g1_chain(
-        (px.reshape(32, 1, 8), py.reshape(32, 1, 8)), interpret=True
+        (px.reshape(32, 1, k), py.reshape(32, 1, k)), interpret=True
     )
     from lambda_ethereum_consensus_tpu.ops.bls_g1 import _ints_batch
 
