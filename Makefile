@@ -12,8 +12,16 @@ all: native
 native:
 	$(MAKE) -C native
 
+# Fast default lane (consensus, network, crypto-host, ssz, spec vectors
+# kept out): target < 5 min on one core.
 test: native
-	python -m pytest tests/ -q -m "not spectest"
+	python -m pytest tests/ -q -m "not spectest and not device"
+
+# Device-kernel lane: plane/einsum stacks with multi-minute XLA compiles
+# (ladders, pairing, chained verify).  Uses the persistent compile cache
+# in .jax_cache, so the first run pays the compiles and later runs don't.
+test-device: native
+	python -m pytest tests/ -q -m "device"
 
 # Opt-in heavy lane: multi-GB / multi-minute XLA CPU compiles of the
 # einsum-stack device pairing oracle tests (see test_device_pairing.py).

@@ -68,6 +68,8 @@ class BeaconNode:
         self._tasks: list[asyncio.Task] = []
         self._subs: list[TopicSubscription] = []
         self._stopping = False
+        self.device_backend = None
+        self._prev_hash_backend = None
 
     # ------------------------------------------------------------- startup
 
@@ -127,10 +129,11 @@ class BeaconNode:
         be opt-in sidecars to the product."""
         from ..utils.env import device_default
 
-        self.device_backend = None
         if device_default():
             from ..ops.sha256 import install_device_backend
+            from ..ssz.hash import get_hash_backend
 
+            self._prev_hash_backend = get_hash_backend()
             self.device_backend = install_device_backend()
             log.info("device paths ON: SSZ hashing + BLS routed to the TPU")
 
@@ -306,6 +309,13 @@ class BeaconNode:
 
     async def stop(self) -> None:
         self._stopping = True
+        if self.device_backend is not None:
+            # restore the process-global SSZ hash backend a start() on a
+            # TPU host swapped in (multi-node-lifecycle processes, tests)
+            from ..ssz.hash import set_hash_backend
+
+            set_hash_backend(self._prev_hash_backend)
+            self.device_backend = None
         for sub in self._subs:
             try:
                 await sub.stop()

@@ -156,20 +156,9 @@ def make_chain_ops(interpret: bool = False):
         mask = static_live & ~inf_all
         return px, py, qx, qy, mask
 
-    def aggregate_g1(bx, by):
-        # pad the reduce axis to a power of two with infinity entries —
-        # _tree_reduce's pairwise halving would silently broadcast (and
-        # double-count) an odd split otherwise
-        k = bx.shape[-1]
-        kp = _pow2(k)
-        pad = [(0, 0)] * (bx.ndim - 1) + [(0, kp - k)]
-        bx = jnp.pad(bx, pad)
-        by = jnp.pad(by, pad)
-        inf = jnp.pad(
-            jnp.zeros(bx.shape[1:-1] + (k,), jnp.bool_),
-            [(0, 0)] * (bx.ndim - 2) + [(0, kp - k)],
-            constant_values=True,
-        )
+    def aggregate_g1(bx, by, inf):
+        # operands arrive pow2-padded along the reduce axis (host side:
+        # aggregate_g1_chain) so the jit cache is keyed on padded shapes
         z = jnp.broadcast_to(
             jnp.asarray(BI.to_limbs(1)).reshape(32, *([1] * (bx.ndim - 1))),
             bx.shape,
@@ -323,8 +312,22 @@ def aggregate_g1_chain(points_planes, interpret: bool | None = None):
     affine point with no host inversion.  Input planes must carry no
     infinities (callers validate pubkeys); output lanes that reduce to
     infinity come back as (0, 0).
+
+    The reduce axis is pow2-padded HERE (host side, with infinity
+    entries) so that all K in (kp/2, kp] share one compiled program —
+    _tree_reduce's pairwise halving would silently double-count an odd
+    split, and padding inside the jit would key the compile cache on
+    every distinct raw K.
     """
     if interpret is None:
         interpret = not _use_planes()
+    bx, by = points_planes
+    k = bx.shape[-1]
+    kp = _pow2(k)
+    pad = [(0, 0)] * (bx.ndim - 1) + [(0, kp - k)]
+    bx = np.pad(np.asarray(bx), pad)
+    by = np.pad(np.asarray(by), pad)
+    inf = np.zeros(bx.shape[1:], bool)
+    inf[..., k:] = True
     ops = _get_chain_ops(interpret)
-    return ops["aggregate_g1"](*points_planes)
+    return ops["aggregate_g1"](bx, by, inf)

@@ -13,6 +13,9 @@ import pytest
 from lambda_ethereum_consensus_tpu.crypto.bls.fields import P
 from lambda_ethereum_consensus_tpu.ops import bigint_pallas as BP
 
+# heavy XLA/kernel compiles: run in the `make test-device` lane
+pytestmark = pytest.mark.device
+
 RNG = random.Random(91)
 B_TILE = BP.SUBLANES * BP.LANES  # one grid tile
 

@@ -40,6 +40,7 @@ def _mk_check(hs, n=4, n_msgs=2, bad_index=None):
     return (entries, hs[:n_msgs], gids)
 
 
+@pytest.mark.device
 def test_chain_verify_valid_invalid_empty(hs):
     # one device chain, four checks batched on the C axis (incl. the
     # empty check: vacuously true, same as verify_points([])); 32-bit
@@ -57,6 +58,7 @@ def test_chain_verify_valid_invalid_empty(hs):
     assert res == [True, False, True, True]
 
 
+@pytest.mark.device
 @pytest.mark.parametrize("k", [8, 3])  # k=3: non-pow2 pads with infinity
 def test_aggregate_g1_chain_matches_host_sum(k):
     pts = [

@@ -8,6 +8,9 @@ import pytest
 from lambda_ethereum_consensus_tpu.crypto.bls.fields import P
 from lambda_ethereum_consensus_tpu.ops import bigint as BI
 
+# heavy XLA/kernel compiles: run in the `make test-device` lane
+pytestmark = pytest.mark.device
+
 RNG = random.Random(7)
 
 

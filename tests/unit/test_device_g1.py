@@ -8,6 +8,9 @@ from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
 from lambda_ethereum_consensus_tpu.crypto.bls.fields import R
 from lambda_ethereum_consensus_tpu.ops.bls_g1 import batch_g1_mul
 
+# heavy XLA/kernel compiles: run in the `make test-device` lane
+pytestmark = pytest.mark.device
+
 RNG = random.Random(31)
 
 

@@ -2,9 +2,14 @@
 
 import random
 
+import pytest
+
 from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
 from lambda_ethereum_consensus_tpu.crypto.bls.fields import R
 from lambda_ethereum_consensus_tpu.ops.bls_g2 import batch_g2_mul
+
+# heavy XLA/kernel compiles: run in the `make test-device` lane
+pytestmark = pytest.mark.device
 
 RNG = random.Random(67)
 

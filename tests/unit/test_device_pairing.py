@@ -28,6 +28,9 @@ from lambda_ethereum_consensus_tpu.crypto.bls import fields as F
 from lambda_ethereum_consensus_tpu.ops import bls_fq12 as FQ
 from lambda_ethereum_consensus_tpu.ops import bls_pairing as DP
 
+# heavy XLA/kernel compiles: run in the `make test-device` lane
+pytestmark = pytest.mark.device
+
 RNG = random.Random(71)
 
 

@@ -49,6 +49,23 @@ def test_node_skips_device_backend_off_tpu(monkeypatch):
     assert node.device_backend is None
 
 
+def test_stop_restores_process_global_hash_backend(monkeypatch):
+    import asyncio
+
+    from lambda_ethereum_consensus_tpu.ssz.hash import get_hash_backend
+
+    monkeypatch.setattr(
+        "lambda_ethereum_consensus_tpu.utils.env.device_default", lambda: True
+    )
+    before = get_hash_backend()
+    node = _node()
+    node._install_device_paths()
+    assert get_hash_backend() is node.device_backend
+    asyncio.get_event_loop_policy().new_event_loop().run_until_complete(node.stop())
+    assert get_hash_backend() is before
+    assert node.device_backend is None
+
+
 def test_bls_no_device_opts_out(monkeypatch):
     monkeypatch.setenv("BLS_NO_DEVICE", "1")
     assert env_mod.device_default() is False

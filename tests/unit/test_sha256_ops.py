@@ -1,6 +1,7 @@
 """Batched SHA-256 kernels vs the hashlib oracle."""
 
 import hashlib
+import os
 
 import numpy as np
 import pytest
@@ -52,6 +53,14 @@ def test_device_merkle_tree_matches_host(count, limit):
     assert device == host
 
 
+@pytest.mark.device
+@pytest.mark.skipif(
+    not os.environ.get("SHA_PALLAS_INTERPRET"),
+    reason="interpret-mode tracing of the unrolled 64-round kernel needs "
+    ">17 GB and tens of minutes (round-1 default-lane killer); the kernel "
+    "is oracle-checked on real hardware by bench.py — opt in with "
+    "SHA_PALLAS_INTERPRET=1",
+)
 def test_pallas_kernel_interpret_mode():
     rng = np.random.default_rng(0)
     n = 64
