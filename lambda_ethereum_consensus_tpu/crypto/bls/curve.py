@@ -285,6 +285,14 @@ def _split_flags(data: bytes, size: int) -> tuple[int, bool, bool]:
 
 
 def g1_from_bytes(data: bytes, subgroup_check: bool = True) -> AffinePoint:
+    """Decompress a G1 point.
+
+    ``subgroup_check=False`` skips the prime-order check and is ONLY safe
+    for points that never reach a pairing: the branch-free device pairing
+    route assumes prime-order inputs (small-order points yield silently
+    wrong results there, unlike the host loop).  See pairing.pairing_check
+    and the BLS_DEBUG_SUBGROUP probe.
+    """
     top, infinity, sign = _split_flags(data, 48)
     body = bytes([top]) + data[1:]
     if infinity:
@@ -307,6 +315,11 @@ def g1_from_bytes(data: bytes, subgroup_check: bool = True) -> AffinePoint:
 
 
 def g2_from_bytes(data: bytes, subgroup_check: bool = True) -> AffinePoint:
+    """Decompress a G2 point (twist coordinates).
+
+    ``subgroup_check=False`` is ONLY safe for points that never reach a
+    pairing — see :func:`g1_from_bytes`.
+    """
     top, infinity, sign = _split_flags(data, 96)
     body = bytes([top]) + data[1:]
     if infinity:

@@ -183,6 +183,15 @@ def pairing_check(pairs: list[tuple[AffinePoint, AffinePoint]]) -> bool:
     if not live:
         return True
     if _device_pairing_enabled(len(live)):
+        if env_flag("BLS_DEBUG_SUBGROUP"):
+            # The branch-free device formulas assume prime-order inputs
+            # (a small-order point would yield silently-undefined math,
+            # not a loud failure like the host loop's vertical-line
+            # handling).  Callers must decode with subgroup_check on —
+            # this opt-in probe catches a caller that didn't (ADVICE r1).
+            assert all(
+                g1.in_subgroup(p) and g2.in_subgroup(q) for p, q in live
+            ), "device pairing requires subgroup-checked points"
         from ...ops.bls_pairing import pairing_product_is_one
 
         return pairing_product_is_one(live)

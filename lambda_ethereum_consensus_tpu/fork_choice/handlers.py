@@ -59,6 +59,8 @@ def update_checkpoints(
         store.justified_checkpoint = justified
     if finalized.epoch > store.finalized_checkpoint.epoch:
         store.finalized_checkpoint = finalized
+        if store.head_cache is not None:
+            store.head_cache.prune(bytes(finalized.root))
 
 
 def update_unrealized_checkpoints(
@@ -203,12 +205,24 @@ def update_latest_messages(
     non_equivocating = [
         i for i in attesting_indices if i not in store.equivocating_indices
     ]
+    cache = store.head_cache
+    target_state = (
+        store.checkpoint_states.get(checkpoint_key(target))
+        if cache is not None
+        else None
+    )
     for i in non_equivocating:
         prev = store.latest_messages.get(i)
         if prev is None or target.epoch > prev.epoch:
             store.latest_messages[i] = LatestMessage(
                 epoch=int(target.epoch), root=beacon_block_root
             )
+            if cache is not None and target_state is not None:
+                cache.on_vote(
+                    i,
+                    beacon_block_root,
+                    int(target_state.validators[i].effective_balance),
+                )
 
 
 def _prepare_attestation(
@@ -318,6 +332,8 @@ def on_attester_slashing(
     state = store.block_states[bytes(store.justified_checkpoint.root)]
     expect(is_valid_indexed_attestation(state, att1, spec), "attestation 1 invalid")
     expect(is_valid_indexed_attestation(state, att2, spec), "attestation 2 invalid")
-    store.equivocating_indices.update(
-        set(att1.attesting_indices) & set(att2.attesting_indices)
-    )
+    equivocators = set(att1.attesting_indices) & set(att2.attesting_indices)
+    store.equivocating_indices.update(equivocators)
+    if store.head_cache is not None:
+        for i in equivocators:
+            store.head_cache.on_equivocation(i)

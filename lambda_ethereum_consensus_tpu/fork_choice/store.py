@@ -13,6 +13,7 @@ from ..config import ChainSpec, constants, get_chain_spec
 from ..state_transition import accessors, misc
 from ..state_transition.errors import SpecError
 from ..types.beacon import BeaconBlock, BeaconState, Checkpoint
+from .tree import HeadCache
 
 
 class ForkChoiceError(SpecError):
@@ -42,6 +43,9 @@ class Store:
     unrealized_justifications: dict[bytes, Checkpoint] = field(default_factory=dict)
     # children index maintained on insert so head walks are O(tree) not O(blocks^2)
     children: dict[bytes, list[bytes]] = field(default_factory=dict)
+    # O(1) cached-head tree, streamed by the handlers (see tree.HeadCache);
+    # None only for hand-built test stores
+    head_cache: HeadCache | None = None
 
     # ---------------------------------------------------------- time helpers
     def current_slot(self, spec: ChainSpec | None = None) -> int:
@@ -82,6 +86,8 @@ class Store:
         self.blocks[root] = block
         self.block_states[root] = state
         self.children.setdefault(bytes(block.parent_root), []).append(root)
+        if self.head_cache is not None:
+            self.head_cache.on_block(root, bytes(block.parent_root))
 
 
 def checkpoint_key(checkpoint: Checkpoint) -> tuple[int, bytes]:
@@ -121,4 +127,5 @@ def get_forkchoice_store(
     store.block_states[anchor_root] = anchor_state
     store.checkpoint_states[checkpoint_key(justified)] = anchor_state
     store.unrealized_justifications[anchor_root] = justified
+    store.head_cache = HeadCache(anchor_root)
     return store

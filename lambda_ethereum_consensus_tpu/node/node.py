@@ -286,6 +286,19 @@ class BeaconNode:
             await asyncio.sleep(1.0 - (now % 1.0))
             try:
                 on_tick(self.store, int(time.time()), self.spec)
+                if self.store.head_cache is not None:
+                    # O(1) cached head for the per-tick gauge — the full
+                    # LMD-GHOST get_head stays on the consensus-critical
+                    # paths (chain view, API, production)
+                    head = self.store.head_cache.head()
+                    head_block = self.store.blocks.get(head)
+                    if head_block is not None:
+                        # own gauge: sync_store_slot belongs to _on_applied
+                        # (per-applied-block); mixing writers would make
+                        # the sync panel flap between fork heads
+                        self.metrics.set_gauge(
+                            "fork_choice_head_slot", int(head_block.slot)
+                        )
             except Exception:
                 log.exception("tick failed")
 

@@ -89,15 +89,6 @@ def make_chain_ops(interpret: bool = False):
         X, Y, Z, inf = g2j["ladder"]((bx, by), kbits)
         return X, Y, Z, inf | ~live
 
-    def _tree_reduce(jac, pt):
-        """Reduce the trailing axis (a power of two) by pairwise jac_add."""
-        X, Y, Z, inf = pt
-        while X.shape[-1] > 1:
-            a = (X[..., ::2], Y[..., ::2], Z[..., ::2], inf[..., ::2])
-            b = (X[..., 1::2], Y[..., 1::2], Z[..., 1::2], inf[..., 1::2])
-            X, Y, Z, inf = jac["jac_add"](a, b)
-        return X[..., 0], Y[..., 0], Z[..., 0], inf[..., 0]
-
     def _norm_g1(X, Y, Z):
         """Jacobian -> affine via batched Fermat inversion (z=0 -> (0,0))."""
         zi = fq["fp_inv"](Z)
@@ -113,6 +104,24 @@ def make_chain_ops(interpret: bool = False):
     _ng = C.g1.affine_neg(C.G1_GENERATOR)
     neg_g1_x = jnp.asarray(BI.to_limbs(_ng[0])[:, None, None])  # (32,1,1)
     neg_g1_y = jnp.asarray(BI.to_limbs(_ng[1])[:, None, None])
+
+    # prep is HOST-COMPOSED from small jitted pieces rather than jitted
+    # whole: its unrolled reduction levels + the Fermat scans in one XLA
+    # program took >25 min to compile on the TPU backend, while each
+    # piece below compiles in seconds and every intermediate stays on
+    # device (no host pulls — the chain property that matters).
+    jadd1 = wrap(g1j["jac_add"])
+    jadd2 = wrap(g2j["jac_add"])
+    norm_g1_j = wrap(_norm_g1)
+    norm_g2_j = wrap(_norm_g2)
+
+    def _tree_reduce_j(jadd, pt):
+        X, Y, Z, inf = pt
+        while X.shape[-1] > 1:
+            a = (X[..., ::2], Y[..., ::2], Z[..., ::2], inf[..., ::2])
+            b = (X[..., 1::2], Y[..., 1::2], Z[..., 1::2], inf[..., 1::2])
+            X, Y, Z, inf = jadd(a, b)
+        return X[..., 0], Y[..., 0], Z[..., 0], inf[..., 0]
 
     def prep(jac1, jac2, idx_g1, idx_sig, h_x, h_y, static_live):
         """Gather + reduce + normalize + pack the Miller batch.
@@ -132,8 +141,8 @@ def make_chain_ops(interpret: bool = False):
             jnp.take(Z, idx_g1.reshape(-1), axis=1).reshape(-1, c, m1, s),
             jnp.take(inf, idx_g1.reshape(-1), axis=0).reshape(c, m1, s),
         )
-        gX, gY, gZ, ginf = _tree_reduce(g1j, g)  # (32, c, m1), (c, m1)
-        px_g, py_g = _norm_g1(gX, gY, gZ)
+        gX, gY, gZ, ginf = _tree_reduce_j(jadd1, g)  # (32, c, m1), (c, m1)
+        px_g, py_g = norm_g1_j(gX, gY, gZ)
 
         X2, Y2, Z2, inf2 = jac2
         e = idx_sig.shape[1]
@@ -143,8 +152,8 @@ def make_chain_ops(interpret: bool = False):
             jnp.take(Z2, idx_sig.reshape(-1), axis=2).reshape(-1, 2, c, e),
             jnp.take(inf2, idx_sig.reshape(-1), axis=0).reshape(c, e),
         )
-        sX, sY, sZ, sinf = _tree_reduce(g2j, s2)  # (32, 2, c), (c,)
-        qx_s, qy_s = _norm_g2(sX, sY, sZ)
+        sX, sY, sZ, sinf = _tree_reduce_j(jadd2, s2)  # (32, 2, c), (c,)
+        qx_s, qy_s = norm_g2_j(sX, sY, sZ)
 
         # Pack the (c, m) Miller batch: groups in slots 0..m1-1, the
         # signature pair last.
@@ -158,22 +167,26 @@ def make_chain_ops(interpret: bool = False):
 
     def aggregate_g1(bx, by, inf):
         # operands arrive pow2-padded along the reduce axis (host side:
-        # aggregate_g1_chain) so the jit cache is keyed on padded shapes
+        # aggregate_g1_chain) so the jit cache is keyed on padded shapes;
+        # host-composed per level like prep (one giant jit of the
+        # unrolled reduction is the >25-min-compile failure mode)
+        bx, by, inf = jnp.asarray(bx), jnp.asarray(by), jnp.asarray(inf)
         z = jnp.broadcast_to(
             jnp.asarray(BI.to_limbs(1)).reshape(32, *([1] * (bx.ndim - 1))),
             bx.shape,
         )
-        X, Y, Z, _ = _tree_reduce(g1j, (bx, by, z, inf))
-        return _norm_g1(X, Y, Z)
+        X, Y, Z, _ = _tree_reduce_j(jadd1, (bx, by, z, inf))
+        return norm_g1_j(X, Y, Z)
 
     return {
         "ladder_g1": wrap(ladder_g1),
         "ladder_g2": wrap(ladder_g2),
-        "prep": wrap(prep),
-        "aggregate_g1": wrap(aggregate_g1),
+        # host-composed (see comment above prep) — pieces are jitted
+        "prep": prep,
+        "aggregate_g1": aggregate_g1,
         "miller": pairing["miller"],
         "check_tail": pairing["check_tail"],
-        "tree_reduce": _tree_reduce,
+        "tree_reduce": _tree_reduce_j,
         "norm_g1": _norm_g1,
         "g1j": g1j,
         "g2j": g2j,

@@ -182,17 +182,15 @@ def batch_g2_mul(
             np.asarray(inf),
         )
 
-    conv = {
-        id(arr): (_ints_batch(arr[:, 0]), _ints_batch(arr[:, 1]))
-        for arr in (X, Y, Z)
-    }
-
-    def fq2_of(arr, i):
-        c0, c1 = conv[id(arr)]
-        return (c0[i], c1[i])
+    # one limb->int conversion per coordinate array, held in named
+    # variables (an id()-keyed dict would silently depend on object
+    # lifetimes — ADVICE r1)
+    xs_c = (_ints_batch(X[:, 0]), _ints_batch(X[:, 1]))
+    ys_c = (_ints_batch(Y[:, 0]), _ints_batch(Y[:, 1]))
+    zs_c = (_ints_batch(Z[:, 0]), _ints_batch(Z[:, 1]))
 
     live = [i for i in range(len(points)) if not bool(inf[i])]
-    zs = {i: fq2_of(Z, i) for i in live}
+    zs = {i: (zs_c[0][i], zs_c[1][i]) for i in live}
     # Fq2 inverse via conjugate / Fp norm; all norms inverted with one
     # modexp (batch_inv_mod, shared with batch_g1_mul)
     from .bls_g1 import batch_inv_mod
@@ -212,6 +210,9 @@ def batch_g2_mul(
         zinv2 = F.fq2_sq(zinvs[i])
         zinv3 = F.fq2_mul(zinv2, zinvs[i])
         out.append(
-            (F.fq2_mul(fq2_of(X, i), zinv2), F.fq2_mul(fq2_of(Y, i), zinv3))
+            (
+                F.fq2_mul((xs_c[0][i], xs_c[1][i]), zinv2),
+                F.fq2_mul((ys_c[0][i], ys_c[1][i]), zinv3),
+            )
         )
     return out

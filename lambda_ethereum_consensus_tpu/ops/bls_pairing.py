@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..crypto.bls.fields import BLS_X
+from ..crypto.bls.fields import BLS_X, BLS_X_IS_NEG
 from . import bigint as BI
 from . import bls_fq12 as FQ
 from .bls_g1 import _ints_batch, _limbs_batch, _use_planes
@@ -50,6 +50,11 @@ __all__ = [
 # MSB-first bits of |x| after the leading 1 (63 entries), shared by the
 # Miller loop and a^x — identical to the host/native loop order.
 _X_BITS = np.array([int(b) for b in bin(BLS_X)[3:]], np.int32)
+
+# The device Miller loop and pow_x conjugate UNCONDITIONALLY for the
+# negative BLS parameter (the host path branches on the flag) — make the
+# assumption loud if the curve constants ever change (ADVICE r1).
+assert BLS_X_IS_NEG, "device pairing assumes the negative BLS12-381 parameter"
 
 # w-power -> (c1?, v-power) tower slot, per w^2 = v, v^3 = xi.
 _W_SLOTS = [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2)]

@@ -1,0 +1,119 @@
+"""Per-stage compile/run timing of the chained device verify on real TPU.
+
+Warms the persistent compile cache (.jax_cache) at the production shape
+buckets and prints one line per stage (cold = compile + run, warm = run).
+Run before benching: bench.py reuses these exact shapes.
+
+Usage: python scripts/tpu_stage_probe.py [B] [C] [GROUPS_PER_CHECK]
+"""
+
+import os
+import secrets
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from lambda_ethereum_consensus_tpu.crypto.bls import curve as C  # noqa: E402
+from lambda_ethereum_consensus_tpu.crypto.bls.hash_to_curve import (  # noqa: E402
+    DST_POP,
+    hash_to_g2,
+)
+from lambda_ethereum_consensus_tpu.ops import bls_batch as BB  # noqa: E402
+
+
+def main() -> None:
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    c = int(sys.argv[2]) if len(sys.argv) > 2 else 2
+    n_groups = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+
+    print("backend:", jax.default_backend(), flush=True)
+    ops = BB._get_chain_ops(False)
+    rng = np.random.default_rng(0)
+
+    pts = [C.g1.multiply_raw(C.G1_GENERATOR, 3 + i) for i in range(8)]
+    pkx, pky = BB._g1_planes([pts[i % 8] for i in range(B)])
+    kbits = BB._scalar_bits_batch(
+        [secrets.randbits(128) | 1 for _ in range(B)], 128
+    ).T
+    live = np.ones(B, bool)
+
+    def stage(name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        leaves = jax.tree_util.tree_leaves(out)
+        leaves[0].block_until_ready()
+        print(f"{name}: {time.perf_counter() - t0:.1f}s", flush=True)
+        return out
+
+    jac1 = stage(
+        f"ladder_g1 B={B} cold",
+        lambda: ops["ladder_g1"](
+            jnp.asarray(pkx), jnp.asarray(pky), jnp.asarray(kbits), jnp.asarray(live)
+        ),
+    )
+    stage(
+        "ladder_g1 warm",
+        lambda: ops["ladder_g1"](
+            jnp.asarray(pkx), jnp.asarray(pky), jnp.asarray(kbits), jnp.asarray(live)
+        ),
+    )
+
+    qts = [C.g2.multiply_raw(C.G2_GENERATOR, 3 + i) for i in range(8)]
+    sgx, sgy = BB._g2_planes([qts[i % 8] for i in range(B)])
+    jac2 = stage(
+        f"ladder_g2 B={B} cold",
+        lambda: ops["ladder_g2"](
+            jnp.asarray(sgx), jnp.asarray(sgy), jnp.asarray(kbits), jnp.asarray(live)
+        ),
+    )
+    stage(
+        "ladder_g2 warm",
+        lambda: ops["ladder_g2"](
+            jnp.asarray(sgx), jnp.asarray(sgy), jnp.asarray(kbits), jnp.asarray(live)
+        ),
+    )
+
+    m1 = BB._pow2(n_groups + 1) - 1
+    s = 8
+    e = BB._pow2(max(B // c, 1))
+    idx_g1 = rng.integers(0, B, size=(c, m1, s)).astype(np.int32)
+    idx_sig = rng.integers(0, B, size=(c, e)).astype(np.int32)
+    hpts = [hash_to_g2(b"m%d" % i, DST_POP) for i in range(8)]
+    hx, hy = BB._g2_planes([hpts[i % 8] for i in range(c * m1)])
+    hx = hx.reshape(32, 2, c, m1)
+    hy = hy.reshape(32, 2, c, m1)
+    live2 = np.ones((c, m1 + 1), bool)
+
+    args = lambda: ops["prep"](
+        jac1,
+        jac2,
+        jnp.asarray(idx_g1),
+        jnp.asarray(idx_sig),
+        jnp.asarray(hx),
+        jnp.asarray(hy),
+        jnp.asarray(live2),
+    )
+    px, py, qx, qy, mask = stage(f"prep (c={c}, m={m1+1}, s={s}, e={e}) cold", args)
+    stage("prep warm", args)
+
+    f = stage(f"miller (c={c}, m={m1+1}) cold", lambda: ops["miller"](px, py, qx, qy))
+    stage("miller warm", lambda: ops["miller"](px, py, qx, qy))
+
+    stage("check_tail cold", lambda: ops["check_tail"](f, mask))
+    stage("check_tail warm", lambda: ops["check_tail"](f, mask))
+    print("STAGES DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

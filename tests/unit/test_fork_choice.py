@@ -165,6 +165,49 @@ def test_attestations_steer_fork_choice(chain):
         on_attestation(store, att, spec=spec)
         assert get_weight(store, loser, spec) > 0
         assert get_head(store, spec) == loser
+        # the streamed O(1) head cache must track the full recomputation
+        # on this boost-free, viability-trivial scenario (tree.HeadCache)
+        assert store.head_cache.head() == loser
+
+
+def test_head_cache_follows_get_head_across_vote_moves(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = make_store(genesis, anchor_block, spec)
+        signed_a, _ = build_block(genesis, spec, 1, graffiti=b"\xaa" * 32)
+        signed_b, _ = build_block(genesis, spec, 1, graffiti=b"\xbb" * 32)
+        on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+        root_a = on_block(store, signed_a, spec=spec)
+        root_b = on_block(store, signed_b, spec=spec)
+        assert store.head_cache.head() == get_head(store, spec)
+
+        def attest(root, committee_index):
+            committee = accessors.get_beacon_committee(
+                store.block_states[root], 1, committee_index, spec
+            )
+            data = AttestationData(
+                slot=1,
+                index=committee_index,
+                beacon_block_root=root,
+                source=store.justified_checkpoint,
+                target=Checkpoint(epoch=0, root=anchor_root),
+            )
+            domain = accessors.get_domain(
+                store.block_states[root], constants.DOMAIN_BEACON_ATTESTER, 0, spec
+            )
+            signing_root = misc.compute_signing_root(data, domain)
+            sigs = [bls.sign(SKS[i], signing_root) for i in committee]
+            att = Attestation(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                signature=bls.aggregate(sigs),
+            )
+            on_attestation(store, att, spec=spec)
+
+        attest(min(root_a, root_b), 0)
+        assert store.head_cache.head() == get_head(store, spec)
+        attest(max(root_a, root_b), 1)
+        assert store.head_cache.head() == get_head(store, spec)
 
 
 def test_attestation_for_unknown_block_rejected(chain):

@@ -87,3 +87,53 @@ def test_duplicate_and_unknown_parent():
         raise AssertionError("unknown parent must raise")
     except KeyError:
         pass
+
+
+# ----------------------------------------------------------- HeadCache
+
+
+def _cache_two_forks():
+    from lambda_ethereum_consensus_tpu.fork_choice.tree import HeadCache
+
+    hc = HeadCache(A)
+    hc.on_block(B, A)
+    hc.on_block(C, A)
+    return hc
+
+
+def test_head_cache_vote_move_subtracts_previous_weight():
+    hc = _cache_two_forks()
+    hc.on_vote(0, B, 32)
+    assert hc.head() == B
+    # validator 0 MOVES its vote: the 32 on B must be retracted, so a
+    # single 31-weight vote on C now outweighs B's zero
+    hc.on_vote(0, C, 31)
+    assert hc.tree.weight(B) == 0
+    assert hc.tree.weight(C) == 31
+    assert hc.head() == C
+
+
+def test_head_cache_equivocation_retracts_vote():
+    hc = _cache_two_forks()
+    hc.on_vote(0, B, 32)
+    hc.on_vote(1, C, 16)
+    assert hc.head() == B
+    hc.on_equivocation(0)
+    assert hc.tree.weight(B) == 0
+    assert hc.head() == C
+    # idempotent: a second slashing of the same index must not go negative
+    hc.on_equivocation(0)
+    assert hc.tree.weight(B) == 0
+
+
+def test_head_cache_prune_drops_stale_votes():
+    hc = _cache_two_forks()
+    hc.on_block(D, B)
+    hc.on_vote(0, C, 10)
+    hc.on_vote(1, D, 5)
+    hc.prune(B)  # finalize B: C's subtree is gone
+    assert hc.head() == D
+    # the pruned-away vote is forgotten entirely: a later move by the
+    # same validator must not try to retract from a vanished node
+    hc.on_vote(0, D, 7)
+    assert hc.tree.weight(D) == 12
