@@ -304,7 +304,12 @@ def on_attestation_batch(
                 agg_pk = pt if agg_pk is None else g1.affine_add(agg_pk, pt)
             sig_pt = g2_from_bytes(bytes(indexed.signature))
             prepared.append((i, attestation, indexed, (agg_pk, signing_root, sig_pt)))
-        except (SpecError, BlsError, DeserializationError) as e:
+        except (BlsError, DeserializationError) as e:
+            # undecodable signature / bad point: protocol violation
+            results[i] = ForkChoiceError(str(e), reject=True)
+        except SpecError as e:
+            # unknown block, timing, committee mismatch: could be a race
+            # or missing context — ignore, don't penalize
             results[i] = ForkChoiceError(str(e))
     if prepared:
         flags = batch_verify_each_points([entry[3] for entry in prepared])
@@ -312,7 +317,9 @@ def on_attestation_batch(
             if ok:
                 update_latest_messages(store, indexed.attesting_indices, attestation)
             else:
-                results[i] = ForkChoiceError("invalid attestation signature")
+                results[i] = ForkChoiceError(
+                    "invalid attestation signature", reject=True
+                )
     return results
 
 

@@ -17,7 +17,18 @@ from .tree import HeadCache
 
 
 class ForkChoiceError(SpecError):
-    """Message rejected by fork-choice validation."""
+    """Message rejected by fork-choice validation.
+
+    ``reject`` distinguishes protocol violations (bad signature,
+    undecodable point — gossip verdict REJECT, peer penalized) from
+    conditions that may be timing or missing context (unknown block,
+    wrong epoch — verdict IGNORE), mirroring the reference's three-way
+    accept/reject/ignore (subscriptions.go:95-135).
+    """
+
+    def __init__(self, msg: str, reject: bool = False):
+        super().__init__(msg)
+        self.reject = reject
 
 
 @dataclass(frozen=True)

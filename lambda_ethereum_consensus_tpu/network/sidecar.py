@@ -6,9 +6,18 @@ role of the reference's Go libp2p binary (ref: native/libp2p_port/main.go):
 - stdio: 4-byte big-endian length frames carrying ``Command`` in and
   ``Notification`` out (the reference's ``{:packet, 4}`` port contract).
 - p2p: TCP with a HELLO handshake (fork-digest filtered — the job discv5 ENR
-  filtering does in the reference), flood gossip with seen-cache dedup and
-  host-gated validation (mirroring the blocking topic validator,
-  subscriptions.go:95-135), correlated req/resp, and peer exchange.
+  filtering does in the reference), gossipsub-style MESH routing with
+  peer scoring, seen-cache dedup and host-gated validation (mirroring the
+  blocking topic validator, subscriptions.go:95-135), correlated
+  req/resp, and peer exchange.
+
+Mesh (replacing round 1's flood): per subscribed topic the sidecar keeps
+a mesh of D=8 peers (D_lo=6 .. D_hi=12), maintained by a 700 ms heartbeat
+(the reference's eth2 gossipsub params, subscriptions.go:31-77) with
+GRAFT/PRUNE control frames; full messages flow only along mesh links.
+Peer scores are fed by the HOST's validation verdicts — REJECT costs
+``REJECT_PENALTY``, sustained misbehavior crosses ``GRAYLIST_SCORE`` and
+the peer is disconnected — and decay toward zero each heartbeat.
 
 The p2p transport is deliberately contained behind this process boundary so a
 full libp2p implementation can replace it without touching the host runtime.
@@ -29,6 +38,21 @@ MAX_FRAME = 1 << 28
 GOSSIP_SEEN_CAP = 4096
 MAX_DIALED_FROM_EXCHANGE = 32
 
+# Gossipsub-style mesh parameters (ref: subscriptions.go:31-77 — the
+# reference's eth2-tuned go-libp2p-pubsub config).
+MESH_D = 8
+MESH_D_LO = 6
+MESH_D_HI = 12
+HEARTBEAT_S = 0.7
+# Verdict-fed scoring: REJECT is a protocol violation; scores decay
+# toward 0 each heartbeat so old behavior washes out.
+ACCEPT_REWARD = 1.0
+REJECT_PENALTY = 40.0
+SCORE_DECAY = 0.95
+MAX_SCORE = 100.0
+PRUNE_SCORE = -40.0     # below: never grafted, pruned from meshes
+GRAYLIST_SCORE = -80.0  # below: disconnected outright
+
 
 def _msg_id(topic: str, payload: bytes) -> bytes:
     """Gossip message id (sha256 prefix, like eth2's MsgID —
@@ -45,6 +69,8 @@ class Peer:
         self.listen_port = 0
         self.addr = ""
         self.send_lock = asyncio.Lock()
+        self.topics: set[str] = set()  # the peer's announced subscriptions
+        self.score = 0.0
 
     async def send_frame(self, frame: p2p_pb2.P2PFrame) -> None:
         raw = frame.SerializeToString()
@@ -61,6 +87,11 @@ class Sidecar:
         self.enable_peer_exchange = True
         self.peers: dict[bytes, Peer] = {}  # node_id -> peer
         self.subscriptions: set[str] = set()
+        self.mesh: dict[str, set[bytes]] = {}  # topic -> mesh peer ids
+        # negative scores survive disconnection (else a graylisted peer
+        # resets its score with one TCP reconnect); decayed per heartbeat
+        # and dropped once back above the prune threshold
+        self.ban_scores: dict[bytes, float] = {}
         self.handlers: set[str] = set()  # protocol ids served by the host
         self.seen: OrderedDict[bytes, None] = OrderedDict()
         # msg_id -> (topic, payload, source); capped — an evicted entry means
@@ -119,10 +150,20 @@ class Sidecar:
             ok, err = await self.dial(cmd.add_peer.addr)
             await self.result(cmd.id, ok, error=err)
         elif which == "subscribe":
-            self.subscriptions.add(cmd.subscribe.topic)
+            topic = cmd.subscribe.topic
+            self.subscriptions.add(topic)
+            self.mesh.setdefault(topic, set())
+            await self._announce_sub(topic, True)
+            await self._mesh_maintain(topic)
             await self.result(cmd.id, True)
         elif which == "unsubscribe":
-            self.subscriptions.discard(cmd.unsubscribe.topic)
+            topic = cmd.unsubscribe.topic
+            self.subscriptions.discard(topic)
+            for nid in self.mesh.pop(topic, set()):
+                peer = self.peers.get(nid)
+                if peer is not None:
+                    await self._send_control(peer, "prune", topic)
+            await self._announce_sub(topic, False)
             await self.result(cmd.id, True)
         elif which == "publish":
             await self.publish(cmd.publish.topic, cmd.publish.payload)
@@ -153,6 +194,7 @@ class Sidecar:
         self.listen_port = server.sockets[0].getsockname()[1]
         for addr in args.bootnodes:
             asyncio.ensure_future(self.dial(addr))
+        asyncio.ensure_future(self._heartbeat_loop())
         await self.result(
             cmd.id, True, payload=str(self.listen_port).encode()
         )
@@ -184,6 +226,7 @@ class Sidecar:
             hello.hello.node_id = self.node_id
             hello.hello.fork_digest = self.fork_digest
             hello.hello.listen_port = self.listen_port
+            hello.hello.topics.extend(sorted(self.subscriptions))
             await peer.send_frame(hello)
             first = await asyncio.wait_for(self.read_frame(peer), timeout=10)
             if first is None or first.WhichOneof("f") != "hello":
@@ -193,8 +236,13 @@ class Sidecar:
                 return  # wrong fork: drop (the discovery filter's job)
             if h.node_id == self.node_id or h.node_id in self.peers:
                 return  # self-dial or duplicate connection
+            carried = self.ban_scores.get(h.node_id, 0.0)
+            if carried < GRAYLIST_SCORE:
+                return  # graylisted identity: refuse the connection
             peer.node_id = h.node_id
             peer.listen_port = h.listen_port
+            peer.topics = set(h.topics)
+            peer.score = carried
             peername = peer.writer.get_extra_info("peername")
             peer.addr = dialed_addr or (
                 f"{peername[0]}:{h.listen_port}" if h.listen_port else ""
@@ -222,6 +270,10 @@ class Sidecar:
         finally:
             if peer.node_id and self.peers.get(peer.node_id) is peer:
                 del self.peers[peer.node_id]
+                for members in self.mesh.values():
+                    members.discard(peer.node_id)
+                if peer.score < 0:
+                    self.ban_scores[peer.node_id] = peer.score
                 n = port_pb2.Notification()
                 n.peer_gone.peer_id = peer.node_id
                 await self.notify(n)
@@ -248,8 +300,98 @@ class Sidecar:
             await self.on_resp(peer, frame.resp)
         elif which == "peer_exchange":
             await self.on_peer_exchange(frame.peer_exchange.addrs)
+        elif which == "sub_opts":
+            if frame.sub_opts.subscribe:
+                peer.topics.add(frame.sub_opts.topic)
+            else:
+                peer.topics.discard(frame.sub_opts.topic)
+                self.mesh.get(frame.sub_opts.topic, set()).discard(peer.node_id)
+        elif which == "graft":
+            await self.on_graft(peer, frame.graft.topic)
+        elif which == "prune":
+            self.mesh.get(frame.prune.topic, set()).discard(peer.node_id)
         elif which == "goodbye":
             peer.writer.close()
+
+    # ----------------------------------------------------------- mesh
+
+    async def _send_control(self, peer: Peer, kind: str, topic: str) -> None:
+        frame = p2p_pb2.P2PFrame()
+        getattr(frame, kind).topic = topic
+        try:
+            await peer.send_frame(frame)
+        except (OSError, ConnectionError):
+            pass
+
+    async def _announce_sub(self, topic: str, subscribe: bool) -> None:
+        frame = p2p_pb2.P2PFrame()
+        frame.sub_opts.topic = topic
+        frame.sub_opts.subscribe = subscribe
+        for peer in list(self.peers.values()):
+            try:
+                await peer.send_frame(frame)
+            except (OSError, ConnectionError):
+                pass
+
+    async def on_graft(self, peer: Peer, topic: str) -> None:
+        """A peer grafts us into its mesh; accept when we subscribe to the
+        topic and the peer is in good standing, else prune back."""
+        if topic in self.subscriptions and peer.score > PRUNE_SCORE:
+            self.mesh.setdefault(topic, set()).add(peer.node_id)
+        else:
+            await self._send_control(peer, "prune", topic)
+
+    async def _mesh_maintain(self, topic: str) -> None:
+        members = self.mesh.setdefault(topic, set())
+        members &= set(self.peers)  # drop vanished peers
+        if len(members) < MESH_D_LO:
+            candidates = sorted(
+                (
+                    p
+                    for p in self.peers.values()
+                    if topic in p.topics
+                    and p.node_id not in members
+                    and p.score > PRUNE_SCORE
+                ),
+                key=lambda p: -p.score,
+            )
+            for peer in candidates[: MESH_D - len(members)]:
+                members.add(peer.node_id)
+                await self._send_control(peer, "graft", topic)
+        elif len(members) > MESH_D_HI:
+            ranked = sorted(
+                members, key=lambda nid: self.peers[nid].score, reverse=True
+            )
+            for nid in ranked[MESH_D:]:
+                members.discard(nid)
+                peer = self.peers.get(nid)
+                if peer is not None:
+                    await self._send_control(peer, "prune", topic)
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(HEARTBEAT_S)
+            for peer in list(self.peers.values()):
+                peer.score *= SCORE_DECAY
+                if peer.score < GRAYLIST_SCORE:
+                    await self._disconnect(peer)
+            # off-line penalties decay too; forgiven once above the
+            # prune threshold
+            for nid in list(self.ban_scores):
+                self.ban_scores[nid] *= SCORE_DECAY
+                if self.ban_scores[nid] > PRUNE_SCORE:
+                    del self.ban_scores[nid]
+            for topic in list(self.subscriptions):
+                await self._mesh_maintain(topic)
+
+    async def _disconnect(self, peer: Peer) -> None:
+        frame = p2p_pb2.P2PFrame()
+        frame.goodbye.reason = 1  # fault
+        try:
+            await peer.send_frame(frame)
+        except (OSError, ConnectionError):
+            pass
+        peer.writer.close()
 
     # ------------------------------------------------------------- gossip
 
@@ -267,13 +409,23 @@ class Sidecar:
         self._mark_seen(msg_id)
         await self._forward(topic, payload, exclude=None)
 
+    def _route_targets(self, topic: str, exclude: bytes | None) -> list[Peer]:
+        """Mesh members for the topic; when the mesh is still empty (cold
+        start, before a heartbeat) fall back to every topic subscriber."""
+        members = self.mesh.get(topic) or {
+            p.node_id for p in self.peers.values() if topic in p.topics
+        }
+        return [
+            self.peers[nid]
+            for nid in members
+            if nid != exclude and nid in self.peers
+        ]
+
     async def _forward(self, topic: str, payload: bytes, exclude: bytes | None) -> None:
         frame = p2p_pb2.P2PFrame()
         frame.gossip.topic = topic
         frame.gossip.payload = payload
-        for node_id, peer in list(self.peers.items()):
-            if node_id == exclude:
-                continue
+        for peer in self._route_targets(topic, exclude):
             try:
                 await peer.send_frame(frame)
             except (OSError, ConnectionError):
@@ -284,8 +436,8 @@ class Sidecar:
         if not self._mark_seen(msg_id):
             return
         if topic not in self.subscriptions:
-            # not interested, but still forward (flood routing)
-            await self._forward(topic, payload, exclude=peer.node_id)
+            # mesh routing: messages flow along grafted links of
+            # subscribers only — no blind flood relay of foreign topics
             return
         # host-gated validation before forwarding (reference: blocking topic
         # validator waiting on the Elixir verdict, subscriptions.go:95-135)
@@ -304,8 +456,21 @@ class Sidecar:
         if entry is None:
             return
         topic, payload, source = entry
+        peer = self.peers.get(source)
         if verdict == port_pb2.ValidateMessage.ACCEPT:
+            if peer is not None:
+                peer.score = min(MAX_SCORE, peer.score + ACCEPT_REWARD)
             await self._forward(topic, payload, exclude=source)
+        elif verdict == port_pb2.ValidateMessage.REJECT and peer is not None:
+            # protocol violation: downscore, prune from every mesh, and
+            # disconnect once past the graylist threshold (round 1 never
+            # penalized — REJECT now has teeth)
+            peer.score -= REJECT_PENALTY
+            if peer.score <= PRUNE_SCORE:
+                for members in self.mesh.values():
+                    members.discard(source)
+            if peer.score < GRAYLIST_SCORE:
+                await self._disconnect(peer)
 
     # ------------------------------------------------------------ req/resp
 

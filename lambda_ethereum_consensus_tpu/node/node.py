@@ -267,8 +267,14 @@ class BeaconNode:
         results = on_attestation_batch(
             self.store, attestations, is_from_block=False, spec=self.spec
         )
+        # three-way verdicts: invalid signatures REJECT (the sidecar
+        # downscores and eventually disconnects the sender — round 1
+        # conflated invalid with ignore and never penalized anyone)
         return [
-            VERDICT_ACCEPT if err is None else VERDICT_IGNORE for err in results
+            VERDICT_ACCEPT
+            if err is None
+            else (VERDICT_REJECT if getattr(err, "reject", False) else VERDICT_IGNORE)
+            for err in results
         ]
 
     def _on_applied(self, root: bytes, signed: SignedBeaconBlock) -> None:

@@ -142,7 +142,6 @@ def make_chain_ops(interpret: bool = False):
             jnp.take(inf, idx_g1.reshape(-1), axis=0).reshape(c, m1, s),
         )
         gX, gY, gZ, ginf = _tree_reduce_j(jadd1, g)  # (32, c, m1), (c, m1)
-        px_g, py_g = norm_g1_j(gX, gY, gZ)
 
         X2, Y2, Z2, inf2 = jac2
         e = idx_sig.shape[1]
@@ -153,10 +152,20 @@ def make_chain_ops(interpret: bool = False):
             jnp.take(inf2, idx_sig.reshape(-1), axis=0).reshape(c, e),
         )
         sX, sY, sZ, sinf = _tree_reduce_j(jadd2, s2)  # (32, 2, c), (c,)
-        qx_s, qy_s = norm_g2_j(sX, sY, sZ)
+        return finish(
+            (gX, gY, gZ, ginf), (sX, sY, sZ, sinf), h_x, h_y, static_live
+        )
 
-        # Pack the (c, m) Miller batch: groups in slots 0..m1-1, the
-        # signature pair last.
+    def finish(group_jac, sig_jac, h_x, h_y, static_live):
+        """Normalize reduced Jacobians and pack the (c, m) Miller batch:
+        groups in slots 0..m1-1, the signature pair last.  Shared by the
+        single-device prep and the sharded pipeline (which produces the
+        reduced Jacobians via per-device partial sums + all_gather)."""
+        gX, gY, gZ, ginf = group_jac
+        sX, sY, sZ, sinf = sig_jac
+        c = gX.shape[1]
+        px_g, py_g = norm_g1_j(gX, gY, gZ)
+        qx_s, qy_s = norm_g2_j(sX, sY, sZ)
         px = jnp.concatenate([px_g, jnp.broadcast_to(neg_g1_x, (32, c, 1))], -1)
         py = jnp.concatenate([py_g, jnp.broadcast_to(neg_g1_y, (32, c, 1))], -1)
         qx = jnp.concatenate([h_x, qx_s[..., None]], -1)
@@ -183,6 +192,9 @@ def make_chain_ops(interpret: bool = False):
         "ladder_g2": wrap(ladder_g2),
         # host-composed (see comment above prep) — pieces are jitted
         "prep": prep,
+        "finish": finish,
+        "jadd1": jadd1,
+        "jadd2": jadd2,
         "aggregate_g1": aggregate_g1,
         "miller": pairing["miller"],
         "check_tail": pairing["check_tail"],

@@ -493,18 +493,24 @@ def get_fq12_ops():
     return _FQ12_OPS
 
 
-def get_fq12_plane_ops(interpret: bool = False):
+def get_fq12_plane_ops(interpret: bool = False, eager: bool | None = None):
     """Plane-layout tower over the fused Pallas base kernels.
 
-    ``interpret=True`` is the CPU-test mode end to end: einsum-delegated
-    base ops and eager (scan-free) exponent loops.
+    ``interpret=True`` swaps the base ops for the einsum delegation
+    (CPU-testable).  ``eager`` picks the loop style for the exponent
+    scans — defaults to ``interpret`` (eager host loops for plain CPU
+    tests); the sharded pipeline passes ``eager=False`` with
+    ``interpret=True`` because a ``shard_map`` body must be stageable.
     """
-    if interpret not in _FQ12_PLANE_OPS:
+    if eager is None:
+        eager = interpret
+    key = (interpret, eager)
+    if key not in _FQ12_PLANE_OPS:
         from .bigint_pallas import make_plane_ops
 
-        _FQ12_PLANE_OPS[interpret] = make_fq12_ops(
+        _FQ12_PLANE_OPS[key] = make_fq12_ops(
             base=make_plane_ops(interpret=interpret),
             lay=_PlaneLayout(),
-            eager=interpret,
+            eager=eager,
         )
-    return _FQ12_PLANE_OPS[interpret]
+    return _FQ12_PLANE_OPS[key]

@@ -60,12 +60,23 @@ assert BLS_X_IS_NEG, "device pairing assumes the negative BLS12-381 parameter"
 _W_SLOTS = [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2)]
 
 
-def make_pairing_ops(plane: bool = False, interpret: bool = False):
+def make_pairing_ops(
+    plane: bool = False, interpret: bool = False, eager: bool | None = None
+):
+    """``interpret`` picks the base ops (Pallas vs einsum delegation);
+    ``eager`` picks the loop style (host loops vs lax.scan/cond) and
+    defaults to ``interpret``.  The sharded pipeline uses
+    ``interpret=True, eager=False`` — stageable bodies over the
+    CPU-portable base."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    ops = FQ.get_fq12_plane_ops(interpret) if plane else FQ.get_fq12_ops()
+    if eager is None:
+        eager = interpret
+    ops = (
+        FQ.get_fq12_plane_ops(interpret, eager) if plane else FQ.get_fq12_ops()
+    )
     lay = ops["layout"]
     f2m, f2s = ops["fq2_mul"], ops["fq2_sq"]
     f2a, f2sub = ops["fq2_add"], ops["fq2_sub"]
@@ -151,7 +162,7 @@ def make_pairing_ops(plane: bool = False, interpret: bool = False):
         X, Y = qx, qy
         Z = lay.fq2_like((1, 0), qx)
 
-        if interpret:
+        if eager:
             # CPU-test mode: the loop bits are STATIC — unroll as host
             # Python (no lax.cond/scan staging, no giant CPU compile;
             # the tower ops dispatch small fq2-level jits), skipping the
@@ -183,7 +194,7 @@ def make_pairing_ops(plane: bool = False, interpret: bool = False):
         """a^|x| by square-and-multiply over the static parameter bits.
         (Callers conjugate for the negative sign — on the cyclotomic
         subgroup, where every use of this lives.)"""
-        if interpret:
+        if eager:
             acc = a
             for bit in _X_BITS.tolist():
                 acc = f12sq(acc)
@@ -227,7 +238,7 @@ def make_pairing_ops(plane: bool = False, interpret: bool = False):
     # pow_x_abs, easy_part via fp_inv, masked_product) stay host-composed
     # — staging their loops is exactly the giant-compile failure mode —
     # while the straight-line pieces still jit (one dispatch each).
-    wrap = (lambda f: f) if interpret else jax.jit
+    wrap = (lambda f: f) if eager else jax.jit
     jits = {
         "miller": wrap(miller),
         "pow_x_abs": wrap(pow_x_abs),
@@ -273,10 +284,12 @@ def make_pairing_ops(plane: bool = False, interpret: bool = False):
 _OPS: dict = {}
 
 
-def _get_ops(plane: bool = False, interpret: bool = False):
-    key = (plane, interpret)
+def _get_ops(plane: bool = False, interpret: bool = False, eager: bool | None = None):
+    if eager is None:
+        eager = interpret
+    key = (plane, interpret, eager)
     if key not in _OPS:
-        _OPS[key] = make_pairing_ops(plane, interpret)
+        _OPS[key] = make_pairing_ops(plane, interpret, eager)
     return _OPS[key]
 
 

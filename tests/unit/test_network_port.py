@@ -159,3 +159,75 @@ def test_sidecar_crash_detected():
         await port.close()
 
     run(main())
+
+
+def test_rejecting_peer_gets_downscored_and_disconnected():
+    """Sustained REJECT verdicts must prune and finally disconnect the
+    misbehaving sender (VERDICT r1: rejects never penalized anyone)."""
+
+    async def main():
+        digest = b"\x05\x06\x07\x08"
+        bad = await Port.start(fork_digest=digest)
+        honest = await Port.start(fork_digest=digest)
+        gone = asyncio.get_running_loop().create_future()
+        honest.on_peer_gone = (
+            lambda peer_id: gone.done() or gone.set_result(peer_id)
+        )
+        new_peer = asyncio.get_running_loop().create_future()
+        honest.on_new_peer = (
+            lambda peer_id, addr: new_peer.done() or new_peer.set_result(peer_id)
+        )
+        await bad.add_peer(f"127.0.0.1:{honest.listen_port}")
+        assert await asyncio.wait_for(new_peer, 10) == bad.node_id
+
+        seen = asyncio.Queue()
+
+        async def on_gossip(topic, msg_id, payload, from_peer):
+            # every message from the bad peer is a protocol violation
+            await honest.validate_message(msg_id, VERDICT_REJECT)
+            await seen.put(payload)
+
+        await honest.subscribe("/junk", on_gossip)
+        await asyncio.sleep(0.2)
+        # -40 (pruned), -80, -120: the third REJECT crosses the graylist
+        for i in range(3):
+            await bad.publish("/junk", b"junk-%d" % i)
+            await asyncio.wait_for(seen.get(), 10)
+        assert await asyncio.wait_for(gone, 10) == bad.node_id
+        await bad.close()
+        await honest.close()
+
+    run(main())
+
+
+def test_mesh_grafts_between_subscribers():
+    """Two subscribers of one topic graft each other within a heartbeat;
+    a published message then flows along the mesh link."""
+
+    async def main():
+        digest = b"\x09\x0a\x0b\x0c"
+        a = await Port.start(fork_digest=digest)
+        b = await Port.start(fork_digest=digest)
+        await a.add_peer(f"127.0.0.1:{b.listen_port}")
+        await asyncio.sleep(0.2)
+
+        got = asyncio.get_running_loop().create_future()
+
+        async def on_a(topic, msg_id, payload, from_peer):
+            await a.validate_message(msg_id, VERDICT_ACCEPT)
+
+        async def on_b(topic, msg_id, payload, from_peer):
+            await b.validate_message(msg_id, VERDICT_ACCEPT)
+            if not got.done():
+                got.set_result(payload)
+
+        await a.subscribe("/mesh", on_a)
+        await b.subscribe("/mesh", on_b)
+        # a full heartbeat so GRAFT control frames settle the mesh
+        await asyncio.sleep(1.0)
+        await a.publish("/mesh", b"over the mesh")
+        assert await asyncio.wait_for(got, 10) == b"over the mesh"
+        await a.close()
+        await b.close()
+
+    run(main())
