@@ -71,10 +71,14 @@ class Peer:
         self.send_lock = asyncio.Lock()
         self.topics: set[str] = set()  # the peer's announced subscriptions
         self.score = 0.0
+        self.noise = None  # NoiseSession after the handshake
 
     async def send_frame(self, frame: p2p_pb2.P2PFrame) -> None:
         raw = frame.SerializeToString()
         async with self.send_lock:
+            # the lock also serializes AEAD nonces (counter per direction)
+            if self.noise is not None:
+                raw = self.noise.encrypt(raw)
             self.writer.write(struct.pack(">I", len(raw)) + raw)
             await self.writer.drain()
 
@@ -92,6 +96,28 @@ class Sidecar:
         # resets its score with one TCP reconnect); decayed per heartbeat
         # and dropped once back above the prune threshold
         self.ban_scores: dict[bytes, float] = {}
+        # Noise transport static key.  SIDECAR_PLAINTEXT=1 opts out for
+        # debugging — it must match across the whole fleet (there is no
+        # in-band negotiation; a mixed deployment cannot connect and
+        # handshake timeouts are logged to stderr).  With noise on, the
+        # node identity IS the static key (sha256 of the public key), so
+        # a graylisted peer cannot shed its ban by re-rolling a random
+        # node_id — rotation costs a keypair and the HELLO is checked
+        # against the authenticated channel.
+        self.noise_static = None
+        if os.environ.get("SIDECAR_PLAINTEXT", "") not in ("1", "true"):
+            try:
+                from cryptography.hazmat.primitives.asymmetric.x25519 import (
+                    X25519PrivateKey,
+                )
+
+                self.noise_static = X25519PrivateKey.generate()
+            except Exception:  # cryptography unavailable: stay plaintext
+                self.noise_static = None
+        if self.noise_static is not None:
+            from .noise import _pub
+
+            self.node_id = hashlib.sha256(_pub(self.noise_static)).digest()
         self.handlers: set[str] = set()  # protocol ids served by the host
         self.seen: OrderedDict[bytes, None] = OrderedDict()
         # msg_id -> (topic, payload, source); capped — an evicted entry means
@@ -222,6 +248,30 @@ class Sidecar:
 
     async def run_peer(self, peer: Peer, dialed_addr: str | None) -> None:
         try:
+            if self.noise_static is not None:
+                # encrypted transport first: everything after this line —
+                # including the HELLO — rides the authenticated channel
+                from .noise import NoiseError, handshake
+
+                try:
+                    peer.noise = await asyncio.wait_for(
+                        handshake(
+                            peer.reader,
+                            peer.writer,
+                            self.noise_static,
+                            initiator=dialed_addr is not None,
+                        ),
+                        timeout=10,
+                    )
+                except (NoiseError, asyncio.TimeoutError):
+                    print(
+                        "sidecar: noise handshake failed "
+                        f"({'dial ' + dialed_addr if dialed_addr else 'inbound'}) — "
+                        "mixed SIDECAR_PLAINTEXT deployment?",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    return
             hello = p2p_pb2.P2PFrame()
             hello.hello.node_id = self.node_id
             hello.hello.fork_digest = self.fork_digest
@@ -236,6 +286,12 @@ class Sidecar:
                 return  # wrong fork: drop (the discovery filter's job)
             if h.node_id == self.node_id or h.node_id in self.peers:
                 return  # self-dial or duplicate connection
+            if peer.noise is not None:
+                # identity binding: the HELLO node_id must be the hash of
+                # the noise-authenticated static key — no borrowed ids
+                expected = hashlib.sha256(peer.noise.remote_static).digest()
+                if h.node_id != expected:
+                    return
             carried = self.ban_scores.get(h.node_id, 0.0)
             if carried < GRAYLIST_SCORE:
                 return  # graylisted identity: refuse the connection
@@ -288,6 +344,13 @@ class Sidecar:
         if length > MAX_FRAME:
             return None
         raw = await peer.reader.readexactly(length)
+        if peer.noise is not None:
+            from .noise import NoiseError
+
+            try:
+                raw = peer.noise.decrypt(raw)
+            except NoiseError:
+                return None  # tampered/offset stream: drop the peer
         return p2p_pb2.P2PFrame.FromString(raw)
 
     async def handle_frame(self, peer: Peer, frame: p2p_pb2.P2PFrame) -> None:
@@ -461,14 +524,26 @@ class Sidecar:
             if peer is not None:
                 peer.score = min(MAX_SCORE, peer.score + ACCEPT_REWARD)
             await self._forward(topic, payload, exclude=source)
-        elif verdict == port_pb2.ValidateMessage.REJECT and peer is not None:
+        elif verdict == port_pb2.ValidateMessage.REJECT:
             # protocol violation: downscore, prune from every mesh, and
             # disconnect once past the graylist threshold (round 1 never
             # penalized — REJECT now has teeth)
+            if peer is None:
+                # hit-and-run: the sender disconnected before the verdict
+                # landed — debit the persistent ban score directly so a
+                # reconnect doesn't start clean
+                self.ban_scores[source] = (
+                    self.ban_scores.get(source, 0.0) - REJECT_PENALTY
+                )
+                return
             peer.score -= REJECT_PENALTY
             if peer.score <= PRUNE_SCORE:
-                for members in self.mesh.values():
-                    members.discard(source)
+                for topic, members in self.mesh.items():
+                    if source in members:
+                        members.discard(source)
+                        # tell the remote: a silent local discard leaves
+                        # an asymmetric half-dead mesh link on their side
+                        await self._send_control(peer, "prune", topic)
             if peer.score < GRAYLIST_SCORE:
                 await self._disconnect(peer)
 
