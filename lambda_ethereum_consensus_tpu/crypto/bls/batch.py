@@ -53,6 +53,28 @@ def _chain_enabled(n: int) -> bool:
     return env_flag("BLS_DEVICE_CHAIN") or device_default()
 
 
+def _pack_check(entry_list, dst, message_points):
+    """(entries, dst) -> a chain_verify check tuple, memoizing hash_to_g2
+    through ``message_points`` — the ONE place the check format and
+    coefficient policy live (shared by the all-or-nothing and bisection
+    device paths)."""
+    group_of: dict[bytes, int] = {}
+    h_points: list = []
+    gids = []
+    packed = []
+    for pk, message, sig in entry_list:
+        g = group_of.get(message)
+        if g is None:
+            g = group_of[message] = len(h_points)
+            h = message_points.get((message, dst))
+            if h is None:
+                h = message_points[(message, dst)] = hash_to_g2(message, dst)
+            h_points.append(h)
+        gids.append(g)
+        packed.append((pk, sig, secrets.randbits(_COEFF_BITS) | 1))
+    return (packed, h_points, gids)
+
+
 def _scale_entries(entries, coeffs):
     """``[(r_i * pk_i, r_i * sig_i)]`` — on device when the batch
     amortizes the dispatch (the TPU ladder beats the native host path from
@@ -95,26 +117,11 @@ def verify_points(
         return False
     if message_points is None:
         message_points = {}
-    coeffs = [secrets.randbits(_COEFF_BITS) | 1 for _ in entries]
     if _chain_enabled(len(entries)):
         from ...ops.bls_batch import chain_verify
 
-        group_of: dict[bytes, int] = {}
-        h_points = []
-        gids = []
-        for _, message, _ in entries:
-            g = group_of.get(message)
-            if g is None:
-                g = group_of[message] = len(h_points)
-                h = message_points.get((message, dst))
-                if h is None:
-                    h = message_points[(message, dst)] = hash_to_g2(message, dst)
-                h_points.append(h)
-            gids.append(g)
-        packed = [
-            (pk, sig, r) for (pk, _, sig), r in zip(entries, coeffs)
-        ]
-        return chain_verify([(packed, h_points, gids)])[0]
+        return chain_verify([_pack_check(entries, dst, message_points)])[0]
+    coeffs = [secrets.randbits(_COEFF_BITS) | 1 for _ in entries]
     scaled_pks, scaled_sigs = _scale_entries(entries, coeffs)
     by_message: dict[bytes, C.AffinePoint] = {}
     sig_acc: C.AffinePoint = None
@@ -138,25 +145,59 @@ def verify_points(
 def batch_verify_each_points(
     entries: Sequence[PointEntry], dst: bytes = DST_POP
 ) -> list[bool]:
-    """Per-entry validity with bisection blame attribution."""
+    """Per-entry validity with bisection blame attribution.
+
+    Level-synchronous: all of one bisection level's sub-batches are
+    verified TOGETHER — on the device path that is one chained dispatch
+    with the sub-batches on the C axis, so an adversary seeding ``b`` bad
+    items into a drain costs O(log N) device round-trips, not
+    O(b log N) sequential checks.
+    """
     flags = [False] * len(entries)
     message_points: dict[tuple[bytes, bytes], C.AffinePoint] = {}
 
-    def rec(index_range: list[int]) -> None:
-        if verify_points(
-            [entries[i] for i in index_range], dst, message_points
-        ):
-            for i in index_range:
-                flags[i] = True
-            return
-        if len(index_range) == 1:
-            return
-        mid = len(index_range) // 2
-        rec(index_range[:mid])
-        rec(index_range[mid:])
+    def check_many(ranges: list[list[int]]) -> list[bool]:
+        def has_none(r):
+            return any(
+                entries[i][0] is None or entries[i][2] is None for i in r
+            )
 
-    if entries:
-        rec(list(range(len(entries))))
+        if _chain_enabled(max((len(r) for r in ranges), default=0)):
+            from ...ops.bls_batch import chain_verify
+
+            # ranges containing an undecodable (None) point are invalid
+            # by definition (verify_points semantics) — no device needed
+            results: dict[int, bool] = {
+                k: False for k, r in enumerate(ranges) if has_none(r)
+            }
+            live_ranges = [
+                (k, r) for k, r in enumerate(ranges) if k not in results
+            ]
+            checks = [
+                _pack_check([entries[i] for i in r], dst, message_points)
+                for _, r in live_ranges
+            ]
+            for (k, _), ok in zip(live_ranges, chain_verify(checks)):
+                results[k] = ok
+            return [results[k] for k in range(len(ranges))]
+        return [
+            verify_points([entries[i] for i in r], dst, message_points)
+            for r in ranges
+        ]
+
+    pending = [list(range(len(entries)))] if entries else []
+    while pending:
+        oks = check_many(pending)
+        nxt: list[list[int]] = []
+        for index_range, ok in zip(pending, oks):
+            if ok:
+                for i in index_range:
+                    flags[i] = True
+            elif len(index_range) > 1:
+                mid = len(index_range) // 2
+                nxt.append(index_range[:mid])
+                nxt.append(index_range[mid:])
+        pending = nxt
     return flags
 
 

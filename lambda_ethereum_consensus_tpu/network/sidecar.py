@@ -112,7 +112,16 @@ class Sidecar:
                 )
 
                 self.noise_static = X25519PrivateKey.generate()
-            except Exception:  # cryptography unavailable: stay plaintext
+            except Exception as e:  # cryptography unavailable
+                # loud fallback: a silently-plaintext node can't talk to a
+                # noise-on fleet (10 s handshake stalls on every connect)
+                # and voids the key-bound ban mechanism
+                print(
+                    "sidecar: NOISE DISABLED (cryptography unavailable: "
+                    f"{type(e).__name__}: {e}) — running plaintext",
+                    file=sys.stderr,
+                    flush=True,
+                )
                 self.noise_static = None
         if self.noise_static is not None:
             from .noise import _pub

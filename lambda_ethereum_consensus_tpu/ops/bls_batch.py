@@ -123,6 +123,49 @@ def make_chain_ops(interpret: bool = False):
             X, Y, Z, inf = jadd(a, b)
         return X[..., 0], Y[..., 0], Z[..., 0], inf[..., 0]
 
+    # Staged reductions for the compiled (TPU) path: every tree LEVEL is
+    # a distinct program shape, and the axon compile service charges
+    # minutes per program — a lax.scan of one jac_add compiles once like
+    # the ladder.  Long axes split sqrt-ways into two scans so the
+    # sequential step count stays ~2*sqrt(S).
+    def _scan_reduce(jac_add, pt):
+        from jax import lax
+
+        xs = tuple(jnp.moveaxis(v, -1, 0) for v in pt)
+        init = tuple(v[0] for v in xs)
+        rest = tuple(v[1:] for v in xs)
+
+        def body(carry, elem):
+            return jac_add(carry, elem), None
+
+        carry, _ = lax.scan(body, init, rest)
+        return carry
+
+    def _staged_reduce_last(jac, pt):
+        s = pt[0].shape[-1]
+        if s == 1:
+            return tuple(v[..., 0] for v in pt)
+        s1 = 1
+        while s1 * s1 < s:
+            s1 *= 2
+        if s1 * (s // s1) == s and s > 16:
+            s2 = s // s1
+            pt = tuple(
+                v.reshape(*v.shape[:-1], s1, s2) for v in pt
+            )
+            pt = _scan_reduce(jac["jac_add"], pt)  # over s2 -> (..., s1)
+        return _scan_reduce(jac["jac_add"], pt)
+
+    reduce_g1_j = wrap(lambda X, Y, Z, inf: _staged_reduce_last(g1j, (X, Y, Z, inf)))
+    reduce_g2_j = wrap(lambda X, Y, Z, inf: _staged_reduce_last(g2j, (X, Y, Z, inf)))
+
+    def _reduce_last(which, pt):
+        """interpret: eager pairwise tree (loops can't stage); compiled:
+        one jitted scan-based program per operand shape."""
+        if interpret:
+            return _tree_reduce_j(jadd1 if which == 1 else jadd2, pt)
+        return (reduce_g1_j if which == 1 else reduce_g2_j)(*pt)
+
     def prep(jac1, jac2, idx_g1, idx_sig, h_x, h_y, static_live):
         """Gather + reduce + normalize + pack the Miller batch.
 
@@ -141,7 +184,7 @@ def make_chain_ops(interpret: bool = False):
             jnp.take(Z, idx_g1.reshape(-1), axis=1).reshape(-1, c, m1, s),
             jnp.take(inf, idx_g1.reshape(-1), axis=0).reshape(c, m1, s),
         )
-        gX, gY, gZ, ginf = _tree_reduce_j(jadd1, g)  # (32, c, m1), (c, m1)
+        gX, gY, gZ, ginf = _reduce_last(1, g)  # (32, c, m1), (c, m1)
 
         X2, Y2, Z2, inf2 = jac2
         e = idx_sig.shape[1]
@@ -151,7 +194,7 @@ def make_chain_ops(interpret: bool = False):
             jnp.take(Z2, idx_sig.reshape(-1), axis=2).reshape(-1, 2, c, e),
             jnp.take(inf2, idx_sig.reshape(-1), axis=0).reshape(c, e),
         )
-        sX, sY, sZ, sinf = _tree_reduce_j(jadd2, s2)  # (32, 2, c), (c,)
+        sX, sY, sZ, sinf = _reduce_last(2, s2)  # (32, 2, c), (c,)
         return finish(
             (gX, gY, gZ, ginf), (sX, sY, sZ, sinf), h_x, h_y, static_live
         )
@@ -184,7 +227,7 @@ def make_chain_ops(interpret: bool = False):
             jnp.asarray(BI.to_limbs(1)).reshape(32, *([1] * (bx.ndim - 1))),
             bx.shape,
         )
-        X, Y, Z, _ = _tree_reduce_j(jadd1, (bx, by, z, inf))
+        X, Y, Z, _ = _reduce_last(1, (bx, by, z, inf))
         return norm_g1_j(X, Y, Z)
 
     return {
@@ -195,6 +238,10 @@ def make_chain_ops(interpret: bool = False):
         "finish": finish,
         "jadd1": jadd1,
         "jadd2": jadd2,
+        # unjitted scan-based reducers for shard_map bodies (compile as
+        # one program per shape — see the compile-latency note above)
+        "staged_reduce_g1": lambda pt: _staged_reduce_last(g1j, pt),
+        "staged_reduce_g2": lambda pt: _staged_reduce_last(g2j, pt),
         "aggregate_g1": aggregate_g1,
         "miller": pairing["miller"],
         "check_tail": pairing["check_tail"],

@@ -1,0 +1,342 @@
+"""Mesh-sharded RLC batch verification (SURVEY §5.8b's deliverable).
+
+Scales the chained device verify (:mod:`.bls_batch`) across a
+``jax.sharding.Mesh`` the way the reference scales across peers with its
+network backend (ref: native/libp2p_port — plane (a)); this is plane (b):
+XLA collectives over ICI/DCN.
+
+Layout: entries are dealt round-robin onto the ``dp`` axis so every
+device owns an equal contiguous block of the flat entry batch, with the
+last local slot reserved dead (guaranteed-infinity gather target).  The
+data-parallel bulk — the per-entry 128-bit ladders and the per-group
+Jacobian partial sums — runs under ``shard_map`` with zero communication;
+one ``all_gather`` of the tiny per-device partials (#groups points, not
+#entries) crosses the ICI, and the tree over the device axis, the
+normalization, the Miller loop and the shared final exponentiation finish
+replicated.  Communication volume is O(checks x groups), independent of
+the entry count.
+
+The Miller stage deliberately stays replicated here: its cost is
+O(groups), already D-times smaller than the sharded per-entry work, and
+the staged einsum Miller body needed by a shard_map on the CPU mesh is
+the round-1 compile blowup.  On a real multichip slice the same
+structure holds with the Pallas base ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crypto.bls.batch import _COEFF_BITS
+from . import bls_batch as BB
+from .bls_g1 import g1_plane_field
+from .bls_g2 import g2_plane_field
+
+__all__ = ["sharded_chain_verify", "make_shard_ops"]
+
+
+_DEFAULT_MESH = None
+
+
+def _default_mesh():
+    """One process-wide default mesh — a fresh Mesh per call would defeat
+    the id-keyed stage cache below (every drain would re-jit)."""
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        import jax
+        from jax.sharding import Mesh
+
+        _DEFAULT_MESH = Mesh(np.array(jax.devices()), axis_names=("dp",))
+    return _DEFAULT_MESH
+
+
+_SHARD_OPS: dict = {}
+
+
+def make_shard_ops(mesh, interpret: bool):
+    """Build (and cache) the sharded stage functions for one mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from .ladder import make_jacobian_ops
+
+    key = (tuple(d.id for d in mesh.devices.flat), interpret)
+    if key in _SHARD_OPS:
+        return _SHARD_OPS[key]
+
+    # eager loops in interpret mode (stage 1 runs them on sharded
+    # arrays); staged lax.scan on the compiled path
+    g1j = make_jacobian_ops(g1_plane_field(interpret), eager=interpret)
+    g2j = make_jacobian_ops(g2_plane_field(interpret), eager=interpret)
+    chain = BB._get_chain_ops(interpret)
+
+    import inspect
+
+    check_kw = (
+        {"check_vma": False}
+        if "check_vma" in inspect.signature(shard_map).parameters
+        else {"check_rep": False}
+    )
+
+    def smap(fn, in_specs, out_specs):
+        return jax.jit(
+            shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **check_kw
+            )
+        )
+
+    def _with_live(pt, live):
+        X, Y, Z, inf = pt
+        return X, Y, Z, inf | ~live
+
+    # ---- stage 1: per-entry ladders, zero communication ----------------
+    # interpret (CPU mesh): the eager ladder runs directly on the
+    # dp-sharded inputs — eager ops follow their operands' shardings, so
+    # every step executes data-parallel across the mesh without staging
+    # the 128-step scan (whose einsum-base CPU compile is the round-1
+    # blowup).  Compiled (TPU) path: the staged scan under shard_map.
+    if interpret:
+        ladder_g1 = lambda bx, by, kb, lv: _with_live(
+            g1j["ladder"]((bx, by), kb), lv
+        )
+        ladder_g2 = lambda bx, by, kb, lv: _with_live(
+            g2j["ladder"]((bx, by), kb), lv
+        )
+    else:
+        ladder_g1 = smap(
+            lambda bx, by, kb, lv: _with_live(g1j["ladder"]((bx, by), kb), lv),
+            (P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp")),
+            (P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp")),
+        )
+        ladder_g2 = smap(
+            lambda bx, by, kb, lv: _with_live(g2j["ladder"]((bx, by), kb), lv),
+            (P(None, None, "dp"), P(None, None, "dp"), P(None, "dp"), P("dp")),
+            (
+                P(None, None, "dp"),
+                P(None, None, "dp"),
+                P(None, None, "dp"),
+                P("dp"),
+            ),
+        )
+
+    # interpret (CPU mesh): eager pairwise tree; compiled path: the
+    # scan-based staged reduce (one program per shape — unrolled tree
+    # LEVELS inside one shard_map jit are the minutes-per-program axon
+    # compile failure mode, see bls_batch)
+    if interpret:
+        _tree = chain["tree_reduce"]
+        _reduce_g1_local = lambda pt: _tree(g1j["jac_add"], pt)
+        _reduce_g2_local = lambda pt: _tree(g2j["jac_add"], pt)
+    else:
+        _reduce_g1_local = chain["staged_reduce_g1"]
+        _reduce_g2_local = chain["staged_reduce_g2"]
+
+    # ---- stage 2: local partial sums + all_gather + device-axis tree ---
+    def _reduce_g1_body(X, Y, Z, inf, idx):
+        # idx: (1, c, m1, s) local -> squeeze the device axis
+        idx = idx[0]
+        c, m1, s = idx.shape
+        g = (
+            jnp.take(X, idx.reshape(-1), axis=1).reshape(-1, c, m1, s),
+            jnp.take(Y, idx.reshape(-1), axis=1).reshape(-1, c, m1, s),
+            jnp.take(Z, idx.reshape(-1), axis=1).reshape(-1, c, m1, s),
+            jnp.take(inf, idx.reshape(-1), axis=0).reshape(c, m1, s),
+        )
+        pX, pY, pZ, pinf = _reduce_g1_local(g)
+        # partials are tiny (c x m1 points): gather all devices' and
+        # finish the sum replicated — O(groups) over the ICI
+        ag = [
+            jnp.moveaxis(lax.all_gather(v, "dp", axis=0), 0, -1)
+            for v in (pX, pY, pZ, pinf)
+        ]
+        return _reduce_g1_local(tuple(ag))
+
+    reduce_g1 = smap(
+        _reduce_g1_body,
+        (P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp"), P("dp")),
+        (P(None, None, None), P(None, None, None), P(None, None, None), P(None, None)),
+    )
+
+    def _reduce_g2_body(X, Y, Z, inf, idx):
+        idx = idx[0]
+        c, e = idx.shape
+        s2 = (
+            jnp.take(X, idx.reshape(-1), axis=2).reshape(-1, 2, c, e),
+            jnp.take(Y, idx.reshape(-1), axis=2).reshape(-1, 2, c, e),
+            jnp.take(Z, idx.reshape(-1), axis=2).reshape(-1, 2, c, e),
+            jnp.take(inf, idx.reshape(-1), axis=0).reshape(c, e),
+        )
+        pX, pY, pZ, pinf = _reduce_g2_local(s2)
+        ag = [
+            jnp.moveaxis(lax.all_gather(v, "dp", axis=0), 0, -1)
+            for v in (pX, pY, pZ, pinf)
+        ]
+        return _reduce_g2_local(tuple(ag))
+
+    reduce_g2 = smap(
+        _reduce_g2_body,
+        (
+            P(None, None, "dp"),
+            P(None, None, "dp"),
+            P(None, None, "dp"),
+            P("dp"),
+            P("dp"),
+        ),
+        (P(None, None, None), P(None, None, None), P(None, None, None), P(None,)),
+    )
+
+    ops = {
+        "mesh": mesh,
+        "sharding": lambda spec: NamedSharding(mesh, spec),
+        "P": P,
+        "ladder_g1": ladder_g1,
+        "ladder_g2": ladder_g2,
+        "reduce_g1": reduce_g1,
+        "reduce_g2": reduce_g2,
+        "chain": chain,
+    }
+    _SHARD_OPS[key] = ops
+    return ops
+
+
+def sharded_chain_verify(
+    checks,
+    mesh=None,
+    interpret: bool | None = None,
+    coeff_bits: int = _COEFF_BITS,
+) -> list[bool]:
+    """:func:`..bls_batch.chain_verify` distributed over a device mesh.
+
+    Same inputs/outputs and infinity semantics as ``chain_verify``; the
+    per-entry stages run data-parallel over the mesh's ``dp`` axis.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..crypto.bls import curve as C
+
+    if interpret is None:
+        from .bls_g1 import _use_planes
+
+        interpret = not _use_planes()
+    if mesh is None:
+        mesh = _default_mesh()
+    d = mesh.devices.size
+    assert d & (d - 1) == 0, "dp axis size must be a power of two"
+    ops = make_shard_ops(mesh, interpret)
+
+    n_checks = len(checks)
+    if n_checks == 0:
+        return []
+
+    flat_pk, flat_sig, flat_coeff = [], [], []
+    for ci, (entries, _, _) in enumerate(checks):
+        for pk, sig, coeff in entries:
+            flat_pk.append(pk)
+            flat_sig.append(sig)
+            flat_coeff.append(coeff)
+    n = len(flat_pk)
+
+    # Round-robin deal onto devices; each device keeps >= 1 dead tail
+    # slot (the busiest device gets ceil(n/d) live entries, so bl must
+    # exceed THAT, not n//d — off-by-one here corrupts every padding
+    # gather on a full device).
+    q = BB._QUANTUM if not interpret else 8
+    nl = -(-n // d)  # live entries on the busiest device
+    bl = (nl // q + 1) * q
+    b = d * bl
+    # flat entry e lives at global column (e % d) * bl + e // d
+    col = np.arange(n)
+    cols = (col % d) * bl + col // d
+
+    order = np.full(b, -1, np.int64)
+    order[cols] = np.arange(n)
+    pk_list, sig_list, kf = [], [], []
+    for slot in range(b):
+        e = order[slot]
+        if e >= 0:
+            pk_list.append(flat_pk[e])
+            sig_list.append(flat_sig[e])
+            kf.append(flat_coeff[e])
+        else:
+            pk_list.append(C.G1_GENERATOR)
+            sig_list.append(C.G2_GENERATOR)
+            kf.append(1)
+    pkx, pky = BB._g1_planes(pk_list)
+    sgx, sgy = BB._g2_planes(sig_list)
+    kbits = BB._scalar_bits_batch(kf, coeff_bits).T
+    live = order >= 0
+
+    # Shapes shared with chain_verify's packing.
+    max_groups = max(max((len(h) for _, h, _ in checks), default=1), 1)
+    m1 = BB._pow2(max_groups + 1) - 1
+    # per-device group slots / sig slots (local indices, dead = bl - 1)
+    counts = np.zeros((d, n_checks, m1), np.int64)
+    sig_counts = np.zeros((d, n_checks), np.int64)
+    flat_e = 0
+    for ci, (entries, h_points, group_ids) in enumerate(checks):
+        for ei, g in enumerate(group_ids):
+            counts[flat_e % d, ci, g] += 1
+            sig_counts[flat_e % d, ci] += 1
+            flat_e += 1
+    s = BB._pow2(int(counts.max()) or 1)
+    e_max = BB._pow2(int(sig_counts.max()) or 1)
+
+    idx_g1 = np.full((d, n_checks, m1, s), bl - 1, np.int32)
+    idx_sig = np.full((d, n_checks, e_max), bl - 1, np.int32)
+    static_live = np.zeros((n_checks, m1 + 1), bool)
+    fill = np.zeros((d, n_checks, m1), np.int64)
+    sig_fill = np.zeros((d, n_checks), np.int64)
+    flat_e = 0
+    for ci, (entries, h_points, group_ids) in enumerate(checks):
+        for ei, g in enumerate(group_ids):
+            dev = flat_e % d
+            local = flat_e // d
+            idx_g1[dev, ci, g, fill[dev, ci, g]] = local
+            fill[dev, ci, g] += 1
+            idx_sig[dev, ci, sig_fill[dev, ci]] = local
+            sig_fill[dev, ci] += 1
+            flat_e += 1
+        static_live[ci, : len(h_points)] = [
+            any(g == gi for gi in group_ids) for g in range(len(h_points))
+        ]
+        static_live[ci, m1] = len(entries) > 0
+
+    h_points_padded = []
+    for _, h_points, _ in checks:
+        h_points_padded.extend(
+            list(h_points) + [C.G2_GENERATOR] * (m1 - len(h_points))
+        )
+    hx, hy = BB._g2_planes(h_points_padded)
+    hx = hx.reshape(32, 2, n_checks, m1)
+    hy = hy.reshape(32, 2, n_checks, m1)
+
+    put = lambda arr, spec: jax.device_put(jnp.asarray(arr), ops["sharding"](spec))
+    pkx_d = put(pkx, P(None, "dp"))
+    pky_d = put(pky, P(None, "dp"))
+    sgx_d = put(sgx, P(None, None, "dp"))
+    sgy_d = put(sgy, P(None, None, "dp"))
+    kb_d = put(kbits, P(None, "dp"))
+    lv_d = put(live, P("dp"))
+
+    jac1 = ops["ladder_g1"](pkx_d, pky_d, kb_d, lv_d)
+    jac2 = ops["ladder_g2"](sgx_d, sgy_d, kb_d, lv_d)
+    group_jac = ops["reduce_g1"](*jac1, put(idx_g1, P("dp")))
+    sig_jac = ops["reduce_g2"](*jac2, put(idx_sig, P("dp")))
+
+    chain = ops["chain"]
+    px, py, qx, qy, mask = chain["finish"](
+        group_jac, sig_jac, jnp.asarray(hx), jnp.asarray(hy),
+        jnp.asarray(static_live),
+    )
+    f = chain["miller"](px, py, qx, qy)
+    ok = chain["check_tail"](f, mask)
+    return [bool(v) for v in np.asarray(ok)]

@@ -110,3 +110,38 @@ def test_verify_points_routes_through_chain(hs, monkeypatch):
     (check,) = called["checks"]
     packed, h_points, gids = check
     assert len(packed) == 3 and gids == [0, 1, 0] and len(h_points) == 2
+
+
+@pytest.mark.device
+def test_bisection_blame_routes_through_chain(hs, monkeypatch):
+    """Level-synchronous bisection: each level is ONE chain_verify call
+    with the sub-batches batched on the C axis."""
+    from lambda_ethereum_consensus_tpu.crypto.bls import batch as HB
+
+    monkeypatch.setenv("BLS_DEVICE_CHAIN", "1")
+    monkeypatch.setenv("BLS_DEVICE_CHAIN_MIN", "1")
+
+    calls = []
+    real = BB.chain_verify
+
+    def spy(checks, interpret=None, coeff_bits=128):
+        calls.append(len(checks))
+        return real(checks, interpret, coeff_bits)
+
+    monkeypatch.setattr(
+        "lambda_ethereum_consensus_tpu.ops.bls_batch.chain_verify", spy
+    )
+
+    entries = []
+    bad = {2}
+    for i in range(4):
+        sk = secrets.randbits(32) | 1
+        pk = C.g1.multiply_raw(C.G1_GENERATOR, sk)
+        sig_sk = sk + 1 if i in bad else sk
+        sig = C.g2.multiply_raw(hs[i % 2], sig_sk)
+        entries.append((pk, MSGS[i % 2], sig))
+    flags = HB.batch_verify_each_points(entries)
+    assert flags == [True, True, False, True]
+    # level-synchronous: 1 (full) + 1 (two halves) + 1 (two singles) calls,
+    # each a single device dispatch regardless of sub-batch count
+    assert calls == [1, 2, 2]
