@@ -127,6 +127,12 @@ class _BatchLayout:
     def ksize(self, f):
         return f.shape[-5]
 
+    def kleading(self, f):
+        """Move the grouping axis to the front (for lax.scan)."""
+        import jax.numpy as jnp
+
+        return jnp.moveaxis(f, -5, 0)
+
     elem_axes = (-1, -2, -3, -4)
 
 
@@ -196,6 +202,12 @@ class _PlaneLayout:
 
     def ksize(self, f):
         return f.shape[-1]
+
+    def kleading(self, f):
+        """Move the grouping axis to the front (for lax.scan)."""
+        import jax.numpy as jnp
+
+        return jnp.moveaxis(f, -1, 0)
 
     elem_axes = (0, 1, 2, 3)
 

@@ -217,9 +217,24 @@ def make_pairing_ops(
 
     def masked_product(f, mask):
         """Fq12 batch with a K grouping axis innermost + live mask ->
-        product over K; padded lanes become the identity."""
+        product over K; padded lanes become the identity.
+
+        Staged path: a lax.scan of one f12_mul — the pairwise-halving
+        tree makes a distinct program shape per level, each costing
+        minutes on the axon compile service (the check_tail stage alone
+        compiled for 2h+ that way).  Eager path keeps the halving tree
+        (fewer host dispatches).
+        """
         one = ops["fq12_one"](lay.batch_shape(f))
         f = jnp.where(lay.expand_mask(mask), f, one)
+        if not eager:
+            xs = lay.kleading(f)
+
+            def body(acc, elem):
+                return f12m(acc, elem), None
+
+            acc, _ = lax.scan(body, xs[0], xs[1:])
+            return acc
         k = lay.ksize(f)
         while k > 1:
             if k % 2:
@@ -242,7 +257,11 @@ def make_pairing_ops(
     jits = {
         "miller": wrap(miller),
         "pow_x_abs": wrap(pow_x_abs),
-        "easy_part": wrap(easy_part),
+        # easy_part is host-composed from inv/conj/frob/mul below on the
+        # staged path (as one program it was a multi-hour axon compile);
+        # the eager path keeps the direct composition
+        "easy_part": easy_part if eager else None,
+        "inv": wrap(f12inv),
         "masked_product": wrap(masked_product),
         "mul": wrap(f12m),
         "sq": wrap(f12sq),
@@ -264,7 +283,13 @@ def make_pairing_ops(
             jits["frob"],
             jits["sq"],
         )
-        m = jits["easy_part"](f)
+        if jits["easy_part"] is not None:  # eager path
+            m = jits["easy_part"](f)
+        else:
+            # f^((p^6-1)(p^2+1)) from the small jitted pieces: the
+            # inversion (a Fermat scan) is the only non-trivial program
+            t = mul(conj(f), jits["inv"](f))
+            m = mul(frob(frob(t)), t)
         a = mul(pow_x(m), conj(m))
         b = mul(pow_x(a), conj(a))
         c = mul(pow_x(b), frob(b))

@@ -305,9 +305,11 @@ def sharded_chain_verify(
             idx_sig[dev, ci, sig_fill[dev, ci]] = local
             sig_fill[dev, ci] += 1
             flat_e += 1
-        static_live[ci, : len(h_points)] = [
-            any(g == gi for gi in group_ids) for g in range(len(h_points))
-        ]
+        # occupancy was already counted across devices — O(groups), not
+        # a per-group membership scan over every entry
+        static_live[ci, : len(h_points)] = (
+            counts[:, ci, : len(h_points)].sum(axis=0) > 0
+        )
         static_live[ci, m1] = len(entries) > 0
 
     h_points_padded = []

@@ -93,12 +93,21 @@ def main() -> None:
     def dispatch(committees, h_points, sigs):
         """Enqueue one drain's full device chain; returns the ok array
         (not yet pulled)."""
-        # committee aggregation from the device registry
+        # committee aggregation from the device registry; the reduce axis
+        # must be pow2-padded (aggregate_g1's contract — dead lanes are
+        # flagged infinity)
+        kp = BB._pow2(committee)
         idx = jnp.asarray(committees.reshape(-1).astype(np.int32))
         gx = jnp.take(rx_d, idx, axis=1).reshape(32, a_total, committee)
         gy = jnp.take(ry_d, idx, axis=1).reshape(32, a_total, committee)
+        if kp != committee:
+            pad = [(0, 0), (0, 0), (0, kp - committee)]
+            gx = jnp.pad(gx, pad)
+            gy = jnp.pad(gy, pad)
+        inf = np.zeros((a_total, kp), bool)
+        inf[:, committee:] = True
         agg_x, agg_y = ops["aggregate_g1"](
-            gx, gy, jnp.zeros((a_total, committee), bool)
+            gx, gy, jnp.asarray(inf)
         )  # (32, a_total) affine
 
         coeffs = [secrets.randbits(COEFF_BITS) | 1 for _ in range(a_total)]
