@@ -35,7 +35,7 @@ from lambda_ethereum_consensus_tpu.ops import bls_batch as BB  # noqa: E402
 def main() -> None:
     B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
     c = int(sys.argv[2]) if len(sys.argv) > 2 else 2
-    n_groups = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    n_groups = int(sys.argv[3]) if len(sys.argv) > 3 else 127
 
     print("backend:", jax.default_backend(), flush=True)
     ops = BB._get_chain_ops(False)
@@ -84,9 +84,12 @@ def main() -> None:
         ),
     )
 
+    # shape bucket deliberately matches scripts/bench_chain.py's scenario
+    # (s=1: one attestation per message group; e = atts per check) so a
+    # completed probe warm-up is exactly the bench's program set
     m1 = BB._pow2(n_groups + 1) - 1
-    s = 8
-    e = BB._pow2(max(B // c, 1))
+    s = int(os.environ.get("PROBE_S", "1"))
+    e = BB._pow2(int(os.environ.get("PROBE_E", str(n_groups))))
     idx_g1 = rng.integers(0, B, size=(c, m1, s)).astype(np.int32)
     idx_sig = rng.integers(0, B, size=(c, e)).astype(np.int32)
     hpts = [hash_to_g2(b"m%d" % i, DST_POP) for i in range(8)]
