@@ -5,12 +5,19 @@ Prints one JSON line per metric; the LAST line is the headline:
 1. ``ssz_merkle_node_hashes_per_sec`` — SHA-256 Merkle-node hashing, the
    primitive under ``Ssz.hash_tree_root`` (ref: native/ssz_nif tree_hash
    crate); vs single-thread host hashlib.
-2. ``aggregate_bls_verifications_per_sec`` — the BASELINE.json north
+2. ``chain_verify_smoke`` — on-chip valid/invalid/empty verdicts from the
+   chained verify, certifying hardware correctness each round.
+3. ``aggregate_bls_verifications_per_sec`` — the BASELINE.json north
    star (scenario 3: attestations x 2048-validator committees through
-   the chained device verify; scripts/bench_chain.py).  Run in a guarded
-   subprocess: on a cold compile cache the chain takes tens of minutes
-   to build, so a timeout records honest absence instead of hanging the
-   driver (vs_baseline is the fraction of the 50k/s target).
+   the chained device verify; scripts/bench_chain.py).
+
+The BLS bench runs in a guarded subprocess: compiles and measurement
+happen in ONE process, and every compiled program is AOT-serialized to
+``.aot_cache`` (ops/aot.py) as it lands — so a timed-out cold attempt
+still makes progress, the retry resumes from the saved executables, and
+any later run (this driver, the next round) starts warm in seconds.  On
+total failure the metric records honest absence and the SSZ line stays
+the headline.
 """
 
 from __future__ import annotations
@@ -67,37 +74,55 @@ def _bench_host(blocks: np.ndarray, budget_s: float = 2.0) -> float:
     return done / dt
 
 
-def _bench_bls(budget_s: float) -> dict:
-    """scripts/bench_chain.py in a subprocess with a hard wall-clock cap;
-    a dict without "value" (and a "note") when no number was produced —
-    timeout, crash and missing-metric are reported distinctly."""
+def _bls_attempt(budget_s: float) -> tuple[list[dict], str | None]:
+    """One subprocess run of the chain bench; (records, failure-note)."""
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+    argv = [sys.executable, os.path.join(here, "scripts", "bench_chain.py")]
+    scenario = os.environ.get("BENCH_BLS_SCENARIO")
+    if scenario:
+        argv += scenario.split()
     try:
         out = subprocess.run(
-            [sys.executable, os.path.join(here, "scripts", "bench_chain.py")],
-            capture_output=True,
-            text=True,
-            timeout=budget_s,
-            env=env,
-            cwd=here,
+            argv, capture_output=True, text=True, timeout=budget_s, env=env, cwd=here
         )
     except subprocess.TimeoutExpired:
-        return {"note": f"bls chain bench exceeded its {budget_s:.0f}s budget (cold compile cache)"}
+        return [], f"attempt exceeded its {budget_s:.0f}s budget"
     if out.returncode != 0:
-        # a crash is NOT a budget problem — surface it honestly
         tail = (out.stderr or "").strip().splitlines()[-3:]
-        return {"note": "bls chain bench crashed: " + " | ".join(tail)}
-    for line in reversed(out.stdout.strip().splitlines()):
+        return [], "crashed: " + " | ".join(tail)
+    recs = []
+    for line in out.stdout.strip().splitlines():
         try:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if rec.get("metric") == "aggregate_bls_verifications_per_sec":
-            return rec
-    return {"note": "bls chain bench produced no metric line"}
+        if isinstance(rec, dict) and "metric" in rec:
+            recs.append(rec)
+    if not any(r["metric"] == "aggregate_bls_verifications_per_sec" for r in recs):
+        return recs, "produced no metric line"
+    return recs, None
+
+
+def _bench_bls() -> tuple[list[dict], str | None]:
+    """Run the chain bench with retries: a cold-cache timeout still saved
+    its compiled programs to .aot_cache, so the retry resumes from them
+    instead of starting over (the round-2 failure mode was one attempt
+    with no resume)."""
+    budget = float(os.environ.get("BENCH_BLS_BUDGET_S", "1500"))
+    attempts = int(os.environ.get("BENCH_BLS_ATTEMPTS", "2"))
+    notes = []
+    recs: list[dict] = []
+    for i in range(attempts):
+        recs, err = _bls_attempt(budget)
+        if err is None:
+            return recs, None
+        notes.append(f"attempt {i + 1}: {err}")
+    # keep the last attempt's partial records (e.g. the smoke verdicts
+    # from a run that died before the throughput line)
+    return recs, "; ".join(notes) or "disabled (BENCH_BLS_ATTEMPTS=0)"
 
 
 def main() -> None:
@@ -115,16 +140,24 @@ def main() -> None:
         "vs_baseline": round(device_hps / host_hps, 2),
     }
 
-    bls = _bench_bls(float(os.environ.get("BENCH_BLS_BUDGET_S", "1500")))
-    if "value" not in bls:
+    bls_recs, err = _bench_bls()
+    if err is not None:
         # headline stays the SSZ metric; record the failure honestly
         print(json.dumps({"metric": "aggregate_bls_verifications_per_sec",
                           "value": None,
-                          "unit": "aggregate verifications/s", **bls}))
+                          "unit": "aggregate verifications/s",
+                          "note": f"bls chain bench failed: {err}"}))
+        for rec in bls_recs:  # partial records (e.g. smoke) still count
+            print(json.dumps(rec))
         print(json.dumps(ssz_line))
     else:
         print(json.dumps(ssz_line))
-        print(json.dumps(bls))
+        for rec in bls_recs:
+            if rec["metric"] != "aggregate_bls_verifications_per_sec":
+                print(json.dumps(rec))
+        for rec in bls_recs:
+            if rec["metric"] == "aggregate_bls_verifications_per_sec":
+                print(json.dumps(rec))
 
 
 if __name__ == "__main__":

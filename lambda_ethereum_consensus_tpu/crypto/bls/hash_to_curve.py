@@ -207,10 +207,36 @@ def clear_cofactor(pt: AffinePoint) -> AffinePoint:
 
 def hash_to_g2(msg: bytes, dst: bytes = DST_POP) -> AffinePoint:
     """hash_to_curve for G2 (random-oracle variant)."""
+    from . import native
+
+    if native.hash_available():
+        out = native.hash_to_g2_batch([msg], dst)
+        if out is not None:
+            return out[0]
     u0, u1 = hash_to_field_fq2(msg, 2, dst)
     q0 = iso_map(_sswu(u0))
     q1 = iso_map(_sswu(u1))
     return clear_cofactor(g2.affine_add(q0, q1))
+
+
+def native_hash_available() -> bool:
+    from . import native
+
+    return native.hash_available()
+
+
+def hash_to_g2_many(msgs, dst: bytes = DST_POP) -> list[AffinePoint]:
+    """Batch hash_to_g2: the C++ backend hashes messages across a thread
+    pool (~100x the Python path — the reference always has blst's native
+    h2c, ref native/bls_nif/src/lib.rs:33-47); falls back to the Python
+    pipeline per message."""
+    from . import native
+
+    if msgs and native.hash_available():
+        out = native.hash_to_g2_batch(list(msgs), dst)
+        if out is not None:
+            return out
+    return [hash_to_g2(m, dst) for m in msgs]
 
 
 # ----------------------------------------------------- import self-checks

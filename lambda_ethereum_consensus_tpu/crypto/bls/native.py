@@ -49,6 +49,31 @@ def _load():
     lib.bls381_fp_powmod.argtypes = [
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
     ]
+    # newer entry points — probe so an older .so still loads
+    try:
+        lib.bls381_hash_to_g2_batch.restype = None
+        lib.bls381_hash_to_g2_batch.argtypes = [
+            ctypes.c_char_p,                      # msgs, concatenated
+            ctypes.POINTER(ctypes.c_size_t),      # per-message lengths
+            ctypes.c_size_t,                      # n
+            ctypes.c_char_p, ctypes.c_size_t,     # dst
+            ctypes.c_char_p,                      # out: n * 192 bytes
+            ctypes.c_int,                         # nthreads (0 = auto)
+        ]
+        lib.bls381_rlc_verify.restype = ctypes.c_int
+        lib.bls381_rlc_verify.argtypes = [
+            ctypes.c_char_p,                      # pks: n * 96
+            ctypes.c_char_p,                      # sigs: n * 192
+            ctypes.c_char_p,                      # coeffs: n * coeff_len
+            ctypes.c_size_t,                      # coeff_len
+            ctypes.POINTER(ctypes.c_int32),       # group id per entry
+            ctypes.c_size_t,                      # n entries
+            ctypes.c_char_p,                      # h_points: n_groups * 192
+            ctypes.c_size_t,                      # n_groups
+            ctypes.c_int,                         # nthreads (0 = auto)
+        ]
+    except AttributeError:
+        pass
     lib.bls381_init()
     return lib
 
@@ -127,3 +152,50 @@ def g2_mul(pt, scalar: int):
         out, _g2_bytes(pt), scalar.to_bytes(nbytes, "big"), nbytes, ctypes.byref(is_inf)
     )
     return None if is_inf.value else _g2_from(out.raw)
+
+
+def hash_available() -> bool:
+    return _LIB is not None and hasattr(_LIB, "bls381_hash_to_g2_batch")
+
+
+def hash_to_g2_batch(msgs: list[bytes], dst: bytes):
+    """Batch hash_to_g2 across a C++ thread pool; None when unavailable."""
+    if not hash_available():
+        return None
+    n = len(msgs)
+    lens = (ctypes.c_size_t * n)(*[len(m) for m in msgs])
+    out = ctypes.create_string_buffer(192 * n)
+    _LIB.bls381_hash_to_g2_batch(b"".join(msgs), lens, n, dst, len(dst), out, 0)
+    return [_g2_from(out.raw[i * 192 : (i + 1) * 192]) for i in range(n)]
+
+
+def rlc_available() -> bool:
+    return _LIB is not None and hasattr(_LIB, "bls381_rlc_verify")
+
+
+def rlc_verify(entries, h_points, group_ids, coeff_bits: int = 128) -> bool:
+    """One RLC pairing-product check fully in C++ (the reference's blst
+    batch role, ref native/bls_nif/src/lib.rs:14-158):
+
+        prod_g e(sum_{i in g} r_i pk_i, H_g) * e(-g1, sum_i r_i sig_i) == 1
+
+    entries: [(pk_xy, sig_xy, coeff)]; h_points: one G2 point per group;
+    group_ids: per-entry group index.  Points must be on-curve and
+    subgroup-checked by the caller (same contract as chain_verify).
+    """
+    if not rlc_available():
+        return None
+    n = len(entries)
+    if n == 0:
+        return True
+    coeff_len = (coeff_bits + 7) // 8
+    pks = b"".join(_g1_bytes(pk) for pk, _, _ in entries)
+    sigs = b"".join(_g2_bytes(sig) for _, sig, _ in entries)
+    coeffs = b"".join(c.to_bytes(coeff_len, "big") for _, _, c in entries)
+    gids = (ctypes.c_int32 * n)(*group_ids)
+    hbuf = b"".join(_g2_bytes(h) for h in h_points)
+    return bool(
+        _LIB.bls381_rlc_verify(
+            pks, sigs, coeffs, coeff_len, gids, n, hbuf, len(h_points), 0
+        )
+    )

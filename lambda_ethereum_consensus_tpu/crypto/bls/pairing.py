@@ -189,9 +189,14 @@ def pairing_check(pairs: list[tuple[AffinePoint, AffinePoint]]) -> bool:
             # not a loud failure like the host loop's vertical-line
             # handling).  Callers must decode with subgroup_check on —
             # this opt-in probe catches a caller that didn't (ADVICE r1).
-            assert all(
+            # `raise`, not `assert`: the probe must survive python -O
+            # (ADVICE r2).
+            if not all(
                 g1.in_subgroup(p) and g2.in_subgroup(q) for p, q in live
-            ), "device pairing requires subgroup-checked points"
+            ):
+                raise ValueError(
+                    "device pairing requires subgroup-checked points"
+                )
         from ...ops.bls_pairing import pairing_product_is_one
 
         return pairing_product_is_one(live)

@@ -122,7 +122,14 @@ class _SymmetricState:
 
 
 def _dh(priv: X25519PrivateKey, pub_bytes: bytes) -> bytes:
-    return priv.exchange(X25519PublicKey.from_public_bytes(pub_bytes))
+    out = priv.exchange(X25519PublicKey.from_public_bytes(pub_bytes))
+    # contributory-behavior check: a low-order public point yields the
+    # all-zero shared secret and attacker-predictable session keys;
+    # `cryptography` rejects some such points but not all across versions
+    # (ADVICE r2)
+    if out == b"\x00" * 32:
+        raise NoiseError("low-order X25519 public key (all-zero DH output)")
+    return out
 
 
 def _pub(priv: X25519PrivateKey) -> bytes:
@@ -130,6 +137,16 @@ def _pub(priv: X25519PrivateKey) -> bytes:
 
     return priv.public_key().public_bytes(
         serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+
+
+def _priv_bytes(priv: X25519PrivateKey) -> bytes:
+    from cryptography.hazmat.primitives import serialization
+
+    return priv.private_bytes(
+        serialization.Encoding.Raw,
+        serialization.PrivateFormat.Raw,
+        serialization.NoEncryption(),
     )
 
 

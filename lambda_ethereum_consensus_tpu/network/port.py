@@ -59,11 +59,16 @@ class Port:
         bootnodes: list[str] | None = None,
         fork_digest: bytes = b"",
         enable_peer_exchange: bool = True,
+        key_file: str | None = None,
     ) -> "Port":
         self = cls()
         env = dict(os.environ)
         # the sidecar is pure-asyncio; keep accelerators out of it
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if key_file:
+            # persistent noise identity: without it, a restart rotates the
+            # static key and a graylisted peer sheds its ban (ADVICE r2)
+            env.setdefault("SIDECAR_KEY_FILE", key_file)
         self._proc = await asyncio.create_subprocess_exec(
             sys.executable,
             "-m",

@@ -45,13 +45,22 @@ MESH_D_LO = 6
 MESH_D_HI = 12
 HEARTBEAT_S = 0.7
 # Verdict-fed scoring: REJECT is a protocol violation; scores decay
-# toward 0 each heartbeat so old behavior washes out.
+# toward 0 each heartbeat so old behavior washes out.  Negative scores
+# decay far slower (ADVICE r2: 0.95/0.7s forgave a graylist in ~15 s;
+# the reference retains negative scores for ~100 epochs) — at 0.9995 a
+# -120 graylist stays below the -40 prune bar for ~25 min.
 ACCEPT_REWARD = 1.0
 REJECT_PENALTY = 40.0
 SCORE_DECAY = 0.95
+BAN_DECAY = 0.9995
 MAX_SCORE = 100.0
 PRUNE_SCORE = -40.0     # below: never grafted, pruned from meshes
 GRAYLIST_SCORE = -80.0  # below: disconnected outright
+# Topic-scoped peer exchange cadence (in heartbeats): subscribers of a
+# topic are introduced to each other even when the local node does not
+# subscribe, so a relay-only middle node cannot partition that topic
+# (ADVICE r2 — real gossipsub heals such gaps with control traffic).
+SUBSCRIBER_PX_EVERY = 10
 
 
 def _msg_id(topic: str, payload: bytes) -> bytes:
@@ -111,7 +120,40 @@ class Sidecar:
                     X25519PrivateKey,
                 )
 
-                self.noise_static = X25519PrivateKey.generate()
+                # identity persists across restarts (SIDECAR_KEY_FILE):
+                # key rotation must cost more than a process restart or a
+                # graylisted peer evades its ban by restarting (ADVICE r2)
+                key_file = os.environ.get("SIDECAR_KEY_FILE")
+                if key_file and os.path.exists(key_file):
+                    try:
+                        with open(key_file, "rb") as fh:
+                            self.noise_static = (
+                                X25519PrivateKey.from_private_bytes(fh.read(32))
+                            )
+                    except ValueError:
+                        # corrupt/truncated key file: regenerate below — a
+                        # parse error must rotate the identity, never
+                        # silently downgrade the node to plaintext
+                        print(
+                            f"sidecar: corrupt key file {key_file}; "
+                            "regenerating identity",
+                            file=sys.stderr,
+                            flush=True,
+                        )
+                if self.noise_static is None:
+                    self.noise_static = X25519PrivateKey.generate()
+                    if key_file:
+                        from .noise import _priv_bytes
+
+                        # atomic write: a crash mid-write must not leave a
+                        # short file for the next start to trip over
+                        tmp = f"{key_file}.tmp.{os.getpid()}"
+                        fd = os.open(
+                            tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600
+                        )
+                        with os.fdopen(fd, "wb") as fh:
+                            fh.write(_priv_bytes(self.noise_static))
+                        os.replace(tmp, key_file)
             except Exception as e:  # cryptography unavailable
                 # loud fallback: a silently-plaintext node can't talk to a
                 # noise-on fleet (10 s handshake stalls on every connect)
@@ -441,20 +483,56 @@ class Sidecar:
                     await self._send_control(peer, "prune", topic)
 
     async def _heartbeat_loop(self) -> None:
+        beats = 0
         while True:
             await asyncio.sleep(HEARTBEAT_S)
+            beats += 1
             for peer in list(self.peers.values()):
-                peer.score *= SCORE_DECAY
+                peer.score *= SCORE_DECAY if peer.score >= 0 else BAN_DECAY
                 if peer.score < GRAYLIST_SCORE:
                     await self._disconnect(peer)
-            # off-line penalties decay too; forgiven once above the
-            # prune threshold
+            # off-line penalties decay too (slowly); forgiven once above
+            # the prune threshold
             for nid in list(self.ban_scores):
-                self.ban_scores[nid] *= SCORE_DECAY
+                self.ban_scores[nid] *= BAN_DECAY
                 if self.ban_scores[nid] > PRUNE_SCORE:
                     del self.ban_scores[nid]
             for topic in list(self.subscriptions):
                 await self._mesh_maintain(topic)
+            if self.enable_peer_exchange and beats % SUBSCRIBER_PX_EVERY == 0:
+                await self._subscriber_px()
+
+    async def _subscriber_px(self) -> None:
+        """Introduce announced subscribers of each topic to one another.
+
+        Mesh routing only relays topics the local node subscribes to, so
+        two subscribers whose only path runs through a non-subscribing
+        relay would stay partitioned; this control traffic lets them dial
+        each other directly (the role PRUNE-PX / IHAVE play in gossipsub
+        v1.1, subscriptions.go:31-77)."""
+        by_topic: dict[str, list[Peer]] = {}
+        for p in self.peers.values():
+            for t in p.topics:
+                by_topic.setdefault(t, []).append(p)
+        intros: dict[bytes, set[str]] = {}
+        for subs in by_topic.values():
+            if len(subs) < 2:
+                continue
+            addrs = {p.addr for p in subs if p.addr}
+            for p in subs:
+                others = addrs - {p.addr}
+                if others:
+                    intros.setdefault(p.node_id, set()).update(others)
+        for nid, addrs in intros.items():
+            peer = self.peers.get(nid)
+            if peer is None:
+                continue
+            frame = p2p_pb2.P2PFrame()
+            frame.peer_exchange.addrs.extend(sorted(addrs))
+            try:
+                await peer.send_frame(frame)
+            except (OSError, ConnectionError):
+                pass
 
     async def _disconnect(self, peer: Peer) -> None:
         frame = p2p_pb2.P2PFrame()
@@ -547,7 +625,9 @@ class Sidecar:
                 return
             peer.score -= REJECT_PENALTY
             if peer.score <= PRUNE_SCORE:
-                for topic, members in self.mesh.items():
+                # snapshot: _send_control awaits, and a concurrent GRAFT /
+                # subscribe may insert a mesh key mid-iteration (ADVICE r2)
+                for topic, members in list(self.mesh.items()):
                     if source in members:
                         members.discard(source)
                         # tell the remote: a silent local discard leaves

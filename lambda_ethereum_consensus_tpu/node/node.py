@@ -196,6 +196,8 @@ class BeaconNode:
             listen_addr=self.config.listen_addr,
             bootnodes=self.config.bootnodes,
             fork_digest=digest,
+            # noise identity survives restarts: bans stay bound to the key
+            key_file=self.config.db_path + ".sidecar_key",
         )
         self.port.on_new_peer = self._on_new_peer
         self.port.on_peer_gone = self._on_peer_gone

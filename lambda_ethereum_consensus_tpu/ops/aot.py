@@ -1,0 +1,156 @@
+"""AOT executable cache: serialize compiled XLA programs across processes.
+
+The axon-tunneled TPU charges minutes per XLA compile and JAX's persistent
+compilation cache does not reliably key-match across processes on this
+tunnel (identical programs recompile — see ARCHITECTURE.md).  This module
+sidesteps JAX's cache-key computation entirely: each jitted function is
+lowered+compiled once per argument-shape signature, the compiled PjRt
+executable is pickled via ``jax.experimental.serialize_executable``, and
+any later process deserializes it in milliseconds instead of recompiling.
+
+Keys are OURS (stable): function name + flattened arg shapes/dtypes +
+backend + device kind + jax version.  Any load/serialize failure falls
+back to a normal in-memory compile, so this layer can never make a result
+wrong — only a cold start slower.
+
+Role in the reference mapping: the reference's NIF .so files are its
+"compile once, load forever" boundary (ref: native/bls_nif/src/lib.rs:147-158);
+this cache is the TPU build's equivalent for XLA programs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+
+__all__ = ["aot_jit", "aot_dir", "aot_stats"]
+
+_LOCK = threading.Lock()
+_STATS = {"loads": 0, "compiles": 0, "saves": 0, "errors": 0}
+
+
+def aot_dir() -> str | None:
+    """Cache directory, or None when disabled (BLS_NO_AOT=1)."""
+    if os.environ.get("BLS_NO_AOT"):
+        return None
+    d = os.environ.get("BLS_AOT_DIR")
+    if d is None:
+        d = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ".aot_cache",
+        )
+    return d
+
+
+def aot_stats() -> dict:
+    return dict(_STATS)
+
+
+def _env_tag() -> str:
+    import jax
+
+    devs = jax.devices()
+    return (
+        f"{jax.__version__}-{jax.default_backend()}-"
+        f"{devs[0].device_kind}-n{len(devs)}"
+    )
+
+
+def _sig(args) -> str:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        parts.append(f"{shape}:{dtype}")
+    return "|".join(parts)
+
+
+def aot_jit(fn, name: str):
+    """Wrap a ``jax.jit``-ed callable with a per-shape AOT executable cache.
+
+    ``fn`` must support ``.lower(*args)`` (any jitted function does).  The
+    wrapper keeps one loaded/compiled executable per argument signature in
+    memory and one pickle per signature on disk.
+    """
+    compiled_by_sig: dict = {}
+
+    def call(*args):
+        sig = _sig(args)
+        hit = compiled_by_sig.get(sig)
+        if hit is not None:
+            return hit(*args)
+
+        # Trace/lower first (seconds even for the big programs — the
+        # minutes are all in the compile): the disk key hashes the lowered
+        # HLO, so a SOURCE change to the function can never serve the
+        # stale pre-change executable (code identity, not just shapes).
+        try:
+            lowered = fn.lower(*args)
+        except Exception:
+            # functions the lowering path can't handle (e.g. non-jitted
+            # callables slipped in) just run directly, uncached
+            compiled_by_sig[sig] = fn
+            return fn(*args)
+
+        base = aot_dir()
+        path = None
+        if base is not None:
+            try:
+                code_id = hashlib.sha256(
+                    lowered.as_text().encode()
+                ).hexdigest()[:16]
+            except Exception:
+                code_id = "nohlo"
+            key = hashlib.sha256(
+                f"{name}||{_env_tag()}||{sig}||{code_id}".encode()
+            ).hexdigest()[:32]
+            path = os.path.join(base, f"{name}-{key}.aot")
+
+        # 1) disk hit: deserialize (ms) instead of compiling (minutes)
+        if path is not None and os.path.exists(path):
+            try:
+                from jax.experimental.serialize_executable import (
+                    deserialize_and_load,
+                )
+
+                with open(path, "rb") as fh:
+                    payload, in_tree, out_tree = pickle.load(fh)
+                loaded = deserialize_and_load(payload, in_tree, out_tree)
+                with _LOCK:
+                    _STATS["loads"] += 1
+                compiled_by_sig[sig] = loaded
+                return loaded(*args)
+            except Exception:
+                with _LOCK:
+                    _STATS["errors"] += 1
+                # fall through to a fresh compile
+
+        # 2) compile (and best-effort persist)
+        compiled = lowered.compile()
+        with _LOCK:
+            _STATS["compiles"] += 1
+        compiled_by_sig[sig] = compiled
+        if path is not None:
+            try:
+                from jax.experimental.serialize_executable import serialize
+
+                payload, in_tree, out_tree = serialize(compiled)
+                os.makedirs(base, exist_ok=True)
+                tmp = path + f".tmp.{os.getpid()}"
+                with open(tmp, "wb") as fh:
+                    pickle.dump((payload, in_tree, out_tree), fh)
+                os.replace(tmp, path)
+                with _LOCK:
+                    _STATS["saves"] += 1
+            except Exception:
+                with _LOCK:
+                    _STATS["errors"] += 1
+        return compiled(*args)
+
+    call.__name__ = f"aot_{name}"
+    return call

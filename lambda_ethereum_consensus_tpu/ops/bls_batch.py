@@ -79,7 +79,17 @@ def make_chain_ops(interpret: bool = False):
     g1j = make_jacobian_ops(g1f, eager=interpret)
     g2j = make_jacobian_ops(g2f, eager=interpret)
     pairing = get_pairing_ops(plane=True, interpret=interpret)
-    wrap = (lambda f: f) if interpret else jax.jit
+    if interpret:
+        wrap = lambda f, name=None: f
+    else:
+        from .aot import aot_jit
+
+        # every compiled program goes through the cross-process AOT
+        # executable cache — on this tunnel a compile costs minutes and
+        # JAX's own persistent cache misses across processes (ops/aot.py)
+        wrap = lambda f, name=None: aot_jit(
+            jax.jit(f), f"chain_{name or getattr(f, '__name__', 'fn')}"
+        )
 
     def ladder_g1(bx, by, kbits, live):
         X, Y, Z, inf = g1j["ladder"]((bx, by), kbits)
@@ -110,10 +120,10 @@ def make_chain_ops(interpret: bool = False):
     # program took >25 min to compile on the TPU backend, while each
     # piece below compiles in seconds and every intermediate stays on
     # device (no host pulls — the chain property that matters).
-    jadd1 = wrap(g1j["jac_add"])
-    jadd2 = wrap(g2j["jac_add"])
-    norm_g1_j = wrap(_norm_g1)
-    norm_g2_j = wrap(_norm_g2)
+    jadd1 = wrap(g1j["jac_add"], "jadd1")
+    jadd2 = wrap(g2j["jac_add"], "jadd2")
+    norm_g1_j = wrap(_norm_g1, "norm_g1")
+    norm_g2_j = wrap(_norm_g2, "norm_g2")
 
     def _tree_reduce_j(jadd, pt):
         X, Y, Z, inf = pt
@@ -156,8 +166,12 @@ def make_chain_ops(interpret: bool = False):
             pt = _scan_reduce(jac["jac_add"], pt)  # over s2 -> (..., s1)
         return _scan_reduce(jac["jac_add"], pt)
 
-    reduce_g1_j = wrap(lambda X, Y, Z, inf: _staged_reduce_last(g1j, (X, Y, Z, inf)))
-    reduce_g2_j = wrap(lambda X, Y, Z, inf: _staged_reduce_last(g2j, (X, Y, Z, inf)))
+    reduce_g1_j = wrap(
+        lambda X, Y, Z, inf: _staged_reduce_last(g1j, (X, Y, Z, inf)), "reduce_g1"
+    )
+    reduce_g2_j = wrap(
+        lambda X, Y, Z, inf: _staged_reduce_last(g2j, (X, Y, Z, inf)), "reduce_g2"
+    )
 
     def _reduce_last(which, pt):
         """interpret: eager pairwise tree (loops can't stage); compiled:
@@ -231,8 +245,8 @@ def make_chain_ops(interpret: bool = False):
         return norm_g1_j(X, Y, Z)
 
     return {
-        "ladder_g1": wrap(ladder_g1),
-        "ladder_g2": wrap(ladder_g2),
+        "ladder_g1": wrap(ladder_g1, "ladder_g1"),
+        "ladder_g2": wrap(ladder_g2, "ladder_g2"),
         # host-composed (see comment above prep) — pieces are jitted
         "prep": prep,
         "finish": finish,

@@ -253,21 +253,31 @@ def make_pairing_ops(
     # pow_x_abs, easy_part via fp_inv, masked_product) stay host-composed
     # — staging their loops is exactly the giant-compile failure mode —
     # while the straight-line pieces still jit (one dispatch each).
-    wrap = (lambda f: f) if eager else jax.jit
+    if eager:
+        wrap = lambda f, name=None: f
+    else:
+        from .aot import aot_jit
+
+        # compiled programs go through the cross-process AOT executable
+        # cache (ops/aot.py) — the axon tunnel charges minutes/compile
+        tag = "plane" if plane else "einsum"
+        wrap = lambda f, name=None: aot_jit(
+            jax.jit(f), f"pair_{tag}_{name or getattr(f, '__name__', 'fn')}"
+        )
     jits = {
-        "miller": wrap(miller),
-        "pow_x_abs": wrap(pow_x_abs),
+        "miller": wrap(miller, "miller"),
+        "pow_x_abs": wrap(pow_x_abs, "pow_x_abs"),
         # easy_part is host-composed from inv/conj/frob/mul below on the
         # staged path (as one program it was a multi-hour axon compile);
         # the eager path keeps the direct composition
         "easy_part": easy_part if eager else None,
-        "inv": wrap(f12inv),
-        "masked_product": wrap(masked_product),
-        "mul": wrap(f12m),
-        "sq": wrap(f12sq),
-        "conj": wrap(f12conj),
-        "frob": wrap(f12frob),
-        "is_one": wrap(ops["fq12_is_one"]),
+        "inv": wrap(f12inv, "inv"),
+        "masked_product": wrap(masked_product, "masked_product"),
+        "mul": wrap(f12m, "mul"),
+        "sq": wrap(f12sq, "sq"),
+        "conj": wrap(f12conj, "conj"),
+        "frob": wrap(f12frob, "frob"),
+        "is_one": wrap(ops["fq12_is_one"], "is_one"),
     }
 
     def pow_x(a):

@@ -14,6 +14,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 using u64 = uint64_t;
 using u128 = __uint128_t;
@@ -911,6 +913,651 @@ static void final_exponentiation(Fq12& o, const Fq12& f_in) {
     fq12_mul(o, d, m2);
 }
 
+// ------------------------------------------------------------- SHA-256
+// FIPS 180-4, for expand_message_xmd.  Self-contained (no OpenSSL dep);
+// the constants are the published round constants.
+
+struct Sha256 {
+    uint32_t h[8];
+    uint8_t buf[64];
+    uint64_t len;
+    size_t fill;
+};
+
+static const uint32_t SHA_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+static inline uint32_t ror32(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+static void sha256_init(Sha256& s) {
+    static const uint32_t H0[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+    };
+    memcpy(s.h, H0, sizeof(H0));
+    s.len = 0;
+    s.fill = 0;
+}
+
+static void sha256_block(Sha256& s, const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)p[4 * i] << 24) | ((uint32_t)p[4 * i + 1] << 16) |
+               ((uint32_t)p[4 * i + 2] << 8) | p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ror32(w[i - 15], 7) ^ ror32(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = ror32(w[i - 2], 17) ^ ror32(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = s.h[0], b = s.h[1], c = s.h[2], d = s.h[3];
+    uint32_t e = s.h[4], f = s.h[5], g = s.h[6], hh = s.h[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = ror32(e, 6) ^ ror32(e, 11) ^ ror32(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = hh + S1 + ch + SHA_K[i] + w[i];
+        uint32_t S0 = ror32(a, 2) ^ ror32(a, 13) ^ ror32(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + maj;
+        hh = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    s.h[0] += a; s.h[1] += b; s.h[2] += c; s.h[3] += d;
+    s.h[4] += e; s.h[5] += f; s.h[6] += g; s.h[7] += hh;
+}
+
+static void sha256_update(Sha256& s, const uint8_t* data, size_t n) {
+    s.len += n;
+    if (s.fill) {
+        size_t take = 64 - s.fill;
+        if (take > n) take = n;
+        memcpy(s.buf + s.fill, data, take);
+        s.fill += take;
+        data += take;
+        n -= take;
+        if (s.fill == 64) {
+            sha256_block(s, s.buf);
+            s.fill = 0;
+        }
+    }
+    while (n >= 64) {
+        sha256_block(s, data);
+        data += 64;
+        n -= 64;
+    }
+    if (n) {
+        memcpy(s.buf, data, n);
+        s.fill = n;
+    }
+}
+
+static void sha256_final(Sha256& s, uint8_t out[32]) {
+    uint64_t bitlen = s.len * 8;
+    uint8_t pad = 0x80;
+    sha256_update(s, &pad, 1);
+    uint8_t zero = 0;
+    while (s.fill != 56) sha256_update(s, &zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = (uint8_t)(bitlen >> (56 - 8 * i));
+    sha256_update(s, lenb, 8);
+    for (int i = 0; i < 8; i++) {
+        out[4 * i] = (uint8_t)(s.h[i] >> 24);
+        out[4 * i + 1] = (uint8_t)(s.h[i] >> 16);
+        out[4 * i + 2] = (uint8_t)(s.h[i] >> 8);
+        out[4 * i + 3] = (uint8_t)s.h[i];
+    }
+}
+
+// -------------------------------------------------------- hash_to_g2
+// The BLS12381G2_XMD:SHA-256_SSWU_RO ciphersuite (RFC 9380), mirroring
+// crypto/bls/hash_to_curve.py step for step: expand_message_xmd ->
+// hash_to_field(Fq2, 2) -> SSWU on E2' -> 3-isogeny -> add -> clear
+// cofactor.  The isogeny coefficients below are the ones the Python module
+// DERIVES at import time with Vélu's formulas (and checks against the
+// curve equations); they equal the RFC 9380 Appendix E.3 tables.  The
+// cross-test asserts byte-equality of this path vs the Python oracle.
+
+static Fq2 SSWU_A, SSWU_B, SSWU_Z;       // E2' params: A'=(0,240) B'=(1012,1012) Z=-(2+u)
+static Fq2 ISO_XN[4], ISO_XD[3], ISO_YN[4], ISO_YD[4];
+static Fp INV2;                          // 1/2
+static u64 P_PLUS_1_DIV_4[NLIMBS];       // fq sqrt exponent (p ≡ 3 mod 4)
+static Fp G1_GEN_NEG_X, G1_GEN_NEG_Y;    // -G1 generator (for RLC checks)
+static Fq2 PSI_CX, PSI_CY;               // G2 endomorphism ψ coefficients
+static Fq2 SSWU_NB_DIV_A, SSWU_B_DIV_ZA; // -B'/A', B'/(Z·A') precomputed
+
+// h_eff for G2 cofactor clearing (RFC 9380 §8.8.2), big-endian
+static const char* H_EFF_HEX =
+    "bc69f08f2ee75b3584c6a0ea91b352888e2a8e9145ad7689986ff031508ffe1329c2f1"
+    "78731db956d82bf015d1212b02ec0ec69d7477c1ae954cbc06689f6a359894c0adebbf"
+    "6b4e8020005aaa95551";
+static uint8_t H_EFF_BYTES[80];
+static size_t H_EFF_LEN = 0;
+
+// G1 generator, canonical affine coordinates (public curve constant)
+static const char* G1_GEN_X_HEX =
+    "17f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac586c55e8"
+    "3ff97a1aeffb3af00adb22c6bb";
+static const char* G1_GEN_Y_HEX =
+    "08b3f481e3aaa0f1a09e30ed741d8ae4fcf5e095d5d00af600db18cb2c04b3edd03cc7"
+    "44a2888ae40caa232946c5e7e1";
+
+// 3-isogeny E2' -> E2 coefficient tables (c0, c1 hex per Fq2; derived by
+// crypto/bls/hash_to_curve.py::_derive_isogeny, == RFC 9380 E.3)
+static const char* ISO_XN_HEX[] = {
+    "05c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6",
+    "05c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+    "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71a",
+    "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71e",
+    "08ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38d",
+    "171d6541fa38ccfaed6dea691f5fb614cb14b4e7f4e810aa22d6108f142b85757098e38d0f671c7188e2aaaaaaaa5ed1",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+};
+static const char* ISO_XD_HEX[] = {
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa63",
+    "00000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000c",
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa9f",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000001",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+};
+static const char* ISO_YN_HEX[] = {
+    "1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706",
+    "1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+    "05c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97be",
+    "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71c",
+    "08ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38f",
+    "124c9ad43b6cf79bfbf7043de3811ad0761b0f37a1e26286b0e977c69aa274524e79097a56dc4bd9e1b371c71c718b10",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+};
+static const char* ISO_YD_HEX[] = {
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb",
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa9d3",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000012",
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa99",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000001",
+    "000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000000",
+};
+
+static int hexval(char c) {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return 0;
+}
+
+static void fp_from_hex(Fp& out, const char* hex) {
+    uint8_t be[48];
+    for (int i = 0; i < 48; i++)
+        be[i] = (uint8_t)((hexval(hex[2 * i]) << 4) | hexval(hex[2 * i + 1]));
+    fp_from_bytes(out, be);
+}
+
+static void fq2_from_hex(Fq2& out, const char* c0, const char* c1) {
+    fp_from_hex(out.c0, c0);
+    fp_from_hex(out.c1, c1);
+}
+
+// canonical (non-Montgomery) limbs, for sgn0 / zero tests
+static void fp_canonical(u64 out[NLIMBS], const Fp& a) {
+    Fp one_raw = {{1, 0, 0, 0, 0, 0}};
+    Fp norm;
+    fp_mul(norm, a, one_raw);
+    memcpy(out, norm.l, sizeof(norm.l));
+}
+
+static int fq2_sgn0(const Fq2& x) {
+    u64 c0[NLIMBS], c1[NLIMBS];
+    fp_canonical(c0, x.c0);
+    fp_canonical(c1, x.c1);
+    int sign_0 = (int)(c0[0] & 1);
+    bool zero_0 = true;
+    for (int i = 0; i < NLIMBS; i++) zero_0 = zero_0 && c0[i] == 0;
+    int sign_1 = (int)(c1[0] & 1);
+    return sign_0 | ((zero_0 ? 1 : 0) & sign_1);
+}
+
+// sqrt in Fq (p ≡ 3 mod 4): a^((p+1)/4), verified by squaring
+static bool fq_sqrt(Fp& out, const Fp& a) {
+    Fp s, s2;
+    fp_pow(s, a, P_PLUS_1_DIV_4, NLIMBS);
+    fp_sq(s2, s);
+    if (!fp_eq(s2, a)) return false;
+    out = s;
+    return true;
+}
+
+// sqrt in Fq2 via the complex method (mirrors fields.py::fq2_sqrt)
+static bool fq2_sqrt(Fq2& out, const Fq2& a) {
+    if (fp_is_zero(a.c1)) {
+        Fp s;
+        if (fq_sqrt(s, a.c0)) {
+            out.c0 = s;
+            out.c1 = FP_ZERO;
+            return true;
+        }
+        Fp na;
+        fp_neg(na, a.c0);
+        if (fq_sqrt(s, na)) {
+            out.c0 = FP_ZERO;
+            out.c1 = s;
+            return true;
+        }
+        return false;
+    }
+    Fp alpha, t, s;
+    fp_sq(alpha, a.c0);
+    fp_sq(t, a.c1);
+    fp_add(alpha, alpha, t);  // norm
+    if (!fq_sqrt(s, alpha)) return false;
+    Fp delta, x0;
+    fp_add(delta, a.c0, s);
+    fp_mul(delta, delta, INV2);
+    if (!fq_sqrt(x0, delta)) {
+        fp_sub(delta, a.c0, s);
+        fp_mul(delta, delta, INV2);
+        if (!fq_sqrt(x0, delta)) return false;
+    }
+    Fp x0inv, x1;
+    fp_inv(x0inv, x0);
+    fp_mul(x1, a.c1, INV2);
+    fp_mul(x1, x1, x0inv);
+    Fq2 cand = {x0, x1}, sq;
+    fq2_sq(sq, cand);
+    if (!fq2_eq(sq, a)) return false;
+    out = cand;
+    return true;
+}
+
+static bool h2c_ready = false;
+
+static void h2c_init() {
+    if (h2c_ready) return;
+    // SSWU constants: A' = 240u, B' = 1012(1+u), Z = -(2+u)
+    Fp f240, f1012, f2c, f1c;
+    Fp raw240 = {{240, 0, 0, 0, 0, 0}};
+    Fp raw1012 = {{1012, 0, 0, 0, 0, 0}};
+    Fp raw2 = {{2, 0, 0, 0, 0, 0}};
+    Fp raw1 = {{1, 0, 0, 0, 0, 0}};
+    Fp r2;
+    memcpy(r2.l, R2, sizeof(R2));
+    fp_mul(f240, raw240, r2);
+    fp_mul(f1012, raw1012, r2);
+    fp_mul(f2c, raw2, r2);
+    fp_mul(f1c, raw1, r2);
+    SSWU_A.c0 = FP_ZERO;
+    SSWU_A.c1 = f240;
+    SSWU_B.c0 = f1012;
+    SSWU_B.c1 = f1012;
+    fp_neg(SSWU_Z.c0, f2c);
+    fp_neg(SSWU_Z.c1, f1c);
+    for (int i = 0; i < 4; i++)
+        fq2_from_hex(ISO_XN[i], ISO_XN_HEX[2 * i], ISO_XN_HEX[2 * i + 1]);
+    for (int i = 0; i < 3; i++)
+        fq2_from_hex(ISO_XD[i], ISO_XD_HEX[2 * i], ISO_XD_HEX[2 * i + 1]);
+    for (int i = 0; i < 4; i++)
+        fq2_from_hex(ISO_YN[i], ISO_YN_HEX[2 * i], ISO_YN_HEX[2 * i + 1]);
+    for (int i = 0; i < 4; i++)
+        fq2_from_hex(ISO_YD[i], ISO_YD_HEX[2 * i], ISO_YD_HEX[2 * i + 1]);
+    // INV2 = (p+1)/2 as a field element: inverse of 2
+    Fp two;
+    fp_add(two, FP_ONE, FP_ONE);
+    fp_inv(INV2, two);
+    // (p+1)/4
+    u64 pp1[NLIMBS];
+    memcpy(pp1, P, sizeof(P));
+    pp1[0] += 1;  // no carry: p ends ...aaab
+    u128 rem = 0;
+    for (int i = NLIMBS - 1; i >= 0; i--) {
+        u128 cur = (rem << 64) | pp1[i];
+        P_PLUS_1_DIV_4[i] = (u64)(cur / 4);
+        rem = cur % 4;
+    }
+    // h_eff bytes
+    size_t hl = strlen(H_EFF_HEX);
+    H_EFF_LEN = (hl + 1) / 2;
+    size_t off = 0;
+    if (hl % 2) {
+        H_EFF_BYTES[0] = (uint8_t)hexval(H_EFF_HEX[0]);
+        off = 1;
+    }
+    for (size_t i = off; i < H_EFF_LEN; i++)
+        H_EFF_BYTES[i] = (uint8_t)((hexval(H_EFF_HEX[2 * i - off]) << 4) |
+                                   hexval(H_EFF_HEX[2 * i + 1 - off]));
+    // -G1 generator
+    Fp gx, gy;
+    fp_from_hex(gx, G1_GEN_X_HEX);
+    fp_from_hex(gy, G1_GEN_Y_HEX);
+    G1_GEN_NEG_X = gx;
+    fp_neg(G1_GEN_NEG_Y, gy);
+    // ψ coefficients from the pairing's tower constants (see above)
+    fq2_inv(PSI_CX, G6_1);
+    Fq2 g12sq, g12cu;
+    fq2_sq(g12sq, G12);
+    fq2_mul(g12cu, g12sq, G12);  // ξ^((p-1)/2)
+    fq2_inv(PSI_CY, g12cu);
+    // SSWU per-call inversions hoisted to constants
+    Fq2 ainv, za, zainv, nb;
+    fq2_inv(ainv, SSWU_A);
+    fq2_neg(nb, SSWU_B);
+    fq2_mul(SSWU_NB_DIV_A, nb, ainv);
+    fq2_mul(za, SSWU_Z, SSWU_A);
+    fq2_inv(zainv, za);
+    fq2_mul(SSWU_B_DIV_ZA, SSWU_B, zainv);
+    h2c_ready = true;
+}
+
+// 64 big-endian bytes -> Fq (RFC 9380 hash_to_field's mod-p reduction):
+// value = hi * 2^384 + lo, with mont(2^384) = R2 limbs as a field element
+static void fp_from_wide(Fp& out, const uint8_t* be64) {
+    Fp lo_raw;
+    for (int i = 0; i < NLIMBS; i++) {
+        u64 limb = 0;
+        for (int b = 0; b < 8; b++)
+            limb = (limb << 8) | be64[16 + (NLIMBS - 1 - i) * 8 + b];
+        lo_raw.l[i] = limb;
+    }
+    // reduce the raw 384-bit value below p (at most ~8 subtractions)
+    while (fp_cmp_p(lo_raw) >= 0) {
+        u64 borrow = 0;
+        for (int i = 0; i < NLIMBS; i++) {
+            u128 cur = (u128)lo_raw.l[i] - P[i] - borrow;
+            lo_raw.l[i] = (u64)cur;
+            borrow = (cur >> 64) ? 1 : 0;
+        }
+    }
+    Fp hi_raw = {{0, 0, 0, 0, 0, 0}};
+    for (int i = 0; i < 2; i++) {
+        u64 limb = 0;
+        for (int b = 0; b < 8; b++) limb = (limb << 8) | be64[(1 - i) * 8 + b];
+        hi_raw.l[i] = limb;
+    }
+    Fp r2, lo_m, hi_m, t;
+    memcpy(r2.l, R2, sizeof(R2));
+    fp_mul(lo_m, lo_raw, r2);
+    fp_mul(hi_m, hi_raw, r2);
+    fp_mul(t, hi_m, r2);  // * mont(2^384)
+    fp_add(out, t, lo_m);
+}
+
+// expand_message_xmd with SHA-256 (RFC 9380 §5.3.1), fixed 256-byte output
+static void expand_message_xmd_256(const uint8_t* msg, size_t msg_len,
+                                   const uint8_t* dst, size_t dst_len,
+                                   uint8_t out[256]) {
+    uint8_t dst_hashed[32];
+    uint8_t dst_prime[256 + 1];
+    size_t dst_prime_len;
+    if (dst_len > 255) {
+        Sha256 s;
+        sha256_init(s);
+        const char* prefix = "H2C-OVERSIZE-DST-";
+        sha256_update(s, (const uint8_t*)prefix, strlen(prefix));
+        sha256_update(s, dst, dst_len);
+        sha256_final(s, dst_hashed);
+        memcpy(dst_prime, dst_hashed, 32);
+        dst_prime[32] = 32;
+        dst_prime_len = 33;
+    } else {
+        memcpy(dst_prime, dst, dst_len);
+        dst_prime[dst_len] = (uint8_t)dst_len;
+        dst_prime_len = dst_len + 1;
+    }
+    const size_t len_in_bytes = 256;  // 2 field elements x 2 components x 64B
+    uint8_t z_pad[64];
+    memset(z_pad, 0, sizeof(z_pad));
+    uint8_t l_i_b[2] = {(uint8_t)(len_in_bytes >> 8), (uint8_t)len_in_bytes};
+    uint8_t b0[32], bi[32];
+    Sha256 s;
+    sha256_init(s);
+    sha256_update(s, z_pad, 64);
+    sha256_update(s, msg, msg_len);
+    sha256_update(s, l_i_b, 2);
+    uint8_t zero = 0;
+    sha256_update(s, &zero, 1);
+    sha256_update(s, dst_prime, dst_prime_len);
+    sha256_final(s, b0);
+    uint8_t ctr = 1;
+    sha256_init(s);
+    sha256_update(s, b0, 32);
+    sha256_update(s, &ctr, 1);
+    sha256_update(s, dst_prime, dst_prime_len);
+    sha256_final(s, bi);
+    memcpy(out, bi, 32);
+    for (int i = 2; i <= 8; i++) {
+        uint8_t mixed[32];
+        for (int j = 0; j < 32; j++) mixed[j] = b0[j] ^ bi[j];
+        ctr = (uint8_t)i;
+        sha256_init(s);
+        sha256_update(s, mixed, 32);
+        sha256_update(s, &ctr, 1);
+        sha256_update(s, dst_prime, dst_prime_len);
+        sha256_final(s, bi);
+        memcpy(out + 32 * (i - 1), bi, 32);
+    }
+}
+
+// simplified SWU for AB != 0 onto E2' (RFC 9380 §6.6.2)
+static void sswu(Fq2& out_x, Fq2& out_y, const Fq2& u) {
+    Fq2 u2, zu2, tv, x1, gx1, y;
+    fq2_sq(u2, u);
+    fq2_mul(zu2, SSWU_Z, u2);
+    Fq2 zu2sq;
+    fq2_sq(zu2sq, zu2);
+    fq2_add(tv, zu2sq, zu2);
+    if (fq2_is_zero(tv)) {
+        x1 = SSWU_B_DIV_ZA;
+    } else {
+        Fq2 tv1, one_plus;
+        fq2_inv(tv1, tv);
+        Fq2 one = {FP_ONE, FP_ZERO};
+        fq2_add(one_plus, one, tv1);
+        fq2_mul(x1, SSWU_NB_DIV_A, one_plus);
+    }
+    Fq2 x1sq, x1cu, ax, t;
+    fq2_sq(x1sq, x1);
+    fq2_mul(x1cu, x1sq, x1);
+    fq2_mul(ax, SSWU_A, x1);
+    fq2_add(t, x1cu, ax);
+    fq2_add(gx1, t, SSWU_B);
+    Fq2 x;
+    if (fq2_sqrt(y, gx1)) {
+        x = x1;
+    } else {
+        fq2_mul(x, zu2, x1);
+        Fq2 xsq, xcu, ax2, gx2;
+        fq2_sq(xsq, x);
+        fq2_mul(xcu, xsq, x);
+        fq2_mul(ax2, SSWU_A, x);
+        fq2_add(t, xcu, ax2);
+        fq2_add(gx2, t, SSWU_B);
+        fq2_sqrt(y, gx2);  // must exist (one of gx1/gx2 is square)
+    }
+    if (fq2_sgn0(u) != fq2_sgn0(y)) {
+        Fq2 ny;
+        fq2_neg(ny, y);
+        y = ny;
+    }
+    out_x = x;
+    out_y = y;
+}
+
+static void fq2_horner(Fq2& out, const Fq2* coeffs, int n, const Fq2& x) {
+    Fq2 acc = coeffs[n - 1];
+    for (int i = n - 2; i >= 0; i--) {
+        Fq2 t;
+        fq2_mul(t, acc, x);
+        fq2_add(acc, t, coeffs[i]);
+    }
+    out = acc;
+}
+
+// 3-isogeny E2' -> E2; false -> point at infinity (denominator vanished)
+static bool iso_map_e2(Fq2& ox, Fq2& oy, const Fq2& x, const Fq2& y) {
+    Fq2 xn, xd, yn, yd;
+    fq2_horner(xn, ISO_XN, 4, x);
+    fq2_horner(xd, ISO_XD, 3, x);
+    fq2_horner(yn, ISO_YN, 4, x);
+    fq2_horner(yd, ISO_YD, 4, x);
+    if (fq2_is_zero(xd) || fq2_is_zero(yd)) return false;
+    // one inversion for both denominators (Montgomery trick)
+    Fq2 prod, prod_inv, xdi, ydi, t;
+    fq2_mul(prod, xd, yd);
+    fq2_inv(prod_inv, prod);
+    fq2_mul(xdi, prod_inv, yd);
+    fq2_mul(ydi, prod_inv, xd);
+    fq2_mul(ox, xn, xdi);
+    fq2_mul(t, yn, ydi);
+    fq2_mul(oy, y, t);
+    return true;
+}
+
+// ---- fast cofactor clearing via the G2 endomorphism ψ -----------------
+// ψ = twist ∘ Frobenius ∘ untwist on the M-twist: ψ(x, y) =
+// (conj(x)·ξ^-(p-1)/3, conj(y)·ξ^-(p-1)/2) — the coefficients fall out of
+// the SAME tower constants the pairing already computes (G6_1, G12), so
+// nothing new is transcribed.  RFC 9380 §8.8.2 picked h_eff so that the
+// Budroni–Pintore chain [x²-x-1]P + [x-1]ψ(P) + ψ²([2]P) equals
+// [h_eff]P exactly; the cross-tests pin this equality against the Python
+// h_eff oracle.
+
+static void g2j_psi(G2J& o, const G2J& p) {
+    Fq2 t;
+    fq2_conj(t, p.x);
+    fq2_mul(o.x, t, PSI_CX);
+    fq2_conj(t, p.y);
+    fq2_mul(o.y, t, PSI_CY);
+    fq2_conj(o.z, p.z);
+}
+
+static void g2j_neg(G2J& o, const G2J& p) {
+    o.x = p.x;
+    fq2_neg(o.y, p.y);
+    o.z = p.z;
+}
+
+// multiply by |x| = 0xd201000000010000 (6 set bits -> 63 doubles + 5 adds)
+static void g2j_mul_x_abs(G2J& o, const G2J& p) {
+    G2J acc = p;  // top bit consumed by starting at the base
+    for (int bit = 62; bit >= 0; bit--) {
+        G2J t;
+        g2_double(t, acc);
+        acc = t;
+        if ((BLS_X >> bit) & 1) {
+            g2_add(t, acc, p);
+            acc = t;
+        }
+    }
+    o = acc;
+}
+
+static void g2j_clear_cofactor(G2J& out, const G2J& p) {
+    G2J xa, a, b, t, acc;
+    g2j_mul_x_abs(xa, p);
+    g2j_neg(a, xa);       // a = [x]P (x negative)
+    g2j_mul_x_abs(xa, a);
+    g2j_neg(b, xa);       // b = [x²]P
+    G2J na, np, psia, psip, npsip, two_p, psi2;
+    g2j_neg(na, a);
+    g2j_neg(np, p);
+    g2j_psi(psia, a);     // [x]ψ(P)
+    g2j_psi(psip, p);
+    g2j_neg(npsip, psip);
+    g2_double(two_p, p);
+    g2j_psi(t, two_p);
+    g2j_psi(psi2, t);     // ψ²([2]P)
+    g2_add(acc, b, na);
+    g2_add(acc, acc, np);
+    g2_add(acc, acc, psia);
+    g2_add(acc, acc, npsip);
+    g2_add(out, acc, psi2);
+}
+
+// Jacobian scalar multiplication by big-endian bytes (shared shape with
+// the C-ABI g2_mul; internal so hash batches skip the byte round trip)
+static void g2j_mul_be(G2J& out, const G2J& base, const uint8_t* scalar,
+                       size_t len) {
+    G2J acc;
+    acc.x.c0 = FP_ONE;
+    acc.x.c1 = FP_ZERO;
+    acc.y = acc.x;
+    acc.z.c0 = FP_ZERO;
+    acc.z.c1 = FP_ZERO;
+    for (size_t i = 0; i < len; i++) {
+        uint8_t byte = scalar[i];
+        for (int bit = 7; bit >= 0; bit--) {
+            G2J t;
+            g2_double(t, acc);
+            acc = t;
+            if ((byte >> bit) & 1) {
+                g2_add(t, acc, base);
+                acc = t;
+            }
+        }
+    }
+    out = acc;
+}
+
+// full hash_to_g2 for one message -> affine (x, y); the RO variant
+// (two SSWU points added before cofactor clearing)
+static void hash_to_g2_one(Fq2& ox, Fq2& oy, const uint8_t* msg, size_t msg_len,
+                           const uint8_t* dst, size_t dst_len) {
+    uint8_t data[256];
+    expand_message_xmd_256(msg, msg_len, dst, dst_len, data);
+    Fq2 u0, u1;
+    fp_from_wide(u0.c0, data);
+    fp_from_wide(u0.c1, data + 64);
+    fp_from_wide(u1.c0, data + 128);
+    fp_from_wide(u1.c1, data + 192);
+    Fq2 x0, y0, x1, y1;
+    sswu(x0, y0, u0);
+    sswu(x1, y1, u1);
+    G2J q0, q1;
+    Fq2 mx, my;
+    if (iso_map_e2(mx, my, x0, y0)) {
+        q0.x = mx;
+        q0.y = my;
+        q0.z.c0 = FP_ONE;
+        q0.z.c1 = FP_ZERO;
+    } else {
+        q0.x.c0 = FP_ONE; q0.x.c1 = FP_ZERO;
+        q0.y = q0.x;
+        q0.z.c0 = FP_ZERO; q0.z.c1 = FP_ZERO;
+    }
+    if (iso_map_e2(mx, my, x1, y1)) {
+        q1.x = mx;
+        q1.y = my;
+        q1.z.c0 = FP_ONE;
+        q1.z.c1 = FP_ZERO;
+    } else {
+        q1.x.c0 = FP_ONE; q1.x.c1 = FP_ZERO;
+        q1.y = q1.x;
+        q1.z.c0 = FP_ZERO; q1.z.c1 = FP_ZERO;
+    }
+    G2J sum, cleared;
+    g2_add(sum, q0, q1);
+    g2j_clear_cofactor(cleared, sum);
+    // normalize (hash outputs are never infinity for the RO construction)
+    Fq2 zi, zi2, zi3;
+    fq2_inv(zi, cleared.z);
+    fq2_sq(zi2, zi);
+    fq2_mul(zi3, zi2, zi);
+    fq2_mul(ox, cleared.x, zi2);
+    fq2_mul(oy, cleared.y, zi3);
+}
+
 // ------------------------------------------------------------------ C ABI
 
 extern "C" {
@@ -1077,6 +1724,165 @@ void bls381_g2_mul(uint8_t* out192, const uint8_t* in192, const uint8_t* scalar,
     fp_to_bytes(out192 + 48, ax.c1);
     fp_to_bytes(out192 + 96, ay.c0);
     fp_to_bytes(out192 + 144, ay.c1);
+}
+
+// Batch hash_to_g2 (RFC 9380 RO ciphersuite) across a thread pool.
+// msgs: concatenated message bytes, lens[i] each message's length;
+// out: n * 192 bytes affine x||y (each Fq2 c0||c1, 48B BE).
+// nthreads = 0 -> hardware_concurrency.  This is the role blst's native
+// h2c plays for the reference (ref: native/bls_nif/src/lib.rs:33-47).
+void bls381_hash_to_g2_batch(const uint8_t* msgs, const size_t* lens, size_t n,
+                             const uint8_t* dst, size_t dst_len, uint8_t* out,
+                             int nthreads) {
+    bls381_init();
+    h2c_init();
+    std::vector<size_t> offsets(n);
+    size_t off = 0;
+    for (size_t i = 0; i < n; i++) {
+        offsets[i] = off;
+        off += lens[i];
+    }
+    int nt = nthreads > 0 ? nthreads : (int)std::thread::hardware_concurrency();
+    if (nt < 1) nt = 1;
+    if ((size_t)nt > n) nt = (int)n;
+    auto work = [&](int tid) {
+        for (size_t i = tid; i < n; i += nt) {
+            Fq2 x, y;
+            hash_to_g2_one(x, y, msgs + offsets[i], lens[i], dst, dst_len);
+            fp_to_bytes(out + i * 192, x.c0);
+            fp_to_bytes(out + i * 192 + 48, x.c1);
+            fp_to_bytes(out + i * 192 + 96, y.c0);
+            fp_to_bytes(out + i * 192 + 144, y.c1);
+        }
+    };
+    if (nt == 1) {
+        work(0);
+    } else {
+        std::vector<std::thread> pool;
+        for (int t = 0; t < nt; t++) pool.emplace_back(work, t);
+        for (auto& th : pool) th.join();
+    }
+}
+
+// One RLC pairing-product check fully native (the host-path counterpart of
+// ops/bls_batch.py::chain_verify; the role blst's aggregate-verify plays
+// for the reference, ref native/bls_nif/src/lib.rs:14-158):
+//
+//   prod_g e( sum_{i in g} r_i pk_i , H_g ) * e( -g1, sum_i r_i sig_i ) == 1
+//
+// pks: n*96B affine G1, sigs: n*192B affine G2, coeffs: n*coeff_len BE
+// scalars, gids: group index per entry, hs: n_groups*192B hashed message
+// points.  The per-entry scalar muls fan out across threads; group sums,
+// lockstep Miller loops and the shared final exponentiation finish on one.
+int bls381_rlc_verify(const uint8_t* pks, const uint8_t* sigs,
+                      const uint8_t* coeffs, size_t coeff_len,
+                      const int32_t* gids, size_t n, const uint8_t* hs,
+                      size_t n_groups, int nthreads) {
+    bls381_init();
+    h2c_init();
+    if (n == 0) return 1;
+    std::vector<G1J> pk_scaled(n);
+    std::vector<G2J> sig_scaled(n);
+    int nt = nthreads > 0 ? nthreads : (int)std::thread::hardware_concurrency();
+    if (nt < 1) nt = 1;
+    if ((size_t)nt > n) nt = (int)n;
+    auto work = [&](int tid) {
+        for (size_t i = tid; i < n; i += nt) {
+            G1J base1;
+            fp_from_bytes(base1.x, pks + i * 96);
+            fp_from_bytes(base1.y, pks + i * 96 + 48);
+            base1.z = FP_ONE;
+            // double-and-add over the BE coefficient bytes
+            G1J acc1 = {FP_ONE, FP_ONE, FP_ZERO};
+            for (size_t b = 0; b < coeff_len; b++) {
+                uint8_t byte = coeffs[i * coeff_len + b];
+                for (int bit = 7; bit >= 0; bit--) {
+                    G1J t;
+                    g1_double(t, acc1);
+                    acc1 = t;
+                    if ((byte >> bit) & 1) {
+                        g1_add(t, acc1, base1);
+                        acc1 = t;
+                    }
+                }
+            }
+            pk_scaled[i] = acc1;
+            G2J base2;
+            fp_from_bytes(base2.x.c0, sigs + i * 192);
+            fp_from_bytes(base2.x.c1, sigs + i * 192 + 48);
+            fp_from_bytes(base2.y.c0, sigs + i * 192 + 96);
+            fp_from_bytes(base2.y.c1, sigs + i * 192 + 144);
+            base2.z.c0 = FP_ONE;
+            base2.z.c1 = FP_ZERO;
+            g2j_mul_be(sig_scaled[i], base2, coeffs + i * coeff_len, coeff_len);
+        }
+    };
+    if (nt == 1) {
+        work(0);
+    } else {
+        std::vector<std::thread> pool;
+        for (int t = 0; t < nt; t++) pool.emplace_back(work, t);
+        for (auto& th : pool) th.join();
+    }
+    // group sums + signature sum
+    std::vector<G1J> group_sum(n_groups, G1J{FP_ONE, FP_ONE, FP_ZERO});
+    G2J sig_sum;
+    sig_sum.x.c0 = FP_ONE;
+    sig_sum.x.c1 = FP_ZERO;
+    sig_sum.y = sig_sum.x;
+    sig_sum.z.c0 = FP_ZERO;
+    sig_sum.z.c1 = FP_ZERO;
+    for (size_t i = 0; i < n; i++) {
+        int32_t g = gids[i];
+        if (g < 0 || (size_t)g >= n_groups) return 0;
+        G1J t;
+        g1_add(t, group_sum[g], pk_scaled[i]);
+        group_sum[g] = t;
+        G2J t2;
+        g2_add(t2, sig_sum, sig_scaled[i]);
+        sig_sum = t2;
+    }
+    // assemble pairs: infinity sums contribute e(inf, Q) = 1 and drop out
+    std::vector<PairSt> pairs;
+    pairs.reserve(n_groups + 1);
+    for (size_t g = 0; g < n_groups; g++) {
+        if (g1j_is_inf(group_sum[g])) continue;
+        Fp zi, zi2, zi3;
+        fp_inv(zi, group_sum[g].z);
+        fp_sq(zi2, zi);
+        fp_mul(zi3, zi2, zi);
+        PairSt ps;
+        fp_mul(ps.px, group_sum[g].x, zi2);
+        fp_mul(ps.py, group_sum[g].y, zi3);
+        fp_from_bytes(ps.q.x.c0, hs + g * 192);
+        fp_from_bytes(ps.q.x.c1, hs + g * 192 + 48);
+        fp_from_bytes(ps.q.y.c0, hs + g * 192 + 96);
+        fp_from_bytes(ps.q.y.c1, hs + g * 192 + 144);
+        pairs.push_back(ps);
+    }
+    if (!g2j_is_inf(sig_sum)) {
+        Fq2 zi, zi2, zi3;
+        fq2_inv(zi, sig_sum.z);
+        fq2_sq(zi2, zi);
+        fq2_mul(zi3, zi2, zi);
+        PairSt ps;
+        ps.px = G1_GEN_NEG_X;
+        ps.py = G1_GEN_NEG_Y;
+        fq2_mul(ps.q.x, sig_sum.x, zi2);
+        fq2_mul(ps.q.y, sig_sum.y, zi3);
+        pairs.push_back(ps);
+    }
+    if (pairs.empty()) return 1;
+    miller_loop_many(pairs.data(), pairs.size());
+    Fq12 acc = pairs[0].f;
+    for (size_t i = 1; i < pairs.size(); i++) {
+        Fq12 t;
+        fq12_mul(t, acc, pairs[i].f);
+        acc = t;
+    }
+    Fq12 res;
+    final_exponentiation(res, acc);
+    return fq12_is_one(res) ? 1 : 0;
 }
 
 }  // extern "C"

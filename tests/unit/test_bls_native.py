@@ -98,3 +98,117 @@ def test_verify_same_through_both_paths(monkeypatch):
     finally:
         object.__setattr__(C.g1, "native_mul", native.g1_mul)
         object.__setattr__(C.g2, "native_mul", native.g2_mul)
+
+
+# ---------------------------------------------------------- hash_to_g2
+
+
+@pytest.mark.skipif(
+    not native.hash_available(), reason="native hash_to_g2 not built"
+)
+class TestNativeHashToG2:
+    """The C++ RFC 9380 pipeline must be byte-identical to the Python
+    oracle — including the ψ-endomorphism cofactor clearing, which RFC
+    9380 §8.8.2 defines to equal multiplication by h_eff exactly."""
+
+    def test_matches_python_oracle(self):
+        from lambda_ethereum_consensus_tpu.crypto.bls import hash_to_curve as H
+
+        for i, msg in enumerate(
+            [b"", b"abc", b"a" * 200, bytes(range(64)), b"\x00" * 33]
+        ):
+            u0, u1 = H.hash_to_field_fq2(msg, 2, H.DST_POP)
+            py = H.clear_cofactor(
+                H.g2.affine_add(H.iso_map(H._sswu(u0)), H.iso_map(H._sswu(u1)))
+            )
+            nat = native.hash_to_g2_batch([msg], H.DST_POP)[0]
+            assert nat == py, f"case {i} diverged"
+
+    def test_batch_order_and_custom_dst(self):
+        from lambda_ethereum_consensus_tpu.crypto.bls import hash_to_curve as H
+
+        msgs = [b"m%d" % i for i in range(7)]
+        dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+        out = native.hash_to_g2_batch(msgs, dst)
+        for m, pt in zip(msgs, out):
+            u0, u1 = H.hash_to_field_fq2(m, 2, dst)
+            py = H.clear_cofactor(
+                H.g2.affine_add(H.iso_map(H._sswu(u0)), H.iso_map(H._sswu(u1)))
+            )
+            assert pt == py
+        # outputs are valid subgroup points
+        for pt in out:
+            assert C.g2.in_subgroup(pt)
+
+    def test_hash_to_g2_many_routes_native(self):
+        from lambda_ethereum_consensus_tpu.crypto.bls.hash_to_curve import (
+            hash_to_g2,
+            hash_to_g2_many,
+        )
+
+        msgs = [b"route%d" % i for i in range(3)]
+        assert hash_to_g2_many(msgs) == [hash_to_g2(m) for m in msgs]
+        assert hash_to_g2_many([]) == []
+
+
+# ---------------------------------------------------------- RLC verify
+
+
+@pytest.mark.skipif(
+    not native.rlc_available(), reason="native RLC verify not built"
+)
+class TestNativeRlcVerify:
+    """The all-native RLC product check (scalar muls + group sums +
+    lockstep Miller + shared final exp) vs verify_points' Python path."""
+
+    def _entries(self, n, n_msgs=3):
+        from lambda_ethereum_consensus_tpu.crypto.bls.hash_to_curve import (
+            DST_POP,
+            hash_to_g2,
+        )
+
+        entries = []
+        for i in range(n):
+            sk = 5 + i
+            m = b"rlc-%d" % (i % n_msgs)
+            pk = C.g1.multiply_raw(C.G1_GENERATOR, sk)
+            sig = C.g2.multiply_raw(hash_to_g2(m, DST_POP), sk)
+            entries.append((pk, m, sig))
+        return entries
+
+    def test_valid_and_corrupted(self, monkeypatch):
+        from lambda_ethereum_consensus_tpu.crypto.bls.batch import verify_points
+
+        entries = self._entries(12)
+        monkeypatch.setenv("BLS_NO_NATIVE_RLC", "1")
+        assert verify_points(entries)
+        monkeypatch.delenv("BLS_NO_NATIVE_RLC")
+        assert verify_points(entries)
+
+        pk, m, sig = entries[7]
+        entries[7] = (pk, m, C.g2.multiply_raw(sig, 2))
+        assert not verify_points(entries)
+        monkeypatch.setenv("BLS_NO_NATIVE_RLC", "1")
+        assert not verify_points(entries)
+
+    def test_direct_api_group_edge_cases(self):
+        from lambda_ethereum_consensus_tpu.crypto.bls.batch import _pack_check
+        from lambda_ethereum_consensus_tpu.crypto.bls.hash_to_curve import DST_POP
+
+        entries = self._entries(5, n_msgs=5)  # every entry its own group
+        packed, h_points, gids = _pack_check(
+            [(pk, m, sig) for pk, m, sig in entries], DST_POP, {}
+        )
+        assert native.rlc_verify(packed, h_points, gids) is True
+        assert native.rlc_verify([], [], []) is True
+
+    def test_wrong_message_grouping_fails(self):
+        from lambda_ethereum_consensus_tpu.crypto.bls.batch import _pack_check
+        from lambda_ethereum_consensus_tpu.crypto.bls.hash_to_curve import DST_POP
+
+        entries = self._entries(6)
+        # swap one entry's message after signing: grouping mismatch
+        pk, _, sig = entries[2]
+        entries[2] = (pk, b"rlc-other", sig)
+        packed, h_points, gids = _pack_check(entries, DST_POP, {})
+        assert native.rlc_verify(packed, h_points, gids) is False
