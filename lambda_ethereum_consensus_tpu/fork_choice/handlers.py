@@ -44,6 +44,7 @@ def _on_tick_per_slot(store: Store, time: int, spec: ChainSpec) -> None:
     current_slot = store.current_slot(spec)
     if current_slot > previous_slot:
         store.proposer_boost_root = b"\x00" * 32
+        store.bump()
         if store.slots_since_epoch_start(spec) == 0:
             update_checkpoints(
                 store,
@@ -57,8 +58,10 @@ def update_checkpoints(
 ) -> None:
     if justified.epoch > store.justified_checkpoint.epoch:
         store.justified_checkpoint = justified
+        store.bump()
     if finalized.epoch > store.finalized_checkpoint.epoch:
         store.finalized_checkpoint = finalized
+        store.bump()
         if store.head_cache is not None:
             store.head_cache.prune(bytes(finalized.root))
 
@@ -114,6 +117,7 @@ def on_block(
     )
     if store.current_slot(spec) == block.slot and is_before_attesting_interval:
         store.proposer_boost_root = root
+        store.bump()
 
     update_checkpoints(
         store, state.current_justified_checkpoint, state.finalized_checkpoint
@@ -211,18 +215,23 @@ def update_latest_messages(
         if cache is not None
         else None
     )
+    updated = False
     for i in non_equivocating:
         prev = store.latest_messages.get(i)
         if prev is None or target.epoch > prev.epoch:
             store.latest_messages[i] = LatestMessage(
                 epoch=int(target.epoch), root=beacon_block_root
             )
+            updated = True
             if cache is not None and target_state is not None:
                 cache.on_vote(
                     i,
                     beacon_block_root,
                     int(target_state.validators[i].effective_balance),
                 )
+    if updated:
+        # one memo invalidation per attestation, not per validator
+        store.bump()
 
 
 def _prepare_attestation(
@@ -341,6 +350,7 @@ def on_attester_slashing(
     expect(is_valid_indexed_attestation(state, att2, spec), "attestation 2 invalid")
     equivocators = set(att1.attesting_indices) & set(att2.attesting_indices)
     store.equivocating_indices.update(equivocators)
+    store.bump()
     if store.head_cache is not None:
         for i in equivocators:
             store.head_cache.on_equivocation(i)

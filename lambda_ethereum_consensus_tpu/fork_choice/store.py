@@ -57,6 +57,16 @@ class Store:
     # O(1) cached-head tree, streamed by the handlers (see tree.HeadCache);
     # None only for hand-built test stores
     head_cache: HeadCache | None = None
+    # head memo (VERDICT r2 #9): ``mutations`` is bumped by every
+    # head-relevant store change (blocks, votes, checkpoints, boost,
+    # equivocations) so API reads between mutations are O(1) instead of a
+    # full LMD-GHOST recomputation; the memo key also carries the current
+    # slot because viability filtering depends on the clock.
+    mutations: int = 0
+    head_memo: tuple | None = None
+
+    def bump(self) -> None:
+        self.mutations += 1
 
     # ---------------------------------------------------------- time helpers
     def current_slot(self, spec: ChainSpec | None = None) -> int:
@@ -99,6 +109,7 @@ class Store:
         self.children.setdefault(bytes(block.parent_root), []).append(root)
         if self.head_cache is not None:
             self.head_cache.on_block(root, bytes(block.parent_root))
+        self.bump()
 
 
 def checkpoint_key(checkpoint: Checkpoint) -> tuple[int, bytes]:

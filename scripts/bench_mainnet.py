@@ -122,6 +122,7 @@ def main() -> None:
         anchor = next(iter(store.blocks))
         for i in range(n):
             store.latest_messages[i] = LatestMessage(epoch=0, root=anchor)
+        store.bump()  # direct mutation: invalidate the head memo explicitly
         t0 = time.perf_counter()
         head = get_head(store, spec)
         emit("get_head_full_votes", time.perf_counter() - t0, n_validators=n)

@@ -143,13 +143,93 @@ def minted(tmp_path_factory):
             },
         )
 
+        # operations/sync_aggregate: empty participation + infinity sig is
+        # a VALID aggregate (official format: pre + sync_aggregate + post)
+        from lambda_ethereum_consensus_tpu.state_transition.mutable import (
+            BeaconStateMut,
+        )
+        from lambda_ethereum_consensus_tpu.state_transition import operations as st_ops
+        from lambda_ethereum_consensus_tpu.types.beacon import (
+            SignedVoluntaryExit,
+            SyncAggregate,
+            VoluntaryExit,
+        )
+
+        agg = SyncAggregate(sync_committee_signature=bls.G2_POINT_AT_INFINITY)
+        # slot 1: sync-aggregate rewards read the previous slot's block root
+        pre_sync = process_slots(genesis, 1, spec)
+        ws = BeaconStateMut(pre_sync)
+        st_ops.process_sync_aggregate(ws, agg, spec)
+        d = case("operations", "sync_aggregate")
+        write_ssz(d / "pre.ssz_snappy", pre_sync, spec)
+        write_ssz(d / "sync_aggregate.ssz_snappy", agg, spec)
+        write_ssz(d / "post.ssz_snappy", ws.freeze(), spec)
+
+        # operations/voluntary_exit: INVALID on genesis (validator has not
+        # been active for SHARD_COMMITTEE_PERIOD) — no post file
+        exit_ = SignedVoluntaryExit(
+            message=VoluntaryExit(epoch=0, validator_index=0),
+            signature=bls.sign(SKS[0], b"not-a-real-signing-root"),
+        )
+        d = case("operations", "voluntary_exit")
+        write_ssz(d / "pre.ssz_snappy", genesis, spec)
+        write_ssz(d / "voluntary_exit.ssz_snappy", exit_, spec)
+
+        # epoch_processing: two deterministic reset passes
+        from lambda_ethereum_consensus_tpu.state_transition import (
+            epoch as st_epoch,
+        )
+
+        for handler, fn in (
+            ("eth1_data_reset", st_epoch.process_eth1_data_reset),
+            ("slashings_reset", st_epoch.process_slashings_reset),
+        ):
+            ws = BeaconStateMut(genesis)
+            fn(ws, spec)
+            d = case("epoch_processing", handler)
+            write_ssz(d / "pre.ssz_snappy", genesis, spec)
+            write_ssz(d / "post.ssz_snappy", ws.freeze(), spec)
+
+        # fork_choice: anchor + tick + one block + head/time checks
+        # (official step-interpreter format, ref runners/fork_choice.ex)
+        anchor_header = genesis.latest_block_header.copy(
+            state_root=genesis.hash_tree_root(spec)
+        )
+        anchor_block = BeaconBlock(
+            slot=0,
+            proposer_index=0,
+            parent_root=bytes(anchor_header.parent_root),
+            state_root=genesis.hash_tree_root(spec),
+            body=BeaconBlockBody(),
+        )
+        tick = genesis.genesis_time + spec.SECONDS_PER_SLOT
+        root1 = signed.message.hash_tree_root(spec)
+        d = case("fork_choice", "on_block")
+        write_ssz(d / "anchor_state.ssz_snappy", genesis, spec)
+        write_ssz(d / "anchor_block.ssz_snappy", anchor_block, spec)
+        write_ssz(d / ("block_0x%s.ssz_snappy" % root1.hex()), signed, spec)
+        write_yaml(
+            d / "steps.yaml",
+            [
+                {"tick": int(tick)},
+                {"block": "block_0x%s" % root1.hex()},
+                {
+                    "checks": {
+                        "time": int(tick),
+                        "head": {"slot": 1, "root": "0x" + root1.hex()},
+                    }
+                },
+            ],
+        )
+
         yield str(root), spec, genesis
 
 
 def test_discovery_and_all_minted_cases_pass(minted):
     root, spec, _ = minted
     cases = list(discover_cases(root))
-    assert len(cases) >= 6
+    assert len(cases) >= 11
+    assert {c[2] for c in cases} == set(RUNNERS), "every runner format-proven"
     for config, fork, runner, handler, case_dir in cases:
         assert not RUNNERS[runner].skip(handler), (runner, handler)
         run_case(config, runner, handler, case_dir, spec=spec)

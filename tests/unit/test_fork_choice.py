@@ -277,3 +277,26 @@ def test_on_tick_pulls_up_checkpoints(chain):
         )
         assert store.current_slot(spec) == 3 * spec.SLOTS_PER_EPOCH
         assert store.justified_checkpoint.epoch == 0
+
+
+def test_get_head_memo_invalidates_on_mutation(chain):
+    """API head reads between mutations are memoized (VERDICT r2 #9);
+    every head-relevant store change must invalidate the memo."""
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = make_store(genesis, anchor_block, spec)
+        h1 = get_head(store, spec)
+        assert store.head_memo is not None
+        memo_before = store.head_memo
+        # a second read hits the memo (no recomputation -> same tuple)
+        assert get_head(store, spec) == h1
+        assert store.head_memo is memo_before
+        # an explicit mutation invalidates; same answer, fresh memo
+        store.bump()
+        assert get_head(store, spec) == h1
+        assert store.head_memo is not memo_before
+        # a new block (a real mutation path) moves the head through the memo
+        signed1, _ = build_block(genesis, spec, 1)
+        on_tick(store, store.genesis_time + spec.SECONDS_PER_SLOT, spec)
+        root1 = on_block(store, signed1, spec=spec)
+        assert get_head(store, spec) == root1
