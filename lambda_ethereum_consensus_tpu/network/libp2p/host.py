@@ -1,0 +1,170 @@
+"""The composed libp2p host: TCP -> multistream -> noise -> mplex -> streams.
+
+Equivalent of the reference's go-libp2p host construction (ref:
+reqresp.go:30-46) built from this package's wire-exact layers.  Upgrade
+sequence per connection, matching the libp2p connection spec:
+
+1. TCP connect/accept;
+2. multistream-select on the raw socket negotiates ``/noise``;
+3. the libp2p-noise XX handshake authenticates both peers' ed25519
+   identities (noise_transport);
+4. multistream-select *inside* the encrypted channel negotiates
+   ``/mplex/6.7.0``;
+5. each application stream opens with its own multistream negotiation of
+   the protocol path (e.g. ``/eth2/beacon_chain/req/status/1/ssz_snappy``).
+
+``request()`` implements the eth2 req/resp stream discipline: write the
+request, half-close, read the response to EOF (ref: reqresp.go:73-86).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .identity import Identity, PeerId
+from .mplex import Mplex, MplexStream
+from .multistream import NegotiationError, handle as ms_handle, select as ms_select
+from .noise_transport import secure_connection
+
+NOISE_PROTOCOL = "/noise"
+MPLEX_PROTOCOL = "/mplex/6.7.0"
+
+
+class Libp2pError(Exception):
+    pass
+
+
+class Connection:
+    def __init__(self, channel, muxer: Mplex, peer_id: PeerId):
+        self.channel = channel
+        self.muxer = muxer
+        self.peer_id = peer_id
+        self.run_task: asyncio.Task | None = None
+
+
+class Libp2pHost:
+    """Minimal libp2p host speaking the real wire protocols."""
+
+    def __init__(self, identity: Identity | None = None):
+        self.identity = identity or Identity()
+        self.peer_id = self.identity.peer_id
+        self.connections: dict[PeerId, Connection] = {}
+        self.handlers: dict[str, object] = {}  # protocol -> async handler
+        self._server: asyncio.AbstractServer | None = None
+        self.on_peer = None  # optional async callback(PeerId, addr)
+
+    # ------------------------------------------------------------ lifecycle
+    async def listen(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._accept, host, port)
+        sock = self._server.sockets[0].getsockname()
+        return sock[0], sock[1]
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for conn in list(self.connections.values()):
+            if conn.run_task is not None:
+                conn.run_task.cancel()
+            conn.channel.close()
+        self.connections.clear()
+
+    def set_stream_handler(self, protocol: str, handler) -> None:
+        """``handler(stream, protocol, peer_id)`` runs per inbound stream."""
+        self.handlers[protocol] = handler
+
+    # ----------------------------------------------------------- connecting
+    async def dial(self, host: str, port: int, timeout: float = 10.0) -> PeerId:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout
+        )
+        try:
+            conn = await asyncio.wait_for(
+                self._upgrade(reader, writer, initiator=True), timeout
+            )
+        except (NegotiationError, asyncio.TimeoutError, OSError) as e:
+            writer.close()
+            raise Libp2pError(f"dial {host}:{port}: {e}") from None
+        await self._register(conn, f"{host}:{port}")
+        return conn.peer_id
+
+    async def _accept(self, reader, writer) -> None:
+        try:
+            conn = await asyncio.wait_for(
+                self._upgrade(reader, writer, initiator=False), 10.0
+            )
+        except Exception:
+            writer.close()
+            return
+        peername = writer.get_extra_info("peername")
+        await self._register(conn, f"{peername[0]}:{peername[1]}" if peername else "")
+
+    async def _upgrade(self, reader, writer, initiator: bool) -> Connection:
+        # security negotiation on the raw socket
+        if initiator:
+            await ms_select(reader, writer, [NOISE_PROTOCOL])
+        else:
+            await ms_handle(reader, writer, [NOISE_PROTOCOL])
+        channel = await secure_connection(reader, writer, self.identity, initiator)
+        # muxer negotiation inside the encrypted channel
+        if initiator:
+            await ms_select(channel, channel, [MPLEX_PROTOCOL])
+        else:
+            await ms_handle(channel, channel, [MPLEX_PROTOCOL])
+        muxer = Mplex(channel, on_stream=self._inbound_stream)
+        return Connection(channel, muxer, channel.peer_id)
+
+    async def _register(self, conn: Connection, addr: str) -> None:
+        if conn.peer_id == self.peer_id or conn.peer_id in self.connections:
+            conn.channel.close()  # self-dial or duplicate
+            return
+        conn.run_task = asyncio.ensure_future(self._run(conn))
+        self.connections[conn.peer_id] = conn
+        if self.on_peer is not None:
+            await self.on_peer(conn.peer_id, addr)
+
+    async def _run(self, conn: Connection) -> None:
+        try:
+            await conn.muxer.run()
+        finally:
+            if self.connections.get(conn.peer_id) is conn:
+                del self.connections[conn.peer_id]
+            conn.channel.close()
+
+    # -------------------------------------------------------------- streams
+    async def _inbound_stream(self, stream: MplexStream) -> None:
+        try:
+            protocol = await ms_handle(stream, stream, sorted(self.handlers))
+        except (NegotiationError, asyncio.IncompleteReadError, Exception):
+            await stream.reset()
+            return
+        peer_id = stream._muxer._channel.peer_id
+        handler = self.handlers[protocol]
+        try:
+            await handler(stream, protocol, peer_id)
+        except Exception:
+            await stream.reset()
+
+    async def new_stream(self, peer_id: PeerId, protocols: list[str]) -> tuple[MplexStream, str]:
+        conn = self.connections.get(peer_id)
+        if conn is None:
+            raise Libp2pError(f"not connected to {peer_id!r}")
+        stream = await conn.muxer.open_stream()
+        try:
+            chosen = await ms_select(stream, stream, protocols)
+        except NegotiationError as e:
+            await stream.reset()
+            raise Libp2pError(str(e)) from None
+        return stream, chosen
+
+    async def request(
+        self, peer_id: PeerId, protocol: str, payload: bytes, timeout: float = 15.0
+    ) -> bytes:
+        """eth2 req/resp exchange: write || half-close || read-to-EOF."""
+        stream, _ = await self.new_stream(peer_id, [protocol])
+        try:
+            stream.write(payload)
+            await stream.close_write()
+            return await asyncio.wait_for(stream.read_all(), timeout)
+        except asyncio.TimeoutError:
+            await stream.reset()
+            raise Libp2pError(f"request timed out on {protocol}") from None

@@ -187,7 +187,9 @@ class NoiseSession:
         self.ss.decrypt_and_hash(msg[32:])
 
     # ---- message 2: <- e, ee, s, es ------------------------------------
-    def write_message_2(self) -> bytes:
+    def write_message_2(self, payload: bytes = b"") -> bytes:
+        """``payload`` rides encrypted under the es key — libp2p puts the
+        responder's identity proof (NoiseHandshakePayload) here."""
         assert not self.initiator
         self.e = X25519PrivateKey.generate()
         e_pub = _pub(self.e)
@@ -195,10 +197,9 @@ class NoiseSession:
         self.ss.mix_key(_dh(self.e, self.re))  # ee
         s_enc = self.ss.encrypt_and_hash(_pub(self.s))  # s
         self.ss.mix_key(_dh(self.s, self.re))  # es (responder: dh(s, re))
-        payload = self.ss.encrypt_and_hash(b"")
-        return e_pub + s_enc + payload
+        return e_pub + s_enc + self.ss.encrypt_and_hash(payload)
 
-    def read_message_2(self, msg: bytes) -> None:
+    def read_message_2(self, msg: bytes) -> bytes:
         assert self.initiator
         if len(msg) < 32 + 48:
             raise NoiseError("short handshake message 2")
@@ -207,23 +208,23 @@ class NoiseSession:
         self.ss.mix_key(_dh(self.e, self.re))  # ee
         self.rs = self.ss.decrypt_and_hash(msg[32 : 32 + 48])  # s
         self.ss.mix_key(_dh(self.e, self.rs))  # es (initiator: dh(e, rs))
-        self.ss.decrypt_and_hash(msg[32 + 48 :])
+        return self.ss.decrypt_and_hash(msg[32 + 48 :])
 
     # ---- message 3: -> s, se -------------------------------------------
-    def write_message_3(self) -> bytes:
+    def write_message_3(self, payload: bytes = b"") -> bytes:
+        """``payload``: the initiator's identity proof in libp2p."""
         assert self.initiator
         s_enc = self.ss.encrypt_and_hash(_pub(self.s))  # s
         self.ss.mix_key(_dh(self.s, self.re))  # se (initiator: dh(s, re))
-        payload = self.ss.encrypt_and_hash(b"")
-        return s_enc + payload
+        return s_enc + self.ss.encrypt_and_hash(payload)
 
-    def read_message_3(self, msg: bytes) -> None:
+    def read_message_3(self, msg: bytes) -> bytes:
         assert not self.initiator
         if len(msg) < 48:
             raise NoiseError("short handshake message 3")
         self.rs = self.ss.decrypt_and_hash(msg[:48])  # s
         self.ss.mix_key(_dh(self.e, self.rs))  # se (responder: dh(e, rs))
-        self.ss.decrypt_and_hash(msg[48:])
+        return self.ss.decrypt_and_hash(msg[48:])
 
     # ---- transport ------------------------------------------------------
     def finalize(self) -> None:
@@ -248,19 +249,28 @@ class NoiseSession:
         return self._recv.decrypt(b"", ciphertext)
 
 
+async def send_framed(writer, msg: bytes) -> None:
+    """Write one ``uint16_be(len) || data`` noise message (the libp2p noise
+    framing; shared by the sidecar handshake and the libp2p transport)."""
+    writer.write(struct.pack(">H", len(msg)) + msg)
+    await writer.drain()
+
+
+async def recv_framed(reader) -> bytes:
+    head = await reader.readexactly(2)
+    (length,) = struct.unpack(">H", head)
+    return await reader.readexactly(length)
+
+
 async def handshake(reader, writer, static: X25519PrivateKey, initiator: bool):
     """Run the XX handshake over 2-byte-length-framed messages; returns a
     finalized :class:`NoiseSession`."""
-    import asyncio
 
     async def send(msg: bytes) -> None:
-        writer.write(struct.pack(">H", len(msg)) + msg)
-        await writer.drain()
+        await send_framed(writer, msg)
 
     async def recv() -> bytes:
-        head = await reader.readexactly(2)
-        (length,) = struct.unpack(">H", head)
-        return await reader.readexactly(length)
+        return await recv_framed(reader)
 
     session = NoiseSession(static, initiator)
     if initiator:

@@ -130,8 +130,31 @@ def aot_jit(fn, name: str):
                     _STATS["errors"] += 1
                 # fall through to a fresh compile
 
-        # 2) compile (and best-effort persist)
-        compiled = lowered.compile()
+        # 2) compile (and best-effort persist).  The axon tunnel's
+        # remote_compile endpoint occasionally drops the connection
+        # mid-compile ("response body closed before all bytes were
+        # read") — a transient infra fault, not a program error — so
+        # retry a couple of times before giving up.
+        compiled = None
+        for attempt in range(3):
+            try:
+                compiled = lowered.compile()
+                break
+            except Exception as e:
+                msg = str(e)
+                transient = (
+                    "INTERNAL" in msg
+                    or "DEADLINE" in msg
+                    or "response body closed" in msg
+                    or "connection reset" in msg.lower()
+                )
+                if attempt == 2 or not transient:
+                    raise
+                with _LOCK:
+                    _STATS["errors"] += 1
+                import time
+
+                time.sleep(2.0 * (attempt + 1))
         with _LOCK:
             _STATS["compiles"] += 1
         compiled_by_sig[sig] = compiled
