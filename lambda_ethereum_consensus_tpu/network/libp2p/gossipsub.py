@@ -1,0 +1,440 @@
+"""gossipsub v1.1 over /meshsub/1.1.0 — the real pubsub wire protocol.
+
+Speaks go-libp2p-pubsub's RPC protobuf (proto/gossipsub.proto) on
+meshsub streams, replacing the sidecar's bespoke gossip frames for
+libp2p-wire deployments.  Semantics follow the gossipsub v1.1 spec with
+the reference's eth2 tuning (ref: subscriptions.go:31-77):
+
+- mesh per topic, D=8 / D_lo=6 / D_hi=12, 700 ms heartbeat;
+- GRAFT/PRUNE control, IHAVE gossip to non-mesh subscribers each
+  heartbeat (history 6 windows, gossip 3), IWANT recovery;
+- StrictNoSign: publishes carry only ``data`` + ``topic``; messages
+  with from/seqno/signature/key are rejected as protocol violations;
+- eth2 message id (post-Altair, ref: utils.go MsgID): sha256 of
+  ``domain(4B) || uint64_le(len(topic)) || topic || payload`` truncated
+  to 20 bytes, where domain is VALID(0x01000000) with the raw-snappy
+  decompressed payload, INVALID(0x00000000) with the compressed bytes;
+- host-gated validation: inbound messages go to an async validator and
+  are forwarded only on ACCEPT (the reference's blocking topic
+  validator, subscriptions.go:95-135); REJECT feeds peer scoring.
+
+One long-lived outbound stream per peer carries our RPCs (varint-
+length-delimited, as go-libp2p-pubsub frames them); each peer likewise
+opens one inbound stream to us.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import struct
+import time
+from collections import OrderedDict
+
+from ...compression.snappy import SnappyError, decompress as raw_decompress
+from ..proto import gossipsub_pb2 as pb
+from . import varint
+from .host import Libp2pError, Libp2pHost
+from .identity import PeerId
+
+MESHSUB_PROTOCOL = "/meshsub/1.1.0"
+MAX_RPC = 10 * (1 << 20)  # the reference's 10 MB message cap
+
+# eth2 mesh tuning (ref: subscriptions.go:33-39)
+D = 8
+D_LO = 6
+D_HI = 12
+HEARTBEAT_S = 0.7
+HISTORY_LENGTH = 6
+HISTORY_GOSSIP = 3
+SEEN_TTL_S = 550 * HEARTBEAT_S
+FANOUT_TTL_S = 60.0
+
+ACCEPT, REJECT, IGNORE = 1, 2, 3
+
+ACCEPT_REWARD = 1.0
+REJECT_PENALTY = 40.0
+PRUNE_SCORE = -40.0
+MAX_SCORE = 100.0
+
+MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
+MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
+
+
+def eth2_msg_id(topic: str, data: bytes) -> bytes:
+    """Post-Altair eth2 message id (ref: utils.go MsgID)."""
+    h = hashlib.sha256()
+    try:
+        payload = raw_decompress(data)
+        h.update(MESSAGE_DOMAIN_VALID_SNAPPY)
+    except SnappyError:
+        payload = data
+        h.update(MESSAGE_DOMAIN_INVALID_SNAPPY)
+    h.update(struct.pack("<Q", len(topic)))
+    h.update(topic.encode())
+    h.update(payload)
+    return h.digest()[:20]
+
+
+def encode_rpc(rpc: pb.RPC) -> bytes:
+    raw = rpc.SerializeToString()
+    return varint.encode(len(raw)) + raw
+
+
+async def _read_rpc(stream) -> pb.RPC:
+    try:
+        length = await varint.read(stream, max_shift=31)
+    except varint.VarintError as e:
+        raise Libp2pError(str(e)) from None
+    if length > MAX_RPC:
+        raise Libp2pError(f"oversized rpc ({length})")
+    return pb.RPC.FromString(await stream.readexactly(length))
+
+
+class _PeerState:
+    def __init__(self, peer_id: PeerId):
+        self.peer_id = peer_id
+        self.topics: set[str] = set()
+        self.score = 0.0
+        self.stream = None  # our outbound meshsub stream
+        self.send_lock = asyncio.Lock()
+
+
+class Gossipsub:
+    """The router.  ``validator(topic, data, msg_id, peer_id) -> verdict``
+    decides forwarding; absent a validator everything is accepted."""
+
+    def __init__(self, host: Libp2pHost, validator=None):
+        self.host = host
+        self.validator = validator
+        self.peers: dict[PeerId, _PeerState] = {}
+        self.subscriptions: set[str] = set()
+        self.mesh: dict[str, set[PeerId]] = {}
+        self.fanout: dict[str, tuple[set[PeerId], float]] = {}
+        # seen-cache: msg_id -> expiry, ids only (550 heartbeats, as the
+        # reference's WithSeenMessagesTTL) — REJECTed ids stay here so
+        # invalid messages are not re-validated, but only ACCEPTed
+        # payloads enter mcache and become IHAVE/IWANT-servable
+        self.seen: OrderedDict[bytes, float] = OrderedDict()
+        # message cache: msg_id -> (topic, data), retained for exactly the
+        # HISTORY_LENGTH gossip windows (payloads drop out with rotation)
+        self.mcache: dict[bytes, tuple[str, bytes]] = {}
+        # gossip windows: lists of msg-ids, newest first
+        self._history: list[list[bytes]] = []
+        self._current_window: list[bytes] = []
+        self._heartbeat_task: asyncio.Task | None = None
+        host.set_stream_handler(MESHSUB_PROTOCOL, self._inbound)
+        self._prev_on_peer = host.on_peer
+        host.on_peer = self._on_peer
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._heartbeat_task is None:
+            self._heartbeat_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            self._heartbeat_task = None
+
+    # ------------------------------------------------------------- peering
+    async def _on_peer(self, peer_id: PeerId, addr: str) -> None:
+        state = _PeerState(peer_id)
+        self.peers[peer_id] = state
+        if self.subscriptions:
+            rpc = pb.RPC()
+            for topic in sorted(self.subscriptions):
+                sub = rpc.subscriptions.add()
+                sub.subscribe = True
+                sub.topicid = topic
+            await self._send_rpc(state, rpc)
+        if self._prev_on_peer is not None:
+            await self._prev_on_peer(peer_id, addr)
+
+    SEND_TIMEOUT_S = 5.0
+
+    async def _send_rpc(self, state: _PeerState, rpc: pb.RPC) -> None:
+        try:
+            # bounded: a peer that accepts the stream but never answers
+            # multistream (or stops reading) must not stall the heartbeat
+            # and every later peer in a forward loop behind its send_lock
+            await asyncio.wait_for(self._send_rpc_inner(state, rpc), self.SEND_TIMEOUT_S)
+        except (
+            Libp2pError,
+            ConnectionError,
+            OSError,
+            asyncio.IncompleteReadError,
+            asyncio.TimeoutError,
+        ):
+            self._drop_peer(state.peer_id)
+
+    async def _send_rpc_inner(self, state: _PeerState, rpc: pb.RPC) -> None:
+        async with state.send_lock:
+            if state.stream is None:
+                state.stream, _ = await self.host.new_stream(
+                    state.peer_id, [MESHSUB_PROTOCOL]
+                )
+            state.stream.write(encode_rpc(rpc))
+            await state.stream.drain()
+
+    def _drop_peer(self, peer_id: PeerId) -> None:
+        self.peers.pop(peer_id, None)
+        for members in self.mesh.values():
+            members.discard(peer_id)
+        for members, _ in self.fanout.values():
+            members.discard(peer_id)
+
+    # ------------------------------------------------------------- inbound
+    async def _inbound(self, stream, protocol: str, peer_id: PeerId) -> None:
+        state = self.peers.get(peer_id)
+        if state is None:
+            state = _PeerState(peer_id)
+            self.peers[peer_id] = state
+        try:
+            while True:
+                rpc = await _read_rpc(stream)
+                await self._handle_rpc(state, rpc)
+        except (asyncio.IncompleteReadError, Libp2pError, ConnectionError):
+            pass
+        finally:
+            self._drop_peer(peer_id)
+
+    async def _handle_rpc(self, state: _PeerState, rpc: pb.RPC) -> None:
+        for sub in rpc.subscriptions:
+            if sub.subscribe:
+                state.topics.add(sub.topicid)
+            else:
+                state.topics.discard(sub.topicid)
+                self.mesh.get(sub.topicid, set()).discard(state.peer_id)
+        for msg in rpc.publish:
+            await self._on_publish(state, msg)
+        if rpc.HasField("control"):
+            await self._on_control(state, rpc.control)
+
+    async def _on_publish(self, state: _PeerState, msg: pb.Message) -> None:
+        # StrictNoSign (ref: subscriptions.go WithMessageSignaturePolicy):
+        # author/seqno/signature on the wire is a protocol violation
+        # (proto3 presence: absent scalar/bytes fields read as empty)
+        if getattr(msg, "from") or msg.seqno or msg.signature or msg.key:
+            state.score -= REJECT_PENALTY
+            return
+        topic = msg.topic
+        if topic not in self.subscriptions:
+            return
+        msg_id = eth2_msg_id(topic, msg.data)
+        if not self._mark_seen(msg_id):
+            return
+        verdict = ACCEPT
+        if self.validator is not None:
+            verdict = await self.validator(topic, msg.data, msg_id, state.peer_id)
+        if verdict == ACCEPT:
+            # only now does the payload enter the gossip cache: a REJECTed
+            # message must never be IHAVE-advertised or IWANT-served
+            self._remember(msg_id, topic, msg.data)
+            state.score = min(MAX_SCORE, state.score + ACCEPT_REWARD)
+            await self._forward(topic, msg.data, exclude=state.peer_id)
+        elif verdict == REJECT:
+            state.score -= REJECT_PENALTY
+            if state.score <= PRUNE_SCORE:
+                for topic_, members in list(self.mesh.items()):
+                    if state.peer_id in members:
+                        members.discard(state.peer_id)
+                        await self._send_control(state, prune=[topic_])
+
+    async def _on_control(self, state: _PeerState, ctl: pb.ControlMessage) -> None:
+        for graft in ctl.graft:
+            topic = graft.topic_id
+            if topic in self.subscriptions and state.score > PRUNE_SCORE:
+                self.mesh.setdefault(topic, set()).add(state.peer_id)
+            else:
+                await self._send_control(state, prune=[topic])
+        for prune in ctl.prune:
+            self.mesh.get(prune.topic_id, set()).discard(state.peer_id)
+        wanted: list[bytes] = []
+        for ihave in ctl.ihave:
+            if ihave.topic_id in self.subscriptions:
+                wanted += [m for m in ihave.message_ids if m not in self.seen]
+        if wanted:
+            rpc = pb.RPC()
+            rpc.control.iwant.add().message_ids.extend(wanted)
+            await self._send_rpc(state, rpc)
+        serve: list[tuple[str, bytes]] = []
+        for iwant in ctl.iwant:
+            for mid in iwant.message_ids:
+                entry = self.mcache.get(mid)
+                if entry is not None:
+                    serve.append(entry)
+        if serve:
+            rpc = pb.RPC()
+            for topic, data in serve:
+                m = rpc.publish.add()
+                m.topic = topic
+                m.data = data
+            await self._send_rpc(state, rpc)
+
+    # ------------------------------------------------------------- outbound
+    async def subscribe(self, topic: str) -> None:
+        self.subscriptions.add(topic)
+        self.mesh.setdefault(topic, set())
+        rpc = pb.RPC()
+        sub = rpc.subscriptions.add()
+        sub.subscribe = True
+        sub.topicid = topic
+        for state in list(self.peers.values()):
+            await self._send_rpc(state, rpc)
+        await self._maintain(topic)
+
+    async def unsubscribe(self, topic: str) -> None:
+        self.subscriptions.discard(topic)
+        rpc = pb.RPC()
+        sub = rpc.subscriptions.add()
+        sub.subscribe = False
+        sub.topicid = topic
+        members = self.mesh.pop(topic, set())
+        for state in list(self.peers.values()):
+            out = pb.RPC()
+            out.CopyFrom(rpc)
+            if state.peer_id in members:
+                out.control.prune.add().topic_id = topic
+            await self._send_rpc(state, out)
+
+    async def publish(self, topic: str, data: bytes) -> bytes:
+        msg_id = eth2_msg_id(topic, data)
+        self._mark_seen(msg_id)
+        self._remember(msg_id, topic, data)
+        await self._forward(topic, data, exclude=None)
+        return msg_id
+
+    def _targets(self, topic: str, exclude: PeerId | None) -> list[_PeerState]:
+        if topic in self.subscriptions:
+            members = self.mesh.get(topic, set())
+        else:
+            # fanout: not subscribed, but publishing — keep D subscribers
+            members, _ = self.fanout.get(topic, (set(), 0.0))
+            members &= set(self.peers)
+            if not members:
+                members = {
+                    s.peer_id
+                    for s in self.peers.values()
+                    if topic in s.topics
+                }
+                members = set(list(members)[:D])
+            self.fanout[topic] = (members, time.monotonic() + FANOUT_TTL_S)
+        return [
+            self.peers[p] for p in members if p != exclude and p in self.peers
+        ]
+
+    async def _forward(self, topic: str, data: bytes, exclude: PeerId | None) -> None:
+        rpc = pb.RPC()
+        msg = rpc.publish.add()
+        msg.topic = topic
+        msg.data = data
+        for state in self._targets(topic, exclude):
+            await self._send_rpc(state, rpc)
+
+    async def _send_control(
+        self, state: _PeerState, graft: list[str] = (), prune: list[str] = ()
+    ) -> None:
+        rpc = pb.RPC()
+        for topic in graft:
+            rpc.control.graft.add().topic_id = topic
+        for topic in prune:
+            rpc.control.prune.add().topic_id = topic
+        await self._send_rpc(state, rpc)
+
+    # ------------------------------------------------------------ heartbeat
+    def _mark_seen(self, msg_id: bytes) -> bool:
+        """True if newly seen; purges expired ids opportunistically."""
+        if msg_id in self.seen:
+            return False
+        now = time.monotonic()
+        self.seen[msg_id] = now + SEEN_TTL_S
+        while self.seen:
+            first = next(iter(self.seen))
+            if self.seen[first] < now:
+                del self.seen[first]
+            else:
+                break
+        return True
+
+    def _remember(self, msg_id: bytes, topic: str, data: bytes) -> None:
+        self.mcache[msg_id] = (topic, data)
+        self._current_window.append(msg_id)
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(HEARTBEAT_S)
+            try:
+                await self.heartbeat()
+            except Exception:
+                pass  # the loop must survive transient send errors
+
+    async def heartbeat(self) -> None:
+        # rotate gossip windows; payloads whose window ages out leave the
+        # message cache (ids stay in `seen` for dedup)
+        self._history.insert(0, self._current_window)
+        self._current_window = []
+        for expired in self._history[HISTORY_LENGTH:]:
+            for mid in expired:
+                self.mcache.pop(mid, None)
+        del self._history[HISTORY_LENGTH:]
+        now = time.monotonic()
+        for topic, (members, expiry) in list(self.fanout.items()):
+            if expiry < now:
+                del self.fanout[topic]
+        for topic in list(self.subscriptions):
+            await self._maintain(topic)
+            await self._emit_gossip(topic)
+
+    async def _maintain(self, topic: str) -> None:
+        members = self.mesh.setdefault(topic, set())
+        members &= set(self.peers)
+        if len(members) < D_LO:
+            candidates = sorted(
+                (
+                    s
+                    for s in self.peers.values()
+                    if topic in s.topics
+                    and s.peer_id not in members
+                    and s.score > PRUNE_SCORE
+                ),
+                key=lambda s: -s.score,
+            )
+            for state in candidates[: D - len(members)]:
+                members.add(state.peer_id)
+                await self._send_control(state, graft=[topic])
+        elif len(members) > D_HI:
+            ranked = sorted(
+                members,
+                key=lambda p: self.peers[p].score if p in self.peers else 0.0,
+                reverse=True,
+            )
+            for peer_id in ranked[D:]:
+                members.discard(peer_id)
+                state = self.peers.get(peer_id)
+                if state is not None:
+                    await self._send_control(state, prune=[topic])
+
+    async def _emit_gossip(self, topic: str) -> None:
+        """IHAVE the last HISTORY_GOSSIP windows' ids to up-to-D
+        subscribed peers outside the mesh (gossipsub spec §gossip)."""
+        ids = [
+            mid
+            for window in self._history[:HISTORY_GOSSIP]
+            for mid in window
+            if mid in self.mcache and self.mcache[mid][0] == topic
+        ]
+        if not ids:
+            return
+        members = self.mesh.get(topic, set())
+        audience = [
+            s
+            for s in self.peers.values()
+            if topic in s.topics and s.peer_id not in members and s.score >= 0
+        ][:D]
+        for state in audience:
+            rpc = pb.RPC()
+            ih = rpc.control.ihave.add()
+            ih.topic_id = topic
+            ih.message_ids.extend(ids)
+            await self._send_rpc(state, rpc)

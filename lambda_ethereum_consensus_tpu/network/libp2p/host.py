@@ -22,7 +22,7 @@ from __future__ import annotations
 import asyncio
 
 from .identity import Identity, PeerId
-from .mplex import Mplex, MplexStream
+from .mplex import Mplex, MplexError, MplexStream
 from .multistream import NegotiationError, handle as ms_handle, select as ms_select
 from .noise_transport import secure_connection
 
@@ -52,6 +52,7 @@ class Libp2pHost:
         self.handlers: dict[str, object] = {}  # protocol -> async handler
         self._server: asyncio.AbstractServer | None = None
         self.on_peer = None  # optional async callback(PeerId, addr)
+        self.on_peer_gone = None  # optional async callback(PeerId)
 
     # ------------------------------------------------------------ lifecycle
     async def listen(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
@@ -81,9 +82,13 @@ class Libp2pHost:
             conn = await asyncio.wait_for(
                 self._upgrade(reader, writer, initiator=True), timeout
             )
-        except (NegotiationError, asyncio.TimeoutError, OSError) as e:
+        except Exception as e:
+            # negotiation/noise/varint/short-read — anything a hostile or
+            # non-libp2p endpoint can provoke must surface as Libp2pError
+            # with the socket closed, never a leaked writer + stray task
+            # exception
             writer.close()
-            raise Libp2pError(f"dial {host}:{port}: {e}") from None
+            raise Libp2pError(f"dial {host}:{port}: {type(e).__name__}: {e}") from None
         await self._register(conn, f"{host}:{port}")
         return conn.peer_id
 
@@ -128,6 +133,11 @@ class Libp2pHost:
         finally:
             if self.connections.get(conn.peer_id) is conn:
                 del self.connections[conn.peer_id]
+                if self.on_peer_gone is not None:
+                    try:
+                        await self.on_peer_gone(conn.peer_id)
+                    except Exception:
+                        pass
             conn.channel.close()
 
     # -------------------------------------------------------------- streams
@@ -168,3 +178,7 @@ class Libp2pHost:
         except asyncio.TimeoutError:
             await stream.reset()
             raise Libp2pError(f"request timed out on {protocol}") from None
+        except (MplexError, ConnectionError, OSError) as e:
+            # peer reset / connection death mid-request: the caller gets a
+            # typed failure, not a stranded task
+            raise Libp2pError(f"request failed on {protocol}: {e}") from None

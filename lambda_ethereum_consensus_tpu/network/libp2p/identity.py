@@ -73,31 +73,18 @@ def base58_decode(text: str) -> bytes:
 
 # ----------------------------------------------------- minimal protobuf I/O
 
+from . import varint
+
+
 def _pb_varint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
+    return varint.encode(n)
 
 
 def _pb_read_varint(data: bytes, pos: int) -> tuple[int, int]:
-    shift = n = 0
-    while True:
-        if pos >= len(data):
-            raise IdentityError("truncated varint")
-        b = data[pos]
-        pos += 1
-        n |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return n, pos
-        shift += 7
-        if shift > 63:
-            raise IdentityError("varint too long")
+    try:
+        return varint.decode(data, pos)
+    except varint.VarintError as e:
+        raise IdentityError(str(e)) from None
 
 
 def _pb_fields(data: bytes) -> dict[int, bytes | int]:

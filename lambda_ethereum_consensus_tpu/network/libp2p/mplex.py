@@ -33,32 +33,11 @@ class MplexError(Exception):
     pass
 
 
+from . import varint
+
+
 def encode_frame(stream_id: int, flag: int, data: bytes = b"") -> bytes:
-    return _varint(stream_id << 3 | flag) + _varint(len(data)) + data
-
-
-def _varint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-async def _read_varint(reader) -> int:
-    shift = n = 0
-    while True:
-        b = (await reader.readexactly(1))[0]
-        n |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return n
-        shift += 7
-        if shift > 63:
-            raise MplexError("varint too long")
+    return varint.encode(stream_id << 3 | flag) + varint.encode(len(data)) + data
 
 
 class MplexStream:
@@ -72,6 +51,7 @@ class MplexStream:
         self._buf = bytearray()
         self._eof = False
         self._reset = False
+        self._local_closed = False
         self._recv_event = asyncio.Event()
         self._out = bytearray()
 
@@ -83,6 +63,13 @@ class MplexStream:
     def _feed_eof(self) -> None:
         self._eof = True
         self._recv_event.set()
+        self._maybe_finished()
+
+    def _maybe_finished(self) -> None:
+        # both half-closes seen: the muxer can forget the stream (the app
+        # still holds the object and can drain the remaining buffer)
+        if self._eof and self._local_closed:
+            self._muxer._drop(self.stream_id, self._we_initiated)
 
     def _feed_reset(self) -> None:
         self._reset = True
@@ -133,6 +120,8 @@ class MplexStream:
         await self.drain()
         flag = CLOSE_INITIATOR if self._we_initiated else CLOSE_RECEIVER
         await self._muxer._send(encode_frame(self.stream_id, flag))
+        self._local_closed = True
+        self._maybe_finished()
 
     async def reset(self) -> None:
         flag = RESET_INITIATOR if self._we_initiated else RESET_RECEIVER
@@ -175,14 +164,20 @@ class Mplex:
         """Read loop: dispatch frames until the channel dies."""
         try:
             while True:
-                header = await _read_varint(self._channel)
-                length = await _read_varint(self._channel)
+                header = await varint.read(self._channel)
+                length = await varint.read(self._channel)
                 if length > MAX_MSG:
                     raise MplexError(f"oversized mplex frame ({length})")
                 data = await self._channel.readexactly(length) if length else b""
                 await self._dispatch(header >> 3, header & 7, data)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            pass
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            MplexError,
+            varint.VarintError,
+        ):
+            pass  # connection dead or peer spoke garbage: tear down
         finally:
             self._closed = True
             for stream in [*self._ours.values(), *self._theirs.values()]:

@@ -28,37 +28,19 @@ class NegotiationError(Exception):
     pass
 
 
+from . import varint
+
+
 def encode_msg(proto: str) -> bytes:
     line = proto.encode() + b"\n"
-    return _varint(len(line)) + line
-
-
-def _varint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        if n:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-async def _read_varint(reader) -> int:
-    shift = n = 0
-    while True:
-        b = (await reader.readexactly(1))[0]
-        n |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return n
-        shift += 7
-        if shift > 31:
-            raise NegotiationError("varint too long")
+    return varint.encode(len(line)) + line
 
 
 async def read_msg(reader) -> str:
-    length = await _read_varint(reader)
+    try:
+        length = await varint.read(reader, max_shift=31)
+    except varint.VarintError as e:
+        raise NegotiationError(str(e)) from None
     if length == 0 or length > MAX_LINE:
         raise NegotiationError(f"bad multistream message length {length}")
     line = await reader.readexactly(length)

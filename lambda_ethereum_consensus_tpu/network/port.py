@@ -60,6 +60,7 @@ class Port:
         fork_digest: bytes = b"",
         enable_peer_exchange: bool = True,
         key_file: str | None = None,
+        wire: str | None = None,
     ) -> "Port":
         self = cls()
         env = dict(os.environ)
@@ -69,6 +70,10 @@ class Port:
             # persistent noise identity: without it, a restart rotates the
             # static key and a graylisted peer sheds its ban (ADVICE r2)
             env.setdefault("SIDECAR_KEY_FILE", key_file)
+        if wire:
+            # "libp2p" = real wire protocols (sidecar_libp2p.py); default
+            # is the bespoke-frame transport
+            env["SIDECAR_WIRE"] = wire
         self._proc = await asyncio.create_subprocess_exec(
             sys.executable,
             "-m",

@@ -734,6 +734,13 @@ async def _main() -> None:
 
 
 def main() -> None:
+    if os.environ.get("SIDECAR_WIRE") == "libp2p":
+        # real libp2p wire protocols (multistream/noise/mplex/meshsub)
+        # behind the same stdio contract — see sidecar_libp2p.py
+        from .sidecar_libp2p import main as libp2p_main
+
+        libp2p_main()
+        return
     try:
         asyncio.run(_main())
     except (KeyboardInterrupt, asyncio.IncompleteReadError, EOFError):

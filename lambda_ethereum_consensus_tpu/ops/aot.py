@@ -141,12 +141,15 @@ def aot_jit(fn, name: str):
                 compiled = lowered.compile()
                 break
             except Exception as e:
+                # only the tunnel's transport faults are retryable —
+                # bare INTERNAL can also be a deterministic compiler
+                # error, which retrying would just triple
                 msg = str(e)
                 transient = (
-                    "INTERNAL" in msg
-                    or "DEADLINE" in msg
+                    "remote_compile" in msg
                     or "response body closed" in msg
                     or "connection reset" in msg.lower()
+                    or "DEADLINE" in msg
                 )
                 if attempt == 2 or not transient:
                     raise

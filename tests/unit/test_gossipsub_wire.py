@@ -1,0 +1,212 @@
+"""gossipsub v1.1 wire conformance + meshsub loopback propagation.
+
+RPC bytes are checked against the go-libp2p-pubsub pb/rpc.proto layout
+(what the reference speaks — ref: subscriptions.go:31-77); the message
+id reimplements utils.go MsgID and is asserted against an independent
+hashlib computation.  The propagation tests run REAL /meshsub/1.1.0
+streams over the full libp2p stack (TCP + noise + mplex).
+"""
+
+import asyncio
+import hashlib
+import struct
+
+from lambda_ethereum_consensus_tpu.compression.snappy import compress as raw_compress
+from lambda_ethereum_consensus_tpu.network.libp2p import gossipsub as gs
+from lambda_ethereum_consensus_tpu.network.libp2p.host import Libp2pHost
+from lambda_ethereum_consensus_tpu.network.proto import gossipsub_pb2 as pb
+
+
+# ------------------------------------------------------------- wire bytes
+
+def test_rpc_subscription_bytes():
+    # RPC{subscriptions:[{subscribe:true, topicid:"t"}]} — field 1
+    # submessage, inner field 1 varint, field 2 string (pb/rpc.proto)
+    rpc = pb.RPC()
+    sub = rpc.subscriptions.add()
+    sub.subscribe = True
+    sub.topicid = "t"
+    assert rpc.SerializeToString() == bytes.fromhex("0a050801120174")
+
+
+def test_rpc_publish_strict_nosign_bytes():
+    # eth2 StrictNoSign publish: ONLY data(2) and topic(4) on the wire
+    rpc = pb.RPC()
+    msg = rpc.publish.add()
+    msg.data = b"\xaa\xbb"
+    msg.topic = "top"
+    raw = rpc.SerializeToString()
+    # RPC field 2 (0x12), len 9; Message: 0x12 (data) len 2, 0x22 (topic) len 3
+    assert raw == b"\x12\x09\x12\x02\xaa\xbb\x22\x03top"
+
+
+def test_rpc_control_graft_bytes():
+    rpc = pb.RPC()
+    rpc.control.graft.add().topic_id = "t"
+    # RPC field 3 (0x1a), ControlMessage field 3 graft (0x1a), inner topic 0x0a
+    assert rpc.SerializeToString() == b"\x1a\x05\x1a\x03\x0a\x01t"
+
+
+def test_varint_delimited_framing():
+    rpc = pb.RPC()
+    rpc.control.iwant.add().message_ids.append(b"\x01" * 20)
+    framed = gs.encode_rpc(rpc)
+    body = rpc.SerializeToString()
+    assert framed == bytes([len(body)]) + body
+
+
+# ----------------------------------------------------------------- msg id
+
+def test_eth2_msg_id_valid_snappy():
+    """Independent recomputation of the post-Altair id formula
+    (ref: utils.go MsgID)."""
+    topic = "/eth2/bba4da96/beacon_block/ssz_snappy"
+    payload = b"block-bytes-here"
+    data = raw_compress(payload)
+    expect = hashlib.sha256(
+        b"\x01\x00\x00\x00" + struct.pack("<Q", len(topic)) + topic.encode() + payload
+    ).digest()[:20]
+    assert gs.eth2_msg_id(topic, data) == expect
+
+
+def test_eth2_msg_id_invalid_snappy():
+    topic = "/eth2/bba4da96/beacon_block/ssz_snappy"
+    garbage = b"\xff\xfe\xfd not snappy"
+    expect = hashlib.sha256(
+        b"\x00\x00\x00\x00" + struct.pack("<Q", len(topic)) + topic.encode() + garbage
+    ).digest()[:20]
+    assert gs.eth2_msg_id(topic, garbage) == expect
+
+
+# ------------------------------------------------------------- propagation
+
+TOPIC = "/eth2/bba4da96/beacon_block/ssz_snappy"
+
+
+async def _mesh_pair():
+    """Two connected routers subscribed to TOPIC with grafted meshes."""
+    h1, h2 = Libp2pHost(), Libp2pHost()
+    g1, g2 = gs.Gossipsub(h1), gs.Gossipsub(h2)
+    host, port = await h2.listen()
+    await h1.dial(host, port)
+    await asyncio.sleep(0.05)  # let the accept-side register the peer
+    await g1.subscribe(TOPIC)
+    await g2.subscribe(TOPIC)
+    await asyncio.sleep(0.05)  # subscription RPCs in flight
+    await g1._maintain(TOPIC)
+    await g2._maintain(TOPIC)
+    await asyncio.sleep(0.05)  # GRAFTs in flight
+    return (h1, g1), (h2, g2)
+
+
+def test_publish_reaches_subscriber_and_validator_gates():
+    async def scenario():
+        (h1, g1), (h2, g2) = await _mesh_pair()
+        got = []
+
+        async def validator(topic, data, msg_id, peer_id):
+            got.append((topic, data, msg_id))
+            return gs.ACCEPT
+
+        g2.validator = validator
+        payload = raw_compress(b"a beacon block")
+        msg_id = await g1.publish(TOPIC, payload)
+        await asyncio.sleep(0.1)
+        await h1.close()
+        await h2.close()
+        return got, msg_id
+
+    got, msg_id = asyncio.run(scenario())
+    assert got == [(TOPIC, raw_compress(b"a beacon block"), msg_id)]
+
+
+def test_reject_downscores_and_does_not_forward():
+    async def scenario():
+        (h1, g1), (h2, g2) = await _mesh_pair()
+
+        async def reject_all(topic, data, msg_id, peer_id):
+            return gs.REJECT
+
+        g2.validator = reject_all
+        payload = raw_compress(b"bad")
+        msg_id = await g1.publish(TOPIC, payload)
+        await asyncio.sleep(0.1)
+        scores = [s.score for s in g2.peers.values()]
+        # rejected: deduped via seen, but never IHAVE/IWANT-servable
+        cached = msg_id in g2.mcache
+        seen = msg_id in g2.seen
+        await h1.close()
+        await h2.close()
+        return scores, cached, seen
+
+    scores, cached, seen = asyncio.run(scenario())
+    assert scores and scores[0] <= -gs.REJECT_PENALTY + 1e-9
+    assert seen and not cached
+
+
+def test_ihave_iwant_recovery():
+    """A peer OUTSIDE the mesh learns a message id via IHAVE gossip and
+    pulls the full message with IWANT."""
+
+    async def scenario():
+        (h1, g1), (h2, g2) = await _mesh_pair()
+        payload = raw_compress(b"gossiped block")
+        msg_id = await g1.publish(TOPIC, payload)
+        # simulate "outside the mesh": clear g1's mesh view of g2, then
+        # run a heartbeat — the IHAVE audience is subscribed non-mesh peers
+        g1.mesh[TOPIC].clear()
+        await g1.heartbeat()  # rotates the window, emits IHAVE to g2
+        # g2 received the original publish: wipe both its caches so the
+        # id reads as unseen and the IWANT path must fetch the payload
+        g2.mcache.pop(msg_id, None)
+        g2.seen.pop(msg_id, None)
+        received = []
+
+        async def validator(topic, data, mid, peer_id):
+            received.append((mid, data))
+            return gs.ACCEPT
+
+        g2.validator = validator
+        await g1.heartbeat()
+        await asyncio.sleep(0.2)
+        await h1.close()
+        await h2.close()
+        return received, msg_id, payload
+
+    received, msg_id, payload = asyncio.run(scenario())
+    assert (msg_id, payload) in received
+
+
+def test_three_node_mesh_relay():
+    """A -> B -> C: C gets A's publish relayed through B's mesh over the
+    real wire stack (no direct A-C connection)."""
+
+    async def scenario():
+        ha, hb, hc = Libp2pHost(), Libp2pHost(), Libp2pHost()
+        ga, gb, gc = gs.Gossipsub(ha), gs.Gossipsub(hb), gs.Gossipsub(hc)
+        bhost, bport = await hb.listen()
+        await ha.dial(bhost, bport)
+        await hc.dial(bhost, bport)
+        await asyncio.sleep(0.05)
+        for g in (ga, gb, gc):
+            await g.subscribe(TOPIC)
+        await asyncio.sleep(0.05)
+        for g in (ga, gb, gc):
+            await g._maintain(TOPIC)
+        await asyncio.sleep(0.05)
+        seen_c = []
+
+        async def validator(topic, data, msg_id, peer_id):
+            seen_c.append(data)
+            return gs.ACCEPT
+
+        gc.validator = validator
+        payload = raw_compress(b"relayed block")
+        await ga.publish(TOPIC, payload)
+        await asyncio.sleep(0.2)
+        for h in (ha, hb, hc):
+            await h.close()
+        return seen_c, payload
+
+    seen_c, payload = asyncio.run(scenario())
+    assert payload in seen_c

@@ -1,0 +1,164 @@
+"""The Port contract served over the REAL libp2p wire (SIDECAR_WIRE=libp2p).
+
+Same host-side API as tests/unit/test_network_port.py, but the spawned
+sidecar subprocess speaks multistream-select + noise + mplex + meshsub
+on the wire (network/sidecar_libp2p.py) — proving the host runtime is
+wire-agnostic, as the reference's is behind its Go port (ref:
+lib/libp2p_port.ex + native/libp2p_port/main.go).
+"""
+
+import asyncio
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.network.port import (
+    Port,
+    PortError,
+    VERDICT_ACCEPT,
+    VERDICT_REJECT,
+)
+
+TOPIC = "/eth2/bba4da96/beacon_block/ssz_snappy"
+STATUS = "/eth2/beacon_chain/req/status/1/ssz_snappy"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_pair():
+    recver = await Port.start(wire="libp2p")
+    sender = await Port.start(wire="libp2p")
+    connected = asyncio.Event()
+    peers = {}
+
+    def on_new_peer(peer_id, addr):
+        peers["id"] = peer_id
+        connected.set()
+
+    sender.on_new_peer = on_new_peer
+    await sender.add_peer(f"127.0.0.1:{recver.listen_port}")
+    await asyncio.wait_for(connected.wait(), 10)
+    return sender, recver, peers["id"]
+
+
+def test_identity_is_libp2p_peer_id():
+    async def main():
+        port = await Port.start(wire="libp2p")
+        node_id = port.node_id
+        await port.close()
+        return node_id
+
+    node_id = run(main())
+    # ed25519 identity multihash: 0x00 0x24, then the 36-byte PublicKey pb
+    assert node_id[:4] == b"\x00\x24\x08\x01" and len(node_id) == 38
+
+
+def test_reqresp_roundtrip_over_libp2p():
+    async def main():
+        sender, recver, peer_id = await start_pair()
+        served = {}
+
+        async def handle(protocol_id, request_id, payload, from_peer):
+            served["protocol"] = protocol_id
+            served["payload"] = payload
+            await recver.send_response(request_id, b"resp:" + payload)
+
+        await recver.set_request_handler(STATUS, handle)
+        reply = await sender.send_request(peer_id, STATUS, b"my-status")
+        await sender.close()
+        await recver.close()
+        return served, reply
+
+    served, reply = run(main())
+    assert served == {"protocol": STATUS, "payload": b"my-status"}
+    assert reply == b"resp:my-status"
+
+
+def test_unsupported_protocol_errors_cleanly():
+    async def main():
+        sender, recver, peer_id = await start_pair()
+        try:
+            await sender.send_request(peer_id, "/eth2/nope/1/ssz_snappy", b"x")
+            raise AssertionError("should have failed")
+        except PortError:
+            pass
+        finally:
+            await sender.close()
+            await recver.close()
+
+    run(main())
+
+
+def test_gossip_validation_over_meshsub():
+    async def main():
+        sender, recver, _hr = await start_pair()
+        got = asyncio.Event()
+        seen = {}
+
+        async def on_gossip(topic, msg_id, payload, from_peer):
+            seen["topic"] = topic
+            seen["payload"] = payload
+            await recver.validate_message(msg_id, VERDICT_ACCEPT)
+            got.set()
+
+        await recver.subscribe(TOPIC, on_gossip)
+        await sender.subscribe(TOPIC, lambda *a: None)
+        await asyncio.sleep(1.0)  # heartbeat grafts the meshes
+        await sender.publish(TOPIC, b"hello-block")
+        await asyncio.wait_for(got.wait(), 10)
+        await sender.close()
+        await recver.close()
+        return seen
+
+    seen = run(main())
+    assert seen == {"topic": TOPIC, "payload": b"hello-block"}
+
+
+@pytest.mark.slow
+def test_gossip_relays_through_middle_node_libp2p():
+    async def main():
+        a = await Port.start(wire="libp2p")
+        b = await Port.start(wire="libp2p")
+        c = await Port.start(wire="libp2p")
+        await a.add_peer(f"127.0.0.1:{b.listen_port}")
+        await c.add_peer(f"127.0.0.1:{b.listen_port}")
+        got_c = asyncio.Event()
+
+        async def on_b(topic, msg_id, payload, from_peer):
+            await b.validate_message(msg_id, VERDICT_ACCEPT)
+
+        async def on_c(topic, msg_id, payload, from_peer):
+            await c.validate_message(msg_id, VERDICT_ACCEPT)
+            got_c.set()
+
+        await b.subscribe(TOPIC, on_b)
+        await c.subscribe(TOPIC, on_c)
+        await a.subscribe(TOPIC, lambda *args: None)
+        await asyncio.sleep(1.2)  # two heartbeats: subs spread, meshes graft
+        await a.publish(TOPIC, b"relay-me")
+        await asyncio.wait_for(got_c.wait(), 10)
+        for port in (a, b, c):
+            await port.close()
+
+    run(main())
+
+
+def test_rejects_feed_scoring_libp2p():
+    async def main():
+        sender, recver, _ = await start_pair()
+        rejected = asyncio.Event()
+
+        async def on_gossip(topic, msg_id, payload, from_peer):
+            await recver.validate_message(msg_id, VERDICT_REJECT)
+            rejected.set()
+
+        await recver.subscribe(TOPIC, on_gossip)
+        await sender.subscribe(TOPIC, lambda *a: None)
+        await asyncio.sleep(1.0)
+        await sender.publish(TOPIC, b"bad-msg")
+        await asyncio.wait_for(rejected.wait(), 10)
+        await sender.close()
+        await recver.close()
+
+    run(main())
