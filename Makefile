@@ -39,6 +39,15 @@ spec-vectors:
 spec-test:
 	python -m pytest tests/spec -q -m spectest
 
+# Egress-free proof that the official pipeline works end-to-end: mint a
+# synthetic corpus in the exact consensus-spec-tests layout, then run the
+# SAME discovery/runner/diff path `make spec-vectors && make spec-test`
+# uses.  Every runner gets at least one case (incl. negatives).
+spec-test-dryrun:
+	rm -rf vendor/consensus-spec-tests-synthetic
+	python -m lambda_ethereum_consensus_tpu.spec_tests.mint vendor/consensus-spec-tests-synthetic
+	SPEC_TESTS_DIR=vendor/consensus-spec-tests-synthetic python -m pytest tests/spec -q -m spectest
+
 bench:
 	python bench.py
 

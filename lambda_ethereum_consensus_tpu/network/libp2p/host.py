@@ -20,6 +20,7 @@ request, half-close, read the response to EOF (ref: reqresp.go:73-86).
 from __future__ import annotations
 
 import asyncio
+import logging
 
 from .identity import Identity, PeerId
 from .mplex import Mplex, MplexError, MplexStream
@@ -144,14 +145,25 @@ class Libp2pHost:
     async def _inbound_stream(self, stream: MplexStream) -> None:
         try:
             protocol = await ms_handle(stream, stream, sorted(self.handlers))
-        except (NegotiationError, asyncio.IncompleteReadError, Exception):
+        except (NegotiationError, asyncio.IncompleteReadError, MplexError):
+            await stream.reset()  # peer protocol error / stream death
+            return
+        except Exception:
+            # a local bug (bad handler registry etc.) must be diagnosable,
+            # not a silent reset indistinguishable from peer misbehavior
+            logging.getLogger("libp2p.host").exception("inbound negotiation failed")
             await stream.reset()
             return
         peer_id = stream._muxer._channel.peer_id
         handler = self.handlers[protocol]
         try:
             await handler(stream, protocol, peer_id)
+        except (MplexError, asyncio.IncompleteReadError, ConnectionError, OSError):
+            await stream.reset()
         except Exception:
+            logging.getLogger("libp2p.host").exception(
+                "stream handler failed for %s", protocol
+            )
             await stream.reset()
 
     async def new_stream(self, peer_id: PeerId, protocols: list[str]) -> tuple[MplexStream, str]:

@@ -33,6 +33,7 @@ class MplexError(Exception):
     pass
 
 
+from ..noise import NoiseError
 from . import varint
 
 
@@ -109,6 +110,12 @@ class MplexStream:
         self._out += data
 
     async def drain(self) -> None:
+        # a reset/dead stream must FAIL the send, not blackhole it — the
+        # gossipsub layer relies on this to drop peers whose meshsub
+        # stream died (a silently-successful drain would leave them
+        # grafted but unreachable forever)
+        if self._reset or self._muxer._closed:
+            raise MplexError("stream reset or connection closed")
         data, self._out = bytes(self._out), bytearray()
         for off in range(0, len(data), MAX_MSG):
             await self._muxer._send(
@@ -176,6 +183,7 @@ class Mplex:
             OSError,
             MplexError,
             varint.VarintError,
+            NoiseError,
         ):
             pass  # connection dead or peer spoke garbage: tear down
         finally:

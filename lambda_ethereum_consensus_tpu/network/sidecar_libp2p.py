@@ -32,6 +32,7 @@ from collections import OrderedDict
 
 from .libp2p.gossipsub import ACCEPT, IGNORE, REJECT, Gossipsub
 from .libp2p.host import Libp2pError, Libp2pHost
+from .libp2p.mplex import MplexError
 from .libp2p.identity import Identity, PeerId
 from .proto import port_pb2
 
@@ -156,7 +157,10 @@ class Libp2pSidecar:
         elif which == "send_request":
             asyncio.ensure_future(self._send_request(cmd))
         elif which == "send_response":
-            await self._send_response(cmd)
+            # backgrounded like send_request: a peer that stops reading
+            # (TCP backpressure) must stall only its own response, never
+            # the command loop (validation verdicts ride the same loop)
+            asyncio.ensure_future(self._send_response(cmd))
         else:
             await self.result(cmd.id, False, error=f"unknown command {which}")
 
@@ -215,17 +219,23 @@ class Libp2pSidecar:
         n.request.peer_id = peer_id.bytes
         await self.notify(n)
 
+    RESPONSE_TIMEOUT_S = 10.0
+
     async def _send_response(self, cmd: port_pb2.Command) -> None:
         stream = self.incoming_requests.pop(cmd.send_response.request_id, None)
         if stream is None:
             await self.result(cmd.id, False, error="unknown request id")
             return
-        try:
+
+        async def write_and_close():
             stream.write(cmd.send_response.payload)
             await stream.close_write()
+
+        try:
+            await asyncio.wait_for(write_and_close(), self.RESPONSE_TIMEOUT_S)
             await self.result(cmd.id, True)
-        except (Libp2pError, ConnectionError, OSError) as e:
-            await self.result(cmd.id, False, error=f"send: {e}")
+        except (Libp2pError, MplexError, ConnectionError, OSError, asyncio.TimeoutError) as e:
+            await self.result(cmd.id, False, error=f"send: {type(e).__name__}: {e}")
 
     async def _send_request(self, cmd: port_pb2.Command) -> None:
         req = cmd.send_request

@@ -1,0 +1,207 @@
+"""Mint a synthetic conformance corpus in the OFFICIAL directory layout.
+
+``tests/<config>/<fork>/<runner>/<handler>/<suite>/<case>`` with
+``*.ssz_snappy`` + ``*.yaml`` files exactly as ethereum/consensus-spec-tests
+ships them (ref: Makefile:60-100 downloads; lib/spec/runners/* formats).
+Purpose (VERDICT r2 #3): prove the whole official pipeline — download
+layout -> discovery -> runner -> structural diff — is one command away
+without network egress:
+
+    python -m lambda_ethereum_consensus_tpu.spec_tests.mint <dir>
+    SPEC_TESTS_DIR=<dir> pytest tests/spec -m spectest
+
+(``make spec-test-dryrun`` does both.)  The cases cover every runner,
+including negative cases (invalid operation with no post file, bls
+``output: false``).
+
+The same minting backs the harness self-tests in
+tests/spec/test_vectors.py.  Cases are minted with the repo's own codec,
+so they prove FORMAT handling, not external correctness — external
+oracles live in tests/spec/test_reference_vectors.py (reference-mined
+data) and test_reference_scenarios.py (reference-mined behavior).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import yaml
+
+
+def mint_corpus(root: str):
+    """Write the corpus under ``root``; returns (spec, genesis_state)."""
+    from ..compression.snappy import compress
+    from ..config import minimal_spec, use_chain_spec
+    from ..crypto import bls
+    from ..state_transition import misc, operations as st_ops, process_slots
+    from ..state_transition import epoch as st_epoch
+    from ..state_transition.genesis import build_genesis_state
+    from ..state_transition.mutable import BeaconStateMut
+    from ..types.beacon import (
+        BeaconBlock,
+        BeaconBlockBody,
+        Checkpoint,
+        SignedVoluntaryExit,
+        SyncAggregate,
+        VoluntaryExit,
+    )
+    from ..validator import build_signed_block
+
+    n = 32
+    sks = [(i + 1).to_bytes(32, "big") for i in range(n)]
+
+    def write_ssz(path, value, spec):
+        with open(path, "wb") as f:
+            f.write(compress(value.encode(spec)))
+
+    def write_yaml(path, data):
+        with open(path, "w") as f:
+            yaml.safe_dump(data, f)
+
+    with use_chain_spec(minimal_spec()) as spec:
+        genesis = build_genesis_state([bls.sk_to_pk(sk) for sk in sks], spec=spec)
+
+        def case(runner, handler, suite="pyspec_tests", name="case_0"):
+            d = os.path.join(
+                root, "tests", "minimal", "capella", runner, handler, suite, name
+            )
+            os.makedirs(d, exist_ok=True)
+            return d
+
+        # ssz_static on a Checkpoint
+        cp = Checkpoint(epoch=7, root=b"\x42" * 32)
+        d = case("ssz_static", "Checkpoint", "ssz_random")
+        write_ssz(os.path.join(d, "serialized.ssz_snappy"), cp, spec)
+        write_yaml(
+            os.path.join(d, "roots.yaml"),
+            {"root": "0x" + cp.hash_tree_root(spec).hex()},
+        )
+
+        # sanity/slots
+        d = case("sanity", "slots")
+        write_ssz(os.path.join(d, "pre.ssz_snappy"), genesis, spec)
+        write_yaml(os.path.join(d, "slots.yaml"), 3)
+        write_ssz(
+            os.path.join(d, "post.ssz_snappy"), process_slots(genesis, 3, spec), spec
+        )
+
+        # sanity/blocks with one real block
+        signed, post = build_signed_block(genesis, 1, sks, spec=spec)
+        d = case("sanity", "blocks")
+        write_ssz(os.path.join(d, "pre.ssz_snappy"), genesis, spec)
+        write_yaml(os.path.join(d, "meta.yaml"), {"blocks_count": 1})
+        write_ssz(os.path.join(d, "blocks_0.ssz_snappy"), signed, spec)
+        write_ssz(os.path.join(d, "post.ssz_snappy"), post, spec)
+
+        # shuffling vector from the scalar-oracle implementation
+        seed = b"\x5b" * 32
+        mapping = [misc.compute_shuffled_index(i, 17, seed, spec) for i in range(17)]
+        d = case("shuffling", "core", "shuffle")
+        write_yaml(
+            os.path.join(d, "mapping.yaml"),
+            {"seed": "0x" + seed.hex(), "count": 17, "mapping": mapping},
+        )
+
+        # bls verify vectors (one positive, one negative)
+        sig = bls.sign(sks[0], b"msg")
+        for name, pk, expect in (
+            ("case_ok", bls.sk_to_pk(sks[0]), True),
+            ("case_bad", bls.sk_to_pk(sks[1]), False),
+        ):
+            d = case("bls", "verify", "bls", name)
+            write_yaml(
+                os.path.join(d, "data.yaml"),
+                {
+                    "input": {
+                        "pubkey": "0x" + pk.hex(),
+                        "message": "0x" + b"msg".hex(),
+                        "signature": "0x" + sig.hex(),
+                    },
+                    "output": expect,
+                },
+            )
+
+        # operations/sync_aggregate: empty participation + infinity sig is
+        # a VALID aggregate (official format: pre + sync_aggregate + post)
+        agg = SyncAggregate(sync_committee_signature=bls.G2_POINT_AT_INFINITY)
+        pre_sync = process_slots(genesis, 1, spec)
+        ws = BeaconStateMut(pre_sync)
+        st_ops.process_sync_aggregate(ws, agg, spec)
+        d = case("operations", "sync_aggregate")
+        write_ssz(os.path.join(d, "pre.ssz_snappy"), pre_sync, spec)
+        write_ssz(os.path.join(d, "sync_aggregate.ssz_snappy"), agg, spec)
+        write_ssz(os.path.join(d, "post.ssz_snappy"), ws.freeze(), spec)
+
+        # operations/voluntary_exit: INVALID on genesis — no post file
+        exit_ = SignedVoluntaryExit(
+            message=VoluntaryExit(epoch=0, validator_index=0),
+            signature=bls.sign(sks[0], b"not-a-real-signing-root"),
+        )
+        d = case("operations", "voluntary_exit")
+        write_ssz(os.path.join(d, "pre.ssz_snappy"), genesis, spec)
+        write_ssz(os.path.join(d, "voluntary_exit.ssz_snappy"), exit_, spec)
+
+        # epoch_processing: two deterministic reset passes
+        for handler, fn in (
+            ("eth1_data_reset", st_epoch.process_eth1_data_reset),
+            ("slashings_reset", st_epoch.process_slashings_reset),
+        ):
+            ws = BeaconStateMut(genesis)
+            fn(ws, spec)
+            d = case("epoch_processing", handler)
+            write_ssz(os.path.join(d, "pre.ssz_snappy"), genesis, spec)
+            write_ssz(os.path.join(d, "post.ssz_snappy"), ws.freeze(), spec)
+
+        # fork_choice: anchor + tick + one block + head/time checks
+        anchor_header = genesis.latest_block_header.copy(
+            state_root=genesis.hash_tree_root(spec)
+        )
+        anchor_block = BeaconBlock(
+            slot=0,
+            proposer_index=0,
+            parent_root=bytes(anchor_header.parent_root),
+            state_root=genesis.hash_tree_root(spec),
+            body=BeaconBlockBody(),
+        )
+        tick = genesis.genesis_time + spec.SECONDS_PER_SLOT
+        root1 = signed.message.hash_tree_root(spec)
+        d = case("fork_choice", "on_block")
+        write_ssz(os.path.join(d, "anchor_state.ssz_snappy"), genesis, spec)
+        write_ssz(os.path.join(d, "anchor_block.ssz_snappy"), anchor_block, spec)
+        write_ssz(os.path.join(d, "block_0x%s.ssz_snappy" % root1.hex()), signed, spec)
+        write_yaml(
+            os.path.join(d, "steps.yaml"),
+            [
+                {"tick": int(tick)},
+                {"block": "block_0x%s" % root1.hex()},
+                {
+                    "checks": {
+                        "time": int(tick),
+                        "head": {"slot": 1, "root": "0x" + root1.hex()},
+                    }
+                },
+            ],
+        )
+
+        return spec, genesis
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: python -m lambda_ethereum_consensus_tpu.spec_tests.mint <dir>")
+        raise SystemExit(2)
+    root = sys.argv[1]
+    mint_corpus(root)
+    count = sum(1 for _ in _walk_cases(root))
+    print(f"minted {count} cases under {root}/tests (official layout)")
+
+
+def _walk_cases(root: str):
+    from .runners import discover_cases
+
+    return discover_cases(root)
+
+
+if __name__ == "__main__":
+    main()

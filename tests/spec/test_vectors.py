@@ -14,13 +14,9 @@ import pytest
 import yaml
 
 from lambda_ethereum_consensus_tpu.compression.snappy import compress
-from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
-from lambda_ethereum_consensus_tpu.crypto import bls
 from lambda_ethereum_consensus_tpu.spec_tests import RUNNERS, discover_cases, run_case
-from lambda_ethereum_consensus_tpu.state_transition import misc, process_slots
-from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
-from lambda_ethereum_consensus_tpu.types.beacon import BeaconBlock, BeaconBlockBody
-from lambda_ethereum_consensus_tpu.validator import build_signed_block
+from lambda_ethereum_consensus_tpu.spec_tests.mint import mint_corpus
+from lambda_ethereum_consensus_tpu.state_transition import process_slots
 
 SPEC_TESTS_DIR = os.environ.get(
     "SPEC_TESTS_DIR",
@@ -57,10 +53,6 @@ def test_official_corpus_presence_note():
 # runners accept good vectors and reject corrupted ones with readable diffs.
 # ---------------------------------------------------------------------------
 
-N = 32
-SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
-
-
 def write_ssz(path, value, spec):
     with open(path, "wb") as f:
         f.write(compress(value.encode(spec)))
@@ -73,156 +65,11 @@ def write_yaml(path, data):
 
 @pytest.fixture(scope="module")
 def minted(tmp_path_factory):
-    """A vector tree with ssz_static, sanity/slots, shuffling and bls cases."""
-    with use_chain_spec(minimal_spec()) as spec:
-        root = tmp_path_factory.mktemp("vectors")
-        genesis = build_genesis_state([bls.sk_to_pk(sk) for sk in SKS], spec=spec)
-
-        def case(runner, handler, suite="pyspec_tests", name="case_0"):
-            d = root / "tests" / "minimal" / "capella" / runner / handler / suite / name
-            d.mkdir(parents=True, exist_ok=True)
-            return d
-
-        # ssz_static on a Checkpoint
-        from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint
-
-        cp = Checkpoint(epoch=7, root=b"\x42" * 32)
-        d = case("ssz_static", "Checkpoint", "ssz_random")
-        write_ssz(d / "serialized.ssz_snappy", cp, spec)
-        write_yaml(d / "roots.yaml", {"root": "0x" + cp.hash_tree_root(spec).hex()})
-
-        # sanity/slots
-        d = case("sanity", "slots")
-        write_ssz(d / "pre.ssz_snappy", genesis, spec)
-        write_yaml(d / "slots.yaml", 3)
-        write_ssz(d / "post.ssz_snappy", process_slots(genesis, 3, spec), spec)
-
-        # sanity/blocks with one real block
-        signed, post = build_signed_block(genesis, 1, SKS, spec=spec)
-        d = case("sanity", "blocks")
-        write_ssz(d / "pre.ssz_snappy", genesis, spec)
-        write_yaml(d / "meta.yaml", {"blocks_count": 1})
-        write_ssz(d / "blocks_0.ssz_snappy", signed, spec)
-        write_ssz(d / "post.ssz_snappy", post, spec)
-
-        # shuffling vector from the scalar-oracle implementation
-        seed = b"\x5b" * 32
-        mapping = [
-            misc.compute_shuffled_index(i, 17, seed, spec) for i in range(17)
-        ]
-        d = case("shuffling", "core", "shuffle")
-        write_yaml(
-            d / "mapping.yaml",
-            {"seed": "0x" + seed.hex(), "count": 17, "mapping": mapping},
-        )
-
-        # bls verify vectors (one positive, one negative)
-        sig = bls.sign(SKS[0], b"msg")
-        d = case("bls", "verify", "bls", "case_ok")
-        write_yaml(
-            d / "data.yaml",
-            {
-                "input": {
-                    "pubkey": "0x" + bls.sk_to_pk(SKS[0]).hex(),
-                    "message": "0x" + b"msg".hex(),
-                    "signature": "0x" + sig.hex(),
-                },
-                "output": True,
-            },
-        )
-        d = case("bls", "verify", "bls", "case_bad")
-        write_yaml(
-            d / "data.yaml",
-            {
-                "input": {
-                    "pubkey": "0x" + bls.sk_to_pk(SKS[1]).hex(),
-                    "message": "0x" + b"msg".hex(),
-                    "signature": "0x" + sig.hex(),
-                },
-                "output": False,
-            },
-        )
-
-        # operations/sync_aggregate: empty participation + infinity sig is
-        # a VALID aggregate (official format: pre + sync_aggregate + post)
-        from lambda_ethereum_consensus_tpu.state_transition.mutable import (
-            BeaconStateMut,
-        )
-        from lambda_ethereum_consensus_tpu.state_transition import operations as st_ops
-        from lambda_ethereum_consensus_tpu.types.beacon import (
-            SignedVoluntaryExit,
-            SyncAggregate,
-            VoluntaryExit,
-        )
-
-        agg = SyncAggregate(sync_committee_signature=bls.G2_POINT_AT_INFINITY)
-        # slot 1: sync-aggregate rewards read the previous slot's block root
-        pre_sync = process_slots(genesis, 1, spec)
-        ws = BeaconStateMut(pre_sync)
-        st_ops.process_sync_aggregate(ws, agg, spec)
-        d = case("operations", "sync_aggregate")
-        write_ssz(d / "pre.ssz_snappy", pre_sync, spec)
-        write_ssz(d / "sync_aggregate.ssz_snappy", agg, spec)
-        write_ssz(d / "post.ssz_snappy", ws.freeze(), spec)
-
-        # operations/voluntary_exit: INVALID on genesis (validator has not
-        # been active for SHARD_COMMITTEE_PERIOD) — no post file
-        exit_ = SignedVoluntaryExit(
-            message=VoluntaryExit(epoch=0, validator_index=0),
-            signature=bls.sign(SKS[0], b"not-a-real-signing-root"),
-        )
-        d = case("operations", "voluntary_exit")
-        write_ssz(d / "pre.ssz_snappy", genesis, spec)
-        write_ssz(d / "voluntary_exit.ssz_snappy", exit_, spec)
-
-        # epoch_processing: two deterministic reset passes
-        from lambda_ethereum_consensus_tpu.state_transition import (
-            epoch as st_epoch,
-        )
-
-        for handler, fn in (
-            ("eth1_data_reset", st_epoch.process_eth1_data_reset),
-            ("slashings_reset", st_epoch.process_slashings_reset),
-        ):
-            ws = BeaconStateMut(genesis)
-            fn(ws, spec)
-            d = case("epoch_processing", handler)
-            write_ssz(d / "pre.ssz_snappy", genesis, spec)
-            write_ssz(d / "post.ssz_snappy", ws.freeze(), spec)
-
-        # fork_choice: anchor + tick + one block + head/time checks
-        # (official step-interpreter format, ref runners/fork_choice.ex)
-        anchor_header = genesis.latest_block_header.copy(
-            state_root=genesis.hash_tree_root(spec)
-        )
-        anchor_block = BeaconBlock(
-            slot=0,
-            proposer_index=0,
-            parent_root=bytes(anchor_header.parent_root),
-            state_root=genesis.hash_tree_root(spec),
-            body=BeaconBlockBody(),
-        )
-        tick = genesis.genesis_time + spec.SECONDS_PER_SLOT
-        root1 = signed.message.hash_tree_root(spec)
-        d = case("fork_choice", "on_block")
-        write_ssz(d / "anchor_state.ssz_snappy", genesis, spec)
-        write_ssz(d / "anchor_block.ssz_snappy", anchor_block, spec)
-        write_ssz(d / ("block_0x%s.ssz_snappy" % root1.hex()), signed, spec)
-        write_yaml(
-            d / "steps.yaml",
-            [
-                {"tick": int(tick)},
-                {"block": "block_0x%s" % root1.hex()},
-                {
-                    "checks": {
-                        "time": int(tick),
-                        "head": {"slot": 1, "root": "0x" + root1.hex()},
-                    }
-                },
-            ],
-        )
-
-        yield str(root), spec, genesis
+    """The synthetic corpus in the official layout (spec_tests/mint.py —
+    the same minting `make spec-test-dryrun` runs standalone)."""
+    root = tmp_path_factory.mktemp("vectors")
+    spec, genesis = mint_corpus(str(root))
+    yield str(root), spec, genesis
 
 
 def test_discovery_and_all_minted_cases_pass(minted):
