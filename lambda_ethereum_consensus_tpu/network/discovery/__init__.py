@@ -1,0 +1,4 @@
+"""Node discovery: ENR records + discv5 (the reference's discovery
+backend — ref: native/libp2p_port/internal/discovery/discovery.go)."""
+
+from .enr import ENR, ENRError  # noqa: F401

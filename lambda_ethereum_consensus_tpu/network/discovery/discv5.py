@@ -1,0 +1,324 @@
+"""discv5 v5.1 wire codec + handshake cryptography.
+
+The packet formats and key schedule of the Node Discovery Protocol v5
+(wire spec v5.1 — what go-ethereum's ``discover.ListenV5`` speaks for
+the reference, ref: discovery.go:30-77):
+
+    packet        = masking-iv(16) || masked-header || message
+    static-header = "discv5" || version(0x0001) || flag || nonce(12) ||
+                    authdata-size(2)
+    header        = static-header || authdata
+    masking       = AES-128-CTR(key = dest-node-id[:16], iv = masking-iv)
+
+Flags: 0 ordinary (authdata = src-node-id), 1 WHOAREYOU (authdata =
+id-nonce(16) || enr-seq(8)), 2 handshake (authdata = src-node-id ||
+sig-size || eph-key-size || id-signature || eph-pubkey || [record]).
+
+Messages are AES-GCM sealed with session keys from:
+
+    secret    = compressed shared secp256k1 point (ECDH)
+    kdf-info  = "discovery v5 key agreement" || node-id-A || node-id-B
+    new-keys  = HKDF-SHA256(secret, salt=challenge-data, kdf-info, 32)
+              = initiator-key(16) || recipient-key(16)
+    id-proof  = sha256("discovery v5 identity proof" || challenge-data
+                || eph-pubkey || node-id-B), secp256k1-signed (r||s)
+
+message-pt = msg-type(1) || rlp(body); AD = masking-iv || header.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+import struct
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from . import rlp
+
+PROTOCOL_ID = b"discv5"
+VERSION = 0x0001
+
+FLAG_MESSAGE = 0
+FLAG_WHOAREYOU = 1
+FLAG_HANDSHAKE = 2
+
+# message types
+PING = 0x01
+PONG = 0x02
+FINDNODE = 0x03
+NODES = 0x04
+TALKREQ = 0x05
+TALKRESP = 0x06
+
+ID_SIGNATURE_TEXT = b"discovery v5 identity proof"
+KDF_INFO_TEXT = b"discovery v5 key agreement"
+
+_SECP256K1_P = 2**256 - 2**32 - 977
+_SECP256K1_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_SECP256K1_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_SECP256K1_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+class Discv5Error(ValueError):
+    pass
+
+
+# ------------------------------------------------ secp256k1 point helpers
+# cryptography's ECDH yields only the x coordinate; discv5's secret is the
+# COMPRESSED shared point (x plus y-parity), so the multiplication runs
+# here (handshake-only, a handful of ops per peer).
+
+def _ec_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    (x1, y1), (x2, y2) = p, q
+    if x1 == x2 and (y1 + y2) % _SECP256K1_P == 0:
+        return None
+    if p == q:
+        lam = (3 * x1 * x1) * pow(2 * y1, -1, _SECP256K1_P) % _SECP256K1_P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, _SECP256K1_P) % _SECP256K1_P
+    x3 = (lam * lam - x1 - x2) % _SECP256K1_P
+    return x3, (lam * (x1 - x3) - y1) % _SECP256K1_P
+
+
+def _ec_mul(point, scalar: int):
+    result = None
+    addend = point
+    while scalar:
+        if scalar & 1:
+            result = _ec_add(result, addend)
+        addend = _ec_add(addend, addend)
+        scalar >>= 1
+    return result
+
+
+def ecdh_compressed(private: ec.EllipticCurvePrivateKey, peer_compressed: bytes) -> bytes:
+    """Shared secret = compressed shared point (discv5 ecdh())."""
+    peer = ec.EllipticCurvePublicKey.from_encoded_point(
+        ec.SECP256K1(), peer_compressed
+    ).public_numbers()
+    d = private.private_numbers().private_value
+    shared = _ec_mul((peer.x, peer.y), d)
+    if shared is None:
+        raise Discv5Error("ECDH produced the point at infinity")
+    x, y = shared
+    return bytes([0x02 | (y & 1)]) + x.to_bytes(32, "big")
+
+
+def compressed_pubkey(private: ec.EllipticCurvePrivateKey) -> bytes:
+    return private.public_key().public_bytes(
+        serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+    )
+
+
+# --------------------------------------------------------------- key sched
+
+def _hkdf_extract_expand(secret: bytes, salt: bytes, info: bytes, length: int) -> bytes:
+    prk = hmac_mod.new(salt, secret, hashlib.sha256).digest()
+    out = b""
+    block = b""
+    counter = 1
+    while len(out) < length:
+        block = hmac_mod.new(prk, block + info + bytes([counter]), hashlib.sha256).digest()
+        out += block
+        counter += 1
+    return out[:length]
+
+
+def derive_session_keys(
+    secret: bytes, node_id_a: bytes, node_id_b: bytes, challenge_data: bytes
+) -> tuple[bytes, bytes]:
+    """(initiator_key, recipient_key) per the discv5 key schedule."""
+    info = KDF_INFO_TEXT + node_id_a + node_id_b
+    keys = _hkdf_extract_expand(secret, challenge_data, info, 32)
+    return keys[:16], keys[16:]
+
+
+def id_sign(
+    private: ec.EllipticCurvePrivateKey,
+    challenge_data: bytes,
+    ephemeral_pubkey: bytes,
+    dest_node_id: bytes,
+) -> bytes:
+    digest = hashlib.sha256(
+        ID_SIGNATURE_TEXT + challenge_data + ephemeral_pubkey + dest_node_id
+    ).digest()
+    der = private.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+    r, s = decode_dss_signature(der)
+    if s > _SECP256K1_N // 2:
+        s = _SECP256K1_N - s
+    return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def id_verify(
+    pubkey_compressed: bytes,
+    signature: bytes,
+    challenge_data: bytes,
+    ephemeral_pubkey: bytes,
+    dest_node_id: bytes,
+) -> bool:
+    if len(signature) != 64:
+        return False
+    digest = hashlib.sha256(
+        ID_SIGNATURE_TEXT + challenge_data + ephemeral_pubkey + dest_node_id
+    ).digest()
+    try:
+        pub = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), pubkey_compressed
+        )
+        pub.verify(
+            encode_dss_signature(
+                int.from_bytes(signature[:32], "big"),
+                int.from_bytes(signature[32:], "big"),
+            ),
+            digest,
+            ec.ECDSA(Prehashed(hashes.SHA256())),
+        )
+        return True
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------------ packet codec
+
+def _mask(dest_node_id: bytes, iv: bytes, data: bytes) -> bytes:
+    cipher = Cipher(algorithms.AES(dest_node_id[:16]), modes.CTR(iv))
+    return cipher.encryptor().update(data)
+
+
+class Header:
+    def __init__(self, flag: int, nonce: bytes, authdata: bytes):
+        self.flag = flag
+        self.nonce = nonce
+        self.authdata = authdata
+
+    def encode(self) -> bytes:
+        return (
+            PROTOCOL_ID
+            + struct.pack(">H", VERSION)
+            + bytes([self.flag])
+            + self.nonce
+            + struct.pack(">H", len(self.authdata))
+            + self.authdata
+        )
+
+
+def encode_packet(
+    dest_node_id: bytes,
+    header: Header,
+    message: bytes = b"",
+    masking_iv: bytes | None = None,
+) -> bytes:
+    iv = masking_iv if masking_iv is not None else os.urandom(16)
+    return iv + _mask(dest_node_id, iv, header.encode()) + message
+
+
+def decode_packet(local_node_id: bytes, data: bytes) -> tuple[bytes, Header, bytes]:
+    """Returns (masking_iv, header, message_ciphertext)."""
+    if len(data) < 16 + 23:
+        raise Discv5Error("packet too short")
+    iv = data[:16]
+    cipher = Cipher(algorithms.AES(local_node_id[:16]), modes.CTR(iv))
+    dec = cipher.decryptor()
+    static = dec.update(data[16 : 16 + 23])
+    if static[:6] != PROTOCOL_ID:
+        raise Discv5Error("bad protocol id")
+    (version,) = struct.unpack(">H", static[6:8])
+    if version != VERSION:
+        raise Discv5Error(f"unsupported version {version}")
+    flag = static[8]
+    nonce = static[9:21]
+    (authdata_size,) = struct.unpack(">H", static[21:23])
+    if 16 + 23 + authdata_size > len(data):
+        raise Discv5Error("truncated authdata")
+    authdata = dec.update(data[16 + 23 : 16 + 23 + authdata_size])
+    message = data[16 + 23 + authdata_size :]
+    return iv, Header(flag, nonce, authdata), message
+
+
+def challenge_data(masking_iv: bytes, header: Header) -> bytes:
+    return masking_iv + header.encode()
+
+
+def seal_message(
+    key: bytes, nonce: bytes, masking_iv: bytes, header: Header, message_pt: bytes
+) -> bytes:
+    ad = masking_iv + header.encode()
+    return AESGCM(key).encrypt(nonce, message_pt, ad)
+
+
+def open_message(
+    key: bytes, nonce: bytes, masking_iv: bytes, header: Header, ciphertext: bytes
+) -> bytes:
+    ad = masking_iv + header.encode()
+    try:
+        return AESGCM(key).decrypt(nonce, ciphertext, ad)
+    except Exception:
+        raise Discv5Error("message authentication failed") from None
+
+
+# ----------------------------------------------------------- message bodies
+
+def encode_message(msg_type: int, body: list) -> bytes:
+    return bytes([msg_type]) + rlp.encode(body)
+
+
+def decode_message(message_pt: bytes) -> tuple[int, list]:
+    if not message_pt:
+        raise Discv5Error("empty message")
+    body = rlp.decode(message_pt[1:])
+    if not isinstance(body, list):
+        raise Discv5Error("message body must be a list")
+    return message_pt[0], body
+
+
+def build_whoareyou(id_nonce: bytes, enr_seq: int, request_nonce: bytes) -> Header:
+    return Header(
+        FLAG_WHOAREYOU, request_nonce, id_nonce + struct.pack(">Q", enr_seq)
+    )
+
+
+def build_handshake_authdata(
+    src_node_id: bytes,
+    id_signature: bytes,
+    ephemeral_pubkey: bytes,
+    record_rlp: bytes = b"",
+) -> bytes:
+    return (
+        src_node_id
+        + bytes([len(id_signature), len(ephemeral_pubkey)])
+        + id_signature
+        + ephemeral_pubkey
+        + record_rlp
+    )
+
+
+def parse_handshake_authdata(authdata: bytes) -> tuple[bytes, bytes, bytes, bytes]:
+    """(src_node_id, id_signature, eph_pubkey, record_rlp)."""
+    if len(authdata) < 34:
+        raise Discv5Error("short handshake authdata")
+    src = authdata[:32]
+    sig_size, key_size = authdata[32], authdata[33]
+    end_sig = 34 + sig_size
+    end_key = end_sig + key_size
+    if end_key > len(authdata):
+        raise Discv5Error("truncated handshake authdata")
+    return (
+        src,
+        authdata[34:end_sig],
+        authdata[end_sig:end_key],
+        authdata[end_key:],
+    )

@@ -1,0 +1,204 @@
+"""Ethereum Node Records (EIP-778) with the "v4" identity scheme.
+
+The discovery-layer identity format the reference consumes as bootnode
+config and filters by fork digest (ref: discovery.go:48-77,122-146;
+bootnode ENRs at config/config.exs).  Wire form:
+
+    record  = rlp([signature, seq, k1, v1, k2, v2, ...])   keys sorted
+    sig(v4) = secp256k1 ECDSA (r||s, 64 bytes) over
+              keccak256(rlp([seq, k1, v1, ...]))
+    text    = "enr:" + base64url(record, no padding)
+    node id = keccak256(uncompressed_pubkey_x || y)        (discv5)
+
+The ``eth2`` entry carries ssz ``ENRForkID`` (fork_digest[4] ||
+current_fork_version... — this module surfaces the leading 4-byte
+digest, which is what peer filtering keys on).
+"""
+
+from __future__ import annotations
+
+import base64
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from . import rlp
+from .keccak import keccak256
+
+MAX_RECORD_SIZE = 300  # EIP-778
+
+# group order of secp256k1 (for low-s signature normalization)
+_SECP256K1_ORDER = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+class ENRError(ValueError):
+    pass
+
+
+def _pubkey_from_compressed(compressed: bytes) -> ec.EllipticCurvePublicKey:
+    try:
+        return ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), compressed
+        )
+    except ValueError as e:
+        raise ENRError(f"bad secp256k1 key: {e}") from None
+
+
+def _uncompressed_xy(pub: ec.EllipticCurvePublicKey) -> bytes:
+    nums = pub.public_numbers()
+    return nums.x.to_bytes(32, "big") + nums.y.to_bytes(32, "big")
+
+
+class ENR:
+    """One parsed record: ``seq``, ``kv`` (raw pairs), derived accessors."""
+
+    def __init__(self, seq: int, kv: dict[bytes, bytes], signature: bytes):
+        self.seq = seq
+        self.kv = kv
+        self.signature = signature
+
+    # ------------------------------------------------------------- parsing
+    @classmethod
+    def from_rlp(cls, raw: bytes, verify: bool = True) -> "ENR":
+        if len(raw) > MAX_RECORD_SIZE:
+            raise ENRError(f"record exceeds {MAX_RECORD_SIZE} bytes")
+        items = rlp.decode(raw)
+        if not isinstance(items, list) or len(items) < 2 or len(items) % 2:
+            raise ENRError("malformed record structure")
+        signature, seq_raw, *pairs = items
+        if not isinstance(signature, bytes) or len(signature) != 64:
+            raise ENRError("v4 signature must be 64 bytes (r||s)")
+        kv: dict[bytes, bytes] = {}
+        prev = None
+        for i in range(0, len(pairs), 2):
+            k, v = pairs[i], pairs[i + 1]
+            if not isinstance(k, bytes):
+                raise ENRError("non-bytes key")
+            if prev is not None and k <= prev:
+                raise ENRError("keys not strictly sorted")
+            prev = k
+            kv[k] = v
+        seq = int.from_bytes(seq_raw, "big") if seq_raw else 0
+        record = cls(seq, kv, signature)
+        if verify:
+            record.verify()
+        return record
+
+    @classmethod
+    def from_text(cls, text: str, verify: bool = True) -> "ENR":
+        if not text.startswith("enr:"):
+            raise ENRError("missing enr: prefix")
+        b64 = text[4:]
+        raw = base64.urlsafe_b64decode(b64 + "=" * (-len(b64) % 4))
+        return cls.from_rlp(raw, verify=verify)
+
+    # ------------------------------------------------------------ signing
+    def _content_digest(self) -> bytes:
+        content = [self.seq] + [
+            x for k in sorted(self.kv) for x in (k, self.kv[k])
+        ]
+        return keccak256(rlp.encode(content))
+
+    def verify(self) -> None:
+        if self.kv.get(b"id") != b"v4":
+            raise ENRError(f"unsupported identity scheme {self.kv.get(b'id')!r}")
+        compressed = self.kv.get(b"secp256k1")
+        if not compressed:
+            raise ENRError("missing secp256k1 key")
+        pub = _pubkey_from_compressed(compressed)
+        r = int.from_bytes(self.signature[:32], "big")
+        s = int.from_bytes(self.signature[32:], "big")
+        try:
+            pub.verify(
+                encode_dss_signature(r, s),
+                self._content_digest(),
+                ec.ECDSA(Prehashed(hashes.SHA256())),  # 32-byte keccak digest
+            )
+        except Exception:
+            raise ENRError("invalid record signature") from None
+
+    @classmethod
+    def create(
+        cls,
+        private: ec.EllipticCurvePrivateKey,
+        seq: int = 1,
+        ip: bytes | None = None,
+        udp: int | None = None,
+        tcp: int | None = None,
+        eth2: bytes | None = None,
+        extra: dict[bytes, bytes] | None = None,
+    ) -> "ENR":
+        compressed = private.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.CompressedPoint,
+        )
+        kv: dict[bytes, bytes] = {b"id": b"v4", b"secp256k1": compressed}
+        if ip is not None:
+            kv[b"ip"] = ip
+        if udp is not None:
+            kv[b"udp"] = udp.to_bytes(2, "big")
+        if tcp is not None:
+            kv[b"tcp"] = tcp.to_bytes(2, "big")
+        if eth2 is not None:
+            kv[b"eth2"] = eth2
+        kv.update(extra or {})
+        record = cls(seq, kv, b"\x00" * 64)
+        der = private.sign(
+            record._content_digest(), ec.ECDSA(Prehashed(hashes.SHA256()))
+        )
+        r, s = decode_dss_signature(der)
+        # low-s normalization (canonical form other implementations expect)
+        if s > _SECP256K1_ORDER // 2:
+            s = _SECP256K1_ORDER - s
+        record.signature = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+        return record
+
+    # ----------------------------------------------------------- encoding
+    def to_rlp(self) -> bytes:
+        items = [self.signature, self.seq] + [
+            x for k in sorted(self.kv) for x in (k, self.kv[k])
+        ]
+        raw = rlp.encode(items)
+        if len(raw) > MAX_RECORD_SIZE:
+            raise ENRError(f"record exceeds {MAX_RECORD_SIZE} bytes")
+        return raw
+
+    def to_text(self) -> str:
+        return "enr:" + base64.urlsafe_b64encode(self.to_rlp()).rstrip(b"=").decode()
+
+    # ---------------------------------------------------------- accessors
+    @property
+    def public_key(self) -> ec.EllipticCurvePublicKey:
+        return _pubkey_from_compressed(self.kv[b"secp256k1"])
+
+    @property
+    def node_id(self) -> bytes:
+        """discv5 node id: keccak256 of the uncompressed public key."""
+        return keccak256(_uncompressed_xy(self.public_key))
+
+    @property
+    def ip(self) -> str | None:
+        raw = self.kv.get(b"ip")
+        return ".".join(str(b) for b in raw) if raw and len(raw) == 4 else None
+
+    @property
+    def udp(self) -> int | None:
+        raw = self.kv.get(b"udp")
+        return int.from_bytes(raw, "big") if raw else None
+
+    @property
+    def tcp(self) -> int | None:
+        raw = self.kv.get(b"tcp")
+        return int.from_bytes(raw, "big") if raw else None
+
+    @property
+    def fork_digest(self) -> bytes | None:
+        """Leading 4 bytes of the eth2 ENRForkID entry (what the
+        reference's discovery filter keys on, discovery.go:122-146)."""
+        raw = self.kv.get(b"eth2")
+        return bytes(raw[:4]) if raw and len(raw) >= 4 else None

@@ -1,0 +1,87 @@
+"""RLP (Recursive Length Prefix) — Ethereum's wire serialization, needed
+for ENR records and discv5 messages (EIP-778 / discv5 spec; the
+reference gets it from go-ethereum).  Items are ``bytes`` or lists."""
+
+from __future__ import annotations
+
+
+class RLPError(ValueError):
+    pass
+
+
+def encode(item) -> bytes:
+    if isinstance(item, int):
+        # canonical integer form: big-endian, no leading zeros, 0 = empty
+        item = item.to_bytes((item.bit_length() + 7) // 8, "big") if item else b""
+    if isinstance(item, (bytes, bytearray)):
+        item = bytes(item)
+        if len(item) == 1 and item[0] < 0x80:
+            return item
+        return _length_prefix(len(item), 0x80) + item
+    if isinstance(item, (list, tuple)):
+        body = b"".join(encode(x) for x in item)
+        return _length_prefix(len(body), 0xC0) + body
+    raise RLPError(f"cannot RLP-encode {type(item).__name__}")
+
+
+def _length_prefix(length: int, offset: int) -> bytes:
+    if length < 56:
+        return bytes([offset + length])
+    size = length.to_bytes((length.bit_length() + 7) // 8, "big")
+    return bytes([offset + 55 + len(size)]) + size
+
+
+def decode(data: bytes):
+    item, end = _decode_at(data, 0)
+    if end != len(data):
+        raise RLPError(f"trailing bytes after RLP item ({len(data) - end})")
+    return item
+
+
+def _decode_at(data: bytes, pos: int):
+    if pos >= len(data):
+        raise RLPError("truncated RLP")
+    b0 = data[pos]
+    if b0 < 0x80:
+        return bytes([b0]), pos + 1
+    if b0 < 0xB8:  # short string
+        length = b0 - 0x80
+        return _take(data, pos + 1, length)
+    if b0 < 0xC0:  # long string
+        lsize = b0 - 0xB7
+        length, pos = _read_length(data, pos + 1, lsize)
+        return _take(data, pos, length)
+    if b0 < 0xF8:  # short list
+        length = b0 - 0xC0
+        return _decode_list(data, pos + 1, length)
+    lsize = b0 - 0xF7
+    length, pos = _read_length(data, pos + 1, lsize)
+    return _decode_list(data, pos, length)
+
+
+def _read_length(data: bytes, pos: int, lsize: int) -> tuple[int, int]:
+    if pos + lsize > len(data):
+        raise RLPError("truncated RLP length")
+    raw = data[pos : pos + lsize]
+    if raw[0] == 0:
+        raise RLPError("non-canonical RLP length (leading zero)")
+    return int.from_bytes(raw, "big"), pos + lsize
+
+
+def _take(data: bytes, pos: int, length: int):
+    if pos + length > len(data):
+        raise RLPError("truncated RLP string")
+    return data[pos : pos + length], pos + length
+
+
+def _decode_list(data: bytes, pos: int, length: int):
+    end = pos + length
+    if end > len(data):
+        raise RLPError("truncated RLP list")
+    items = []
+    while pos < end:
+        item, pos = _decode_at(data, pos)
+        items.append(item)
+    if pos != end:
+        raise RLPError("RLP list length mismatch")
+    return items, end

@@ -1,0 +1,443 @@
+"""discv5 UDP service: sessions, handshakes, FINDNODE walks, peer feed.
+
+The role of the reference's discoverer (ref: discovery.go:30-146 —
+go-ethereum ``discover.ListenV5`` + a fork-digest iterator feeding found
+peers to the libp2p host): listen on UDP, maintain encrypted sessions
+via the WHOAREYOU handshake (codec/crypto in :mod:`discv5`), answer
+PING/FINDNODE, walk the network with FINDNODE queries, and surface
+fork-matching peers' (ip, tcp) endpoints through ``on_peer``.
+
+Routing table: k-buckets by XOR log-distance (k=16), newest-first
+eviction of stale entries on ping failure is simplified to
+insert-if-room/replace-oldest — enough for the bootstrap+walk role this
+service plays here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import secrets
+import struct
+import time
+
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from . import discv5, rlp
+from .enr import ENR
+
+K_BUCKET = 16
+REQUEST_TIMEOUT_S = 2.0
+CHALLENGE_TTL_S = 5.0
+WALK_INTERVAL_S = 30.0
+MAX_NODES_PER_MESSAGE = 4  # response size bound (fits typical MTU)
+
+
+def log_distance(a: bytes, b: bytes) -> int:
+    x = int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+    return x.bit_length()
+
+
+class _Session:
+    def __init__(self, send_key: bytes, recv_key: bytes):
+        self.send_key = send_key
+        self.recv_key = recv_key
+
+
+class _Pending:
+    """One outstanding request: resolved by response or WHOAREYOU."""
+
+    def __init__(self, nonce: bytes, message_pt: bytes, dest: "ENR", addr):
+        self.nonce = nonce
+        self.message_pt = message_pt
+        self.dest = dest
+        self.addr = addr
+        self.future: asyncio.Future = asyncio.get_running_loop().create_future()
+        # NODES aggregation: [request-id, total, [enr...]] arrives as up
+        # to `total` packets; accumulate until all are in
+        self.nodes_acc: list = []
+        self.nodes_packets = 0
+
+
+class Discv5Service(asyncio.DatagramProtocol):
+    def __init__(
+        self,
+        private: ec.EllipticCurvePrivateKey | None = None,
+        enr: ENR | None = None,
+        fork_digest: bytes | None = None,
+        on_peer=None,
+    ):
+        self.private = private or ec.generate_private_key(ec.SECP256K1())
+        self.enr = enr or ENR.create(self.private, seq=1)
+        self.node_id = self.enr.node_id
+        self.fork_digest = fork_digest
+        self.on_peer = on_peer  # async callback(ENR)
+        self.transport: asyncio.DatagramTransport | None = None
+        self.sessions: dict[bytes, _Session] = {}  # node_id -> keys
+        self.known: dict[bytes, ENR] = {}  # node_id -> record (k-buckets)
+        self.addrs: dict[bytes, tuple[str, int]] = {}
+        # nonce -> pending request (for WHOAREYOU-triggered handshakes)
+        self.pending_by_nonce: dict[bytes, _Pending] = {}
+        # request-id -> pending (response correlation)
+        self.pending_by_reqid: dict[bytes, _Pending] = {}
+        # id-nonce challenges we issued: node addr -> (challenge-data, ts).
+        # ONE outstanding challenge per endpoint (discv5 spec): a second
+        # undecryptable packet must NOT mint a fresh challenge, or the
+        # first handshake verifies against the wrong challenge-data
+        self.challenges: dict[tuple[str, int], tuple[bytes, float]] = {}
+        self._walk_task: asyncio.Task | None = None
+        # node_id -> monotonic expiry; peers re-surface after the TTL so a
+        # transiently-failed dial (or an ENR update) isn't lost forever
+        self._fed_until: dict[bytes, float] = {}
+
+    # ----------------------------------------------------------- lifecycle
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(host, port)
+        )
+        return self.transport.get_extra_info("sockname")[1]
+
+    def start_walking(self) -> None:
+        if self._walk_task is None:
+            self._walk_task = asyncio.ensure_future(self._walk_loop())
+
+    async def stop(self) -> None:
+        if self._walk_task is not None:
+            self._walk_task.cancel()
+            self._walk_task = None
+        if self.transport is not None:
+            self.transport.close()
+
+    def add_record(self, record: ENR) -> None:
+        nid = record.node_id
+        if nid == self.node_id:
+            return
+        bucket = [
+            k for k in self.known if log_distance(self.node_id, k)
+            == log_distance(self.node_id, nid)
+        ]
+        if nid not in self.known and len(bucket) >= K_BUCKET:
+            del self.known[bucket[0]]  # replace oldest in the bucket
+        self.known[nid] = record
+        if record.ip and record.udp:
+            self.addrs[nid] = (record.ip, record.udp)
+
+    # ------------------------------------------------------------ requests
+    async def ping(self, record: ENR, timeout: float = REQUEST_TIMEOUT_S) -> list:
+        req_id = secrets.token_bytes(8)
+        body = [req_id, self.enr.seq]
+        return await self._request(record, discv5.PING, body, req_id, timeout)
+
+    async def find_nodes(
+        self, record: ENR, distances: list[int], timeout: float = REQUEST_TIMEOUT_S
+    ) -> list[ENR]:
+        req_id = secrets.token_bytes(8)
+        body = [req_id, [d for d in distances]]
+        nodes_body = await self._request(
+            record, discv5.FINDNODE, body, req_id, timeout
+        )
+        found = []
+        # NODES body: [request-id, total, [enr...]]; multi-packet responses
+        # are aggregated in _handle_message until `total` is met
+        for enr_rlp in nodes_body:
+            try:
+                found.append(ENR.from_rlp(rlp.encode(enr_rlp), verify=True))
+            except Exception:
+                continue  # bad record from peer: skip
+        return found
+
+    async def _request(self, record, msg_type, body, req_id, timeout) -> list:
+        addr = (record.ip, record.udp)
+        if not addr[0] or not addr[1]:
+            raise discv5.Discv5Error("record has no ip/udp endpoint")
+        dest_id = record.node_id
+        nonce = os.urandom(12)
+        message_pt = discv5.encode_message(msg_type, body)
+        pending = _Pending(nonce, message_pt, record, addr)
+        self.pending_by_nonce[nonce] = pending
+        self.pending_by_reqid[req_id] = pending
+        session = self.sessions.get(dest_id)
+        header = discv5.Header(discv5.FLAG_MESSAGE, nonce, self.node_id)
+        iv = os.urandom(16)
+        if session is not None:
+            sealed = discv5.seal_message(
+                session.send_key, nonce, iv, header, message_pt
+            )
+        else:
+            # no session: random payload provokes WHOAREYOU (discv5 spec)
+            sealed = os.urandom(max(len(message_pt) + 16, 32))
+        self.transport.sendto(
+            discv5.encode_packet(dest_id, header, sealed, masking_iv=iv), addr
+        )
+        try:
+            return await asyncio.wait_for(pending.future, timeout)
+        finally:
+            # pending.nonce, not the local: a WHOAREYOU-triggered re-send
+            # re-keys the entry under a fresh nonce
+            self.pending_by_nonce.pop(pending.nonce, None)
+            self.pending_by_reqid.pop(req_id, None)
+
+    # ------------------------------------------------------------- inbound
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            iv, header, message = discv5.decode_packet(self.node_id, data)
+        except discv5.Discv5Error:
+            return
+        try:
+            if header.flag == discv5.FLAG_WHOAREYOU:
+                self._on_whoareyou(iv, header, addr)
+            elif header.flag == discv5.FLAG_HANDSHAKE:
+                self._on_handshake(iv, header, message, addr)
+            elif header.flag == discv5.FLAG_MESSAGE:
+                self._on_message(iv, header, message, addr)
+        except (
+            discv5.Discv5Error,
+            rlp.RLPError,
+            # well-encrypted but structurally-malformed message bodies
+            # (short lists, wrong element types, short authdata) must be
+            # dropped, not crash the datagram handler
+            IndexError,
+            TypeError,
+            ValueError,
+            KeyError,
+            struct.error,
+        ):
+            pass  # malformed or unauthenticated: drop
+
+    # -- WHOAREYOU: peer challenged one of our requests -------------------
+    def _on_whoareyou(self, iv: bytes, header: discv5.Header, addr) -> None:
+        pending = self.pending_by_nonce.get(header.nonce)
+        if pending is None:
+            return
+        dest = pending.dest
+        dest_id = dest.node_id
+        cdata = discv5.challenge_data(iv, header)
+        eph = ec.generate_private_key(ec.SECP256K1())
+        eph_pub = discv5.compressed_pubkey(eph)
+        secret = discv5.ecdh_compressed(eph, dest.kv[b"secp256k1"])
+        send_key, recv_key = discv5.derive_session_keys(
+            secret, self.node_id, dest_id, cdata
+        )
+        self.sessions[dest_id] = _Session(send_key, recv_key)
+        sig = discv5.id_sign(self.private, cdata, eph_pub, dest_id)
+        enr_seq = struct.unpack(">Q", header.authdata[16:24])[0]
+        record_rlp = self.enr.to_rlp() if enr_seq < self.enr.seq else b""
+        authdata = discv5.build_handshake_authdata(
+            self.node_id, sig, eph_pub, record_rlp
+        )
+        nonce = os.urandom(12)
+        hs_header = discv5.Header(discv5.FLAG_HANDSHAKE, nonce, authdata)
+        out_iv = os.urandom(16)
+        sealed = discv5.seal_message(
+            send_key, nonce, out_iv, hs_header, pending.message_pt
+        )
+        self.transport.sendto(
+            discv5.encode_packet(dest_id, hs_header, sealed, masking_iv=out_iv),
+            pending.addr,
+        )
+        # other requests to the same peer were sent sessionless (garbage)
+        # and got no WHOAREYOU (one challenge per endpoint): re-send them
+        # over the session just established
+        for other in list(self.pending_by_nonce.values()):
+            if other is pending or other.dest.node_id != dest_id:
+                continue
+            renonce = os.urandom(12)
+            self.pending_by_nonce.pop(other.nonce, None)
+            other.nonce = renonce
+            self.pending_by_nonce[renonce] = other
+            re_header = discv5.Header(discv5.FLAG_MESSAGE, renonce, self.node_id)
+            re_iv = os.urandom(16)
+            re_sealed = discv5.seal_message(
+                send_key, renonce, re_iv, re_header, other.message_pt
+            )
+            self.transport.sendto(
+                discv5.encode_packet(dest_id, re_header, re_sealed, masking_iv=re_iv),
+                other.addr,
+            )
+
+    # -- handshake: peer answers OUR challenge ----------------------------
+    def _on_handshake(self, iv: bytes, header: discv5.Header, message, addr) -> None:
+        entry = self.challenges.pop(addr, None)
+        if entry is None:
+            return
+        cdata = entry[0]
+        src_id, sig, eph_pub, record_rlp = discv5.parse_handshake_authdata(
+            header.authdata
+        )
+        record = None
+        if record_rlp:
+            record = ENR.from_rlp(record_rlp, verify=True)
+            if record.node_id != src_id:
+                raise discv5.Discv5Error("handshake record/node-id mismatch")
+        else:
+            record = self.known.get(src_id)
+        if record is None:
+            return  # cannot authenticate without a record
+        if not discv5.id_verify(
+            record.kv[b"secp256k1"], sig, cdata, eph_pub, self.node_id
+        ):
+            raise discv5.Discv5Error("bad id signature")
+        secret = discv5.ecdh_compressed(self.private, eph_pub)
+        initiator_key, recipient_key = discv5.derive_session_keys(
+            secret, src_id, self.node_id, cdata
+        )
+        # they initiated: they send with initiator-key, we with recipient-key
+        self.sessions[src_id] = _Session(recipient_key, initiator_key)
+        self.add_record(record)
+        self._feed_peer(record)
+        message_pt = discv5.open_message(
+            initiator_key, header.nonce, iv, header, message
+        )
+        self._handle_message(src_id, addr, message_pt)
+
+    # -- ordinary message -------------------------------------------------
+    def _on_message(self, iv: bytes, header: discv5.Header, message, addr) -> None:
+        src_id = header.authdata
+        if len(src_id) != 32:
+            return
+        session = self.sessions.get(src_id)
+        if session is not None:
+            try:
+                message_pt = discv5.open_message(
+                    session.recv_key, header.nonce, iv, header, message
+                )
+                self._handle_message(src_id, addr, message_pt)
+                return
+            except discv5.Discv5Error:
+                pass  # stale keys: fall through to WHOAREYOU
+        # unknown/failed session: challenge — but never while another
+        # challenge for this endpoint is outstanding (the handshake must
+        # verify against the one challenge-data we remember)
+        existing = self.challenges.get(addr)
+        if existing is not None and time.monotonic() - existing[1] < CHALLENGE_TTL_S:
+            return
+        id_nonce = os.urandom(16)
+        known = self.known.get(src_id)
+        enr_seq = known.seq if known is not None else 0
+        why = discv5.build_whoareyou(id_nonce, enr_seq, header.nonce)
+        out_iv = os.urandom(16)
+        self.challenges[addr] = (
+            discv5.challenge_data(out_iv, why),
+            time.monotonic(),
+        )
+        self.transport.sendto(
+            discv5.encode_packet(src_id, why, b"", masking_iv=out_iv), addr
+        )
+
+    # -- decrypted message dispatch ---------------------------------------
+    def _handle_message(self, src_id: bytes, addr, message_pt: bytes) -> None:
+        msg_type, body = discv5.decode_message(message_pt)
+        if msg_type == discv5.PING:
+            req_id = bytes(body[0])
+            try:  # recipient-ip field: IPv4 only; else empty (info-only)
+                ip_raw = bytes(map(int, addr[0].split(".")))
+            except ValueError:
+                ip_raw = b""
+            pong = [req_id, self.enr.seq, ip_raw, addr[1]]
+            self._respond(src_id, addr, discv5.PONG, pong)
+        elif msg_type == discv5.FINDNODE:
+            req_id = bytes(body[0])
+            distances = {int.from_bytes(d, "big") if d else 0 for d in body[1]}
+            records = []
+            if 0 in distances:
+                records.append(self.enr)
+            for nid, record in self.known.items():
+                if log_distance(self.node_id, nid) in distances:
+                    records.append(record)
+            # chunk into MTU-sized NODES packets, total = packet count
+            chunks = [
+                records[i : i + MAX_NODES_PER_MESSAGE]
+                for i in range(0, len(records), MAX_NODES_PER_MESSAGE)
+            ] or [[]]
+            for chunk in chunks:
+                self._respond(
+                    src_id,
+                    addr,
+                    discv5.NODES,
+                    [req_id, len(chunks), [rlp.decode(r.to_rlp()) for r in chunk]],
+                )
+        elif msg_type in (discv5.PONG, discv5.NODES):
+            req_id = bytes(body[0])
+            pending = self.pending_by_reqid.get(req_id)
+            if pending is None or pending.dest.node_id != src_id:
+                return
+            if not pending.future.done():
+                if msg_type == discv5.NODES:
+                    total = int.from_bytes(body[1], "big") if body[1] else 0
+                    pending.nodes_acc.extend(body[2])
+                    pending.nodes_packets += 1
+                    if pending.nodes_packets >= min(total, 16) or total <= 1:
+                        pending.future.set_result(pending.nodes_acc)
+                else:
+                    pending.future.set_result(body[1:])
+            self.add_record(pending.dest)
+            self._feed_peer(pending.dest)
+
+    def _respond(self, dest_id: bytes, addr, msg_type: int, body: list) -> None:
+        session = self.sessions.get(dest_id)
+        if session is None:
+            return
+        nonce = os.urandom(12)
+        header = discv5.Header(discv5.FLAG_MESSAGE, nonce, self.node_id)
+        iv = os.urandom(16)
+        sealed = discv5.seal_message(
+            session.send_key, nonce, iv, header,
+            discv5.encode_message(msg_type, body),
+        )
+        self.transport.sendto(
+            discv5.encode_packet(dest_id, header, sealed, masking_iv=iv), addr
+        )
+
+    # ----------------------------------------------------------- discovery
+    FEED_TTL_S = 60.0
+
+    def _feed_peer(self, record: ENR) -> None:
+        """Surface fork-matching peers (the reference's filter:
+        discovery.go:122-146 — wrong/absent fork digest is skipped).
+        Rate-limited per node rather than once-ever, so the consumer can
+        retry failed dials on later sightings."""
+        if self.on_peer is None:
+            return
+        now = time.monotonic()
+        if self._fed_until.get(record.node_id, 0.0) > now:
+            return
+        if self.fork_digest is not None and record.fork_digest != self.fork_digest:
+            return
+        self._fed_until[record.node_id] = now + self.FEED_TTL_S
+        result = self.on_peer(record)
+        if asyncio.iscoroutine(result):
+            asyncio.ensure_future(result)
+
+    async def _walk_loop(self) -> None:
+        """Periodic FINDNODE walk over known nodes; a dead node costs its
+        own timeout only, never the rest of the round."""
+        while True:
+            for record in list(self.known.values())[:8]:
+                # bias toward far buckets (where most of the keyspace is)
+                # with one randomized distance for diversity
+                distances = [256, 255, 240 + secrets.randbelow(15)]
+                try:
+                    found = await self.find_nodes(record, distances)
+                except Exception:
+                    continue  # unresponsive/stale entry: move on
+                for r in found:
+                    self.add_record(r)
+                    self._feed_peer(r)
+            await asyncio.sleep(WALK_INTERVAL_S)
+
+    async def bootstrap(self, enr_texts: list[str]) -> int:
+        """Ping all bootnodes concurrently; returns how many answered
+        (a dead bootnode costs one shared timeout, not a serial wait)."""
+
+        async def one(text: str) -> bool:
+            try:
+                record = ENR.from_text(text)
+                self.add_record(record)
+                await self.ping(record)
+                return True
+            except Exception:
+                return False
+
+        results = await asyncio.gather(*(one(t) for t in enr_texts))
+        return sum(results)

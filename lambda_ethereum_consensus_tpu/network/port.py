@@ -43,6 +43,7 @@ class Port:
         self._reader_task: asyncio.Task | None = None
         self.listen_port: int | None = None
         self.node_id: bytes | None = None
+        self.enr: str | None = None  # libp2p wire: our signed discv5 ENR
         # handler registries
         self.gossip_handlers: dict[str, Handler] = {}
         self.request_handlers: dict[str, Handler] = {}
@@ -91,7 +92,11 @@ class Port:
             cmd.init.enable_peer_exchange = enable_peer_exchange
             cmd.init.fork_digest = fork_digest.hex()
             result = await self._command(cmd)
-            self.listen_port = int(result.payload.decode())
+            # payload: "<port>" (bespoke wire) or "<port> <enr>" (libp2p
+            # wire, whose init also returns the node's signed discv5 ENR)
+            parts = result.payload.decode().split(None, 1)
+            self.listen_port = int(parts[0])
+            self.enr = parts[1] if len(parts) > 1 else None
             ident = port_pb2.Command()
             ident.get_node_identity.SetInParent()
             self.node_id = (await self._command(ident)).payload

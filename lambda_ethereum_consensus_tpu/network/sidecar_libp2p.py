@@ -82,6 +82,7 @@ class Libp2pSidecar:
         self.pending_validation: OrderedDict[bytes, asyncio.Future] = OrderedDict()
         # request_id -> inbound stream awaiting its response
         self.incoming_requests: dict[bytes, object] = {}
+        self.discovery = None  # Discv5Service after init
         self._req_counter = 0
         self.stdout_lock = asyncio.Lock()
 
@@ -128,9 +129,15 @@ class Libp2pSidecar:
                 host or "127.0.0.1", int(port or 0)
             )
             self.gossip.start()
+            # bootnodes: "host:port" dials directly; "enr:..." goes through
+            # discv5 (the reference's discovery path, discovery.go:30-146)
+            enr_boots = [a for a in cmd.init.bootnodes if a.startswith("enr:")]
             for addr in cmd.init.bootnodes:
-                asyncio.ensure_future(self._dial(addr))
-            await self.result(cmd.id, True, payload=str(self.listen_port).encode())
+                if not addr.startswith("enr:"):
+                    asyncio.ensure_future(self._dial(addr))
+            enr_text = await self._start_discovery(cmd.init, host, enr_boots)
+            payload = f"{self.listen_port} {enr_text}".encode()
+            await self.result(cmd.id, True, payload=payload)
         elif which == "get_node_identity":
             await self.result(cmd.id, True, payload=self.identity.peer_id.bytes)
         elif which == "add_peer":
@@ -163,6 +170,56 @@ class Libp2pSidecar:
             asyncio.ensure_future(self._send_response(cmd))
         else:
             await self.result(cmd.id, False, error=f"unknown command {which}")
+
+    # ----------------------------------------------------------- discovery
+    async def _start_discovery(self, init, listen_host: str, enr_boots) -> str:
+        """Start discv5; found fork-matching peers get their libp2p TCP
+        endpoint dialed.  Returns our signed ENR text (surfaced in the
+        init result so operators can hand it to other nodes).  Discovery
+        is auxiliary: any failure (UDP bind, bad SIDECAR_EXTERNAL_IP)
+        leaves the libp2p host up with discovery off, never fails init."""
+        try:
+            return await self._start_discovery_inner(init, listen_host, enr_boots)
+        except Exception as e:
+            print(
+                f"sidecar: discv5 disabled ({type(e).__name__}: {e})",
+                file=sys.stderr,
+                flush=True,
+            )
+            self.discovery = None
+            return ""
+
+    async def _start_discovery_inner(self, init, listen_host: str, enr_boots) -> str:
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        from .discovery.enr import ENR
+        from .discovery.service import Discv5Service
+
+        digest = bytes.fromhex(init.fork_digest) if init.fork_digest else None
+
+        async def on_found(record: ENR) -> None:
+            if record.ip and record.tcp:
+                await self._dial(f"{record.ip}:{record.tcp}")
+
+        key = ec.generate_private_key(ec.SECP256K1())
+        self.discovery = Discv5Service(
+            key, fork_digest=digest, on_peer=on_found
+        )
+        udp_port = await self.discovery.start(listen_host or "127.0.0.1")
+        ip_text = os.environ.get("SIDECAR_EXTERNAL_IP", "127.0.0.1")
+        self.discovery.enr = ENR.create(
+            key,
+            seq=1,
+            ip=bytes(int(x) for x in ip_text.split(".")),
+            udp=udp_port,
+            tcp=self.listen_port,
+            eth2=(digest + b"\x00" * 12) if digest else None,
+        )
+        self.discovery.node_id = self.discovery.enr.node_id
+        if enr_boots:
+            asyncio.ensure_future(self.discovery.bootstrap(enr_boots))
+            self.discovery.start_walking()
+        return self.discovery.enr.to_text()
 
     # ------------------------------------------------------------- peering
     async def _dial(self, addr: str) -> tuple[bool, str]:

@@ -1,0 +1,269 @@
+"""Discovery layer: keccak/RLP KATs, real bootnode ENRs, discv5 loopback.
+
+External oracles: the keccak-256 and RLP known-answer vectors are the
+canonical published ones; the ENR fixtures are the reference's REAL
+mainnet bootnode records (data mined from
+/root/reference/config/config.exs:26-40 — produced by go-ethereum's ENR
+encoder, so byte-exact reparse + signature verification is genuine
+cross-implementation interop); the ECDH vector is the discv5 wire
+spec's published test vector.
+"""
+
+import asyncio
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.network.discovery import discv5, rlp
+from lambda_ethereum_consensus_tpu.network.discovery.enr import ENR, ENRError
+from lambda_ethereum_consensus_tpu.network.discovery.keccak import keccak256
+from lambda_ethereum_consensus_tpu.network.discovery.service import (
+    Discv5Service,
+    log_distance,
+)
+
+# -------------------------------------------------------------- keccak-256
+
+def test_keccak256_known_answers():
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+    # multi-block input (> 136-byte rate)
+    assert keccak256(b"a" * 200) != keccak256(b"a" * 199)
+
+
+# --------------------------------------------------------------------- RLP
+
+def test_rlp_canonical_vectors():
+    # the RLP spec's examples
+    assert rlp.encode(b"dog") == bytes.fromhex("83646f67")
+    assert rlp.encode([b"cat", b"dog"]) == bytes.fromhex("c88363617483646f67")
+    assert rlp.encode(b"") == b"\x80"
+    assert rlp.encode([]) == b"\xc0"
+    assert rlp.encode(0) == b"\x80"
+    assert rlp.encode(15) == b"\x0f"
+    assert rlp.encode(1024) == bytes.fromhex("820400")
+    long = b"Lorem ipsum dolor sit amet, consectetur adipisicing elit"
+    assert rlp.encode(long) == b"\xb8\x38" + long
+
+
+def test_rlp_roundtrip_and_malformed():
+    nested = [b"a", [b"bb", [b"ccc"]], b""]
+    assert rlp.decode(rlp.encode(nested)) == nested
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(b"\xb8")  # truncated long-string length
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(bytes.fromhex("c88363617483646f"))  # truncated list body
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(rlp.encode(b"dog") + b"\x00")  # trailing bytes
+
+
+# ------------------------------------------------------- real bootnode ENRs
+# Mainnet bootnode records from the reference's config (data fixture,
+# ref: config/config.exs:26-40) — go-ethereum-encoded, reparsed here.
+
+REFERENCE_BOOTNODES = [
+    "enr:-Le4QPUXJS2BTORXxyx2Ia-9ae4YqA_JWX3ssj4E_J-3z1A-HmFGrU8BpvpqhNabayXeOZ2Nq_sbeDgtzMJpLLnXFgAChGV0aDKQtTA_KgEAAAAAIgEAAAAAAIJpZIJ2NIJpcISsaa0Zg2lwNpAkAIkHAAAAAPA8kv_-awoTiXNlY3AyNTZrMaEDHAD2JKYevx89W0CcFJFiskdcEzkH_Wdv9iW42qLK79ODdWRwgiMohHVkcDaCI4I",
+    "enr:-Le4QLHZDSvkLfqgEo8IWGG96h6mxwe_PsggC20CL3neLBjfXLGAQFOPSltZ7oP6ol54OvaNqO02Rnvb8YmDR274uq8ChGV0aDKQtTA_KgEAAAAAIgEAAAAAAIJpZIJ2NIJpcISLosQxg2lwNpAqAX4AAAAAAPA8kv_-ax65iXNlY3AyNTZrMaEDBJj7_dLFACaxBfaI8KZTh_SSJUjhyAyfshimvSqo22WDdWRwgiMohHVkcDaCI4I",
+    "enr:-Ku4QHqVeJ8PPICcWk1vSn_XcSkjOkNiTg6Fmii5j6vUQgvzMc9L1goFnLKgXqBJspJjIsB91LTOleFmyWWrFVATGngBh2F0dG5ldHOIAAAAAAAAAACEZXRoMpC1MD8qAAAAAP__________gmlkgnY0gmlwhAMRHkWJc2VjcDI1NmsxoQKLVXFOhp2uX6jeT0DvvDpPcU8FWMjQdR4wMuORMhpX24N1ZHCCIyg",
+    "enr:-Ku4QG-2_Md3sZIAUebGYT6g0SMskIml77l6yR-M_JXc-UdNHCmHQeOiMLbylPejyJsdAPsTHJyjJB2sYGDLe0dn8uYBh2F0dG5ldHOIAAAAAAAAAACEZXRoMpC1MD8qAAAAAP__________gmlkgnY0gmlwhBLY-NyJc2VjcDI1NmsxoQORcM6e19T1T9gi7jxEZjk_sjVLGFscUNqAY9obgZaxbIN1ZHCCIyg",
+]
+
+
+@pytest.mark.parametrize("text", REFERENCE_BOOTNODES, ids=["lh0", "lh1", "pr0", "pr1"])
+def test_reference_bootnode_enr_parses_verifies_roundtrips(text):
+    record = ENR.from_text(text)  # verify=True checks the secp256k1 sig
+    assert record.kv[b"id"] == b"v4"
+    assert record.ip is not None and record.udp is not None
+    assert len(record.node_id) == 32
+    # byte-exact re-encode (same RLP, same base64url)
+    assert record.to_text() == text
+
+
+def test_reference_bootnodes_share_mainnet_fork_digest():
+    digests = {ENR.from_text(t).fork_digest for t in REFERENCE_BOOTNODES}
+    assert digests == {bytes.fromhex("b5303f2a")}
+    ids = {ENR.from_text(t).node_id for t in REFERENCE_BOOTNODES}
+    assert len(ids) == len(REFERENCE_BOOTNODES)
+
+
+def test_tampered_enr_rejected():
+    raw = bytearray(ENR.from_text(REFERENCE_BOOTNODES[0]).to_rlp())
+    raw[-1] ^= 1  # flip a bit in the udp6 value
+    with pytest.raises(ENRError):
+        ENR.from_rlp(bytes(raw))
+
+
+def test_enr_create_sign_roundtrip():
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    key = ec.generate_private_key(ec.SECP256K1())
+    record = ENR.create(
+        key, seq=3, ip=bytes([127, 0, 0, 1]), udp=9000, tcp=9001,
+        eth2=bytes.fromhex("b5303f2a") + b"\x00" * 12,
+    )
+    again = ENR.from_text(record.to_text())
+    assert again.seq == 3 and again.ip == "127.0.0.1"
+    assert again.udp == 9000 and again.tcp == 9001
+    assert again.fork_digest == bytes.fromhex("b5303f2a")
+    assert again.node_id == record.node_id
+
+
+# ----------------------------------------------------------- discv5 crypto
+
+def test_discv5_ecdh_spec_vector():
+    """The discv5 wire spec's published ECDH test vector."""
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    sk = int("fb757dc581730490a1d7a00deea65e9b1936924caaea8f44d476014856b68736", 16)
+    pub = bytes.fromhex(
+        "039961e4c2356d61bedb83052c115d311acb3a96f5777296dcf297351130266231"
+    )
+    priv = ec.derive_private_key(sk, ec.SECP256K1())
+    assert discv5.ecdh_compressed(priv, pub).hex() == (
+        "033b11a2a1f214567e1537ce5e509ffd9b21373247f2a3ff6841f4976f53165e7e"
+    )
+
+
+def test_id_signature_roundtrip_and_binding():
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    key = ec.generate_private_key(ec.SECP256K1())
+    pub = discv5.compressed_pubkey(key)
+    sig = discv5.id_sign(key, b"c" * 63, b"e" * 33, b"n" * 32)
+    assert discv5.id_verify(pub, sig, b"c" * 63, b"e" * 33, b"n" * 32)
+    assert not discv5.id_verify(pub, sig, b"X" * 63, b"e" * 33, b"n" * 32)
+    other = discv5.compressed_pubkey(ec.generate_private_key(ec.SECP256K1()))
+    assert not discv5.id_verify(other, sig, b"c" * 63, b"e" * 33, b"n" * 32)
+
+
+def test_packet_masking_roundtrip():
+    node_id = bytes(range(32))
+    header = discv5.Header(discv5.FLAG_MESSAGE, b"\x07" * 12, b"\xaa" * 32)
+    packet = discv5.encode_packet(node_id, header, b"ciphertext")
+    # masked: the protocol id must not appear in clear
+    assert b"discv5" not in packet
+    iv, decoded, message = discv5.decode_packet(node_id, packet)
+    assert decoded.flag == discv5.FLAG_MESSAGE
+    assert decoded.nonce == b"\x07" * 12
+    assert decoded.authdata == b"\xaa" * 32
+    assert message == b"ciphertext"
+    # wrong destination cannot even parse the header
+    with pytest.raises(discv5.Discv5Error):
+        discv5.decode_packet(b"\xff" * 32, packet)
+
+
+def test_message_seal_open_and_tamper():
+    key, nonce, iv = b"k" * 16, b"n" * 12, b"i" * 16
+    header = discv5.Header(discv5.FLAG_MESSAGE, nonce, b"s" * 32)
+    pt = discv5.encode_message(discv5.PING, [b"\x01" * 8, 1])
+    sealed = discv5.seal_message(key, nonce, iv, header, pt)
+    assert discv5.open_message(key, nonce, iv, header, sealed) == pt
+    with pytest.raises(discv5.Discv5Error):
+        discv5.open_message(key, nonce, iv, header, sealed[:-1] + b"\x00")
+
+
+def test_findnode_multi_packet_nodes_aggregation():
+    """More records than fit one NODES packet arrive chunked with
+    total=N and must be aggregated before find_nodes resolves."""
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    async def scenario():
+        key_a = ec.generate_private_key(ec.SECP256K1())
+        key_b = ec.generate_private_key(ec.SECP256K1())
+        a = Discv5Service(key_a)
+        b = Discv5Service(key_b)
+        pa = await a.start("127.0.0.1")
+        pb = await b.start("127.0.0.1")
+        a.enr = ENR.create(key_a, seq=2, ip=bytes([127, 0, 0, 1]), udp=pa)
+        a.node_id = a.enr.node_id
+        b.enr = ENR.create(key_b, seq=2, ip=bytes([127, 0, 0, 1]), udp=pb)
+        b.node_id = b.enr.node_id
+        extras = []
+        for i in range(7):  # > MAX_NODES_PER_MESSAGE(4): needs 2 packets
+            k = ec.generate_private_key(ec.SECP256K1())
+            r = ENR.create(k, seq=1, ip=bytes([10, 0, 0, i + 1]), udp=9000 + i)
+            extras.append(r)
+            b.add_record(r)
+        await a.ping(b.enr)  # establish the session
+        distances = sorted({log_distance(b.enr.node_id, r.node_id) for r in extras})
+        found = await a.find_nodes(b.enr, distances)
+        await a.stop()
+        await b.stop()
+        return {r.node_id for r in found}, {r.node_id for r in extras}
+
+    found_ids, extra_ids = asyncio.run(scenario())
+    assert extra_ids <= found_ids
+
+
+# ---------------------------------------------------------- loopback discv5
+
+def test_discv5_handshake_ping_findnode_loopback():
+    """Two services over real UDP: WHOAREYOU handshake, PING/PONG,
+    FINDNODE/NODES, and the fork-digest-filtered peer feed."""
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    digest = bytes.fromhex("b5303f2a")
+
+    async def scenario():
+        found_by_a = []
+
+        def make(fork, port_hint=0, on_peer=None):
+            key = ec.generate_private_key(ec.SECP256K1())
+            return key, on_peer, fork
+
+        key_a = ec.generate_private_key(ec.SECP256K1())
+        key_b = ec.generate_private_key(ec.SECP256K1())
+        key_c = ec.generate_private_key(ec.SECP256K1())
+
+        async def on_peer_a(record):
+            found_by_a.append(record)
+
+        a = Discv5Service(key_a, fork_digest=digest, on_peer=on_peer_a)
+        b = Discv5Service(key_b, fork_digest=digest)
+        c = Discv5Service(key_c, fork_digest=digest)
+        pa = await a.start("127.0.0.1")
+        pb = await b.start("127.0.0.1")
+        pc = await c.start("127.0.0.1")
+        # self-describing records with real endpoints + eth2 entries
+        a.enr = ENR.create(key_a, seq=2, ip=bytes([127, 0, 0, 1]), udp=pa,
+                           eth2=digest + b"\x00" * 12)
+        a.node_id = a.enr.node_id
+        b.enr = ENR.create(key_b, seq=2, ip=bytes([127, 0, 0, 1]), udp=pb,
+                           eth2=digest + b"\x00" * 12)
+        b.node_id = b.enr.node_id
+        # c is on ANOTHER fork: a must never surface it
+        c.enr = ENR.create(key_c, seq=2, ip=bytes([127, 0, 0, 1]), udp=pc,
+                           eth2=b"\xde\xad\xbe\xef" + b"\x00" * 12)
+        c.node_id = c.enr.node_id
+
+        # b knows c (as a routing-table entry to serve via NODES)
+        b.add_record(c.enr)
+
+        # a pings b: triggers the full WHOAREYOU handshake
+        pong = await a.ping(b.enr)
+        assert int.from_bytes(pong[0], "big") == 2  # b's enr-seq
+        assert b.enr.node_id in a.sessions
+
+        # a asks b for nodes at c's distance: NODES returns c's record,
+        # but the fork filter must keep it out of the peer feed
+        dist = log_distance(b.enr.node_id, c.enr.node_id)
+        found = await a.find_nodes(b.enr, [dist])
+        assert any(r.node_id == c.enr.node_id for r in found)
+        await asyncio.sleep(0.05)
+        fed_ids = {r.node_id for r in found_by_a}
+        assert b.enr.node_id in fed_ids  # same fork: surfaced
+        assert c.enr.node_id not in fed_ids  # wrong fork: filtered
+
+        # second request rides the established session (no new handshake)
+        handshakes_before = len(a.pending_by_nonce)
+        pong2 = await a.ping(b.enr)
+        assert int.from_bytes(pong2[0], "big") == 2
+        assert len(a.pending_by_nonce) == handshakes_before
+
+        for svc in (a, b, c):
+            await svc.stop()
+
+    asyncio.run(scenario())

@@ -144,6 +144,35 @@ def test_gossip_relays_through_middle_node_libp2p():
     run(main())
 
 
+def test_discv5_bootnode_leads_to_libp2p_dial():
+    """A starts with only B's ENR: discv5 handshakes over UDP, the fork
+    filter passes, and A dials B's libp2p TCP endpoint automatically —
+    the reference's discovery->host flow (discovery.go:115-146)."""
+
+    async def main():
+        digest = b"\xba\xa4\xda\x96"
+        b = await Port.start(wire="libp2p", fork_digest=digest)
+        assert b.enr and b.enr.startswith("enr:")
+        connected = asyncio.Event()
+        peers = {}
+        a = await Port.start(
+            wire="libp2p", fork_digest=digest, bootnodes=[b.enr]
+        )
+
+        def on_new_peer(peer_id, addr):
+            peers["id"] = peer_id
+            connected.set()
+
+        a.on_new_peer = on_new_peer
+        await asyncio.wait_for(connected.wait(), 15)
+        await a.close()
+        await b.close()
+        return peers["id"], b.node_id
+
+    found, b_id = run(main())
+    assert found == b_id
+
+
 def test_rejects_feed_scoring_libp2p():
     async def main():
         sender, recver, _ = await start_pair()
