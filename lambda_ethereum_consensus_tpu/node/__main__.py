@@ -24,6 +24,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--bootnodes", default="", help="comma-separated host:port seed peers")
     p.add_argument("--api-port", type=int, default=4000, help="Beacon API port (ref default)")
     p.add_argument("--no-sync", action="store_true", help="disable range sync")
+    p.add_argument("--wire", default="", choices=["", "libp2p"],
+                   help="p2p wire mode: libp2p = real multistream/noise/"
+                        "mplex/meshsub + discv5 (enr: bootnodes supported)")
     p.add_argument("--log-level", default="info")
     return p.parse_args(argv)
 
@@ -43,6 +46,7 @@ def main(argv=None) -> None:
         api_port=args.api_port,
         checkpoint_sync_url=args.checkpoint_sync,
         enable_range_sync=not args.no_sync,
+        wire=args.wire or None,
     )
     node = BeaconNode(config)
 

@@ -50,6 +50,9 @@ class NodeConfig:
     genesis_state: BeaconState | None = None
     anchor_block: BeaconBlock | None = None
     enable_range_sync: bool = True
+    # "libp2p" = real wire protocols (multistream/noise/mplex/meshsub +
+    # discv5 for enr: bootnodes); None/"" = the bespoke-frame sidecar
+    wire: str | None = None
 
 
 class BeaconNode:
@@ -198,6 +201,7 @@ class BeaconNode:
             fork_digest=digest,
             # noise identity survives restarts: bans stay bound to the key
             key_file=self.config.db_path + ".sidecar_key",
+            wire=self.config.wire,
         )
         self.port.on_new_peer = self._on_new_peer
         self.port.on_peer_gone = self._on_peer_gone

@@ -30,13 +30,16 @@ def run(coro):
     return asyncio.run(asyncio.wait_for(coro, timeout=300))
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture
 def chain():
     """Genesis (recent wall-clock genesis_time) + CHAIN_LEN built blocks.
 
     genesis_time sits just far enough in the past that slots 1..CHAIN_LEN+1
     are acceptable now — and stays inside the one-epoch gossip window for as
     long as possible, so slow machines don't flake the gossip assertion.
+    Function-scoped on purpose: each test gets a FRESH wall-clock window
+    (a module-scoped chain ages while earlier tests run, and the gossip
+    acceptance window is only ~51 s on the minimal preset).
     """
     with use_chain_spec(minimal_spec()) as spec:
         genesis_time = int(time.time()) - (CHAIN_LEN + 1) * spec.SECONDS_PER_SLOT - 2
@@ -51,7 +54,12 @@ def chain():
         yield spec, genesis, blocks, state
 
 
-def test_two_nodes_sync_and_gossip(chain, tmp_path):
+@pytest.mark.parametrize("wire", [None, "libp2p"], ids=["bespoke", "libp2p"])
+def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
+    """wire=None: bespoke frames, host:port bootnode, plus the HTTP API
+    checks.  wire="libp2p": the REAL stack — B learns A from a discv5
+    ENR bootnode, range-syncs through eth2 req/resp on mplex streams
+    inside noise, and gets the next block on /meshsub/1.1.0 gossipsub."""
     spec, genesis, blocks, tip_state = chain
 
     async def main():
@@ -61,6 +69,7 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path):
                     db_path=str(tmp_path / "a.wal"),
                     genesis_state=genesis,
                     enable_range_sync=False,
+                    wire=wire,
                 ),
                 spec,
             )
@@ -73,12 +82,18 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path):
             head_a = get_head(node_a.store, spec)
             assert node_a.store.blocks[head_a].slot == CHAIN_LEN
 
+            if wire == "libp2p":
+                assert node_a.port.enr and node_a.port.enr.startswith("enr:")
+                bootnode = node_a.port.enr  # discovery, not an address
+            else:
+                bootnode = f"127.0.0.1:{node_a.port.listen_port}"
             node_b = BeaconNode(
                 NodeConfig(
                     db_path=str(tmp_path / "b.wal"),
                     genesis_state=genesis,
-                    bootnodes=[f"127.0.0.1:{node_a.port.listen_port}"],
+                    bootnodes=[bootnode],
                     enable_range_sync=True,
+                    wire=wire,
                 ),
                 spec,
             )
@@ -96,6 +111,8 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path):
             signed6, _ = build_signed_block(tip_state, CHAIN_LEN + 1, SKS, spec=spec)
             node_a.pending.add_block(signed6)
             await node_a.pending.process_once()
+            if wire == "libp2p":
+                await asyncio.sleep(1.0)  # meshsub heartbeat grafts the meshes
             digest = node_a.chain.fork_digest()
             await publish_ssz(
                 node_a.port, topic_name(digest, "beacon_block"), signed6, spec
@@ -111,33 +128,34 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path):
             # persistence carried the synced chain
             assert node_b.blocks_db.highest_slot() == CHAIN_LEN + 1
 
-            # ---------------- Beacon API over real HTTP against node A
-            # (urllib blocks, so run it off-loop — the server lives on this loop)
-            base = f"http://127.0.0.1:{node_a.api.port}"
-            loop = asyncio.get_running_loop()
+            if wire is None:  # API checks are wire-independent; run once
+                # ---------------- Beacon API over real HTTP against node A
+                # (urllib blocks, so run it off-loop — the server lives on this loop)
+                base = f"http://127.0.0.1:{node_a.api.port}"
+                loop = asyncio.get_running_loop()
 
-            def get_sync(path):
-                with urllib.request.urlopen(base + path, timeout=10) as r:
-                    return json.loads(r.read())
+                def get_sync(path):
+                    with urllib.request.urlopen(base + path, timeout=10) as r:
+                        return json.loads(r.read())
 
-            async def get(path):
-                return await loop.run_in_executor(None, get_sync, path)
+                async def get(path):
+                    return await loop.run_in_executor(None, get_sync, path)
 
-            head_resp = await get("/eth/v1/beacon/blocks/head/root")
-            assert head_resp["data"]["root"] == "0x" + root6.hex()
-            by_slot = await get(f"/eth/v1/beacon/blocks/{CHAIN_LEN}/root")
-            assert by_slot["data"]["root"] == (
-                "0x" + blocks[-1].message.hash_tree_root(spec).hex()
-            )
-            block_v2 = await get(f"/eth/v2/beacon/blocks/0x{root6.hex()}")
-            assert block_v2["data"]["message"]["slot"] == str(CHAIN_LEN + 1)
-            state_root = await get("/eth/v1/beacon/states/head/root")
-            assert state_root["data"]["root"].startswith("0x")
-            metrics_body = await loop.run_in_executor(
-                None,
-                lambda: urllib.request.urlopen(base + "/metrics", timeout=10).read(),
-            )
-            assert b"peers_connection_count" in metrics_body
+                head_resp = await get("/eth/v1/beacon/blocks/head/root")
+                assert head_resp["data"]["root"] == "0x" + root6.hex()
+                by_slot = await get(f"/eth/v1/beacon/blocks/{CHAIN_LEN}/root")
+                assert by_slot["data"]["root"] == (
+                    "0x" + blocks[-1].message.hash_tree_root(spec).hex()
+                )
+                block_v2 = await get(f"/eth/v2/beacon/blocks/0x{root6.hex()}")
+                assert block_v2["data"]["message"]["slot"] == str(CHAIN_LEN + 1)
+                state_root = await get("/eth/v1/beacon/states/head/root")
+                assert state_root["data"]["root"].startswith("0x")
+                metrics_body = await loop.run_in_executor(
+                    None,
+                    lambda: urllib.request.urlopen(base + "/metrics", timeout=10).read(),
+                )
+                assert b"peers_connection_count" in metrics_body
 
             await node_b.stop()
             await node_a.stop()
