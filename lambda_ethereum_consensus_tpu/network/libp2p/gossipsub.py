@@ -56,6 +56,11 @@ ACCEPT_REWARD = 1.0
 REJECT_PENALTY = 40.0
 PRUNE_SCORE = -40.0
 MAX_SCORE = 100.0
+# negative scores survive disconnection (go-libp2p-pubsub RetainScore,
+# ref: subscriptions.go RetainScore = 100 epochs) and decay slowly; a
+# reconnect must not reset a misbehaving peer's standing
+SCORE_DECAY = 0.95
+BAN_DECAY = 0.9995
 
 MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
@@ -108,6 +113,7 @@ class Gossipsub:
         self.host = host
         self.validator = validator
         self.peers: dict[PeerId, _PeerState] = {}
+        self.retained_scores: dict[PeerId, float] = {}  # negative only
         self.subscriptions: set[str] = set()
         self.mesh: dict[str, set[PeerId]] = {}
         self.fanout: dict[str, tuple[set[PeerId], float]] = {}
@@ -140,6 +146,7 @@ class Gossipsub:
     # ------------------------------------------------------------- peering
     async def _on_peer(self, peer_id: PeerId, addr: str) -> None:
         state = _PeerState(peer_id)
+        state.score = self.retained_scores.get(peer_id, 0.0)
         self.peers[peer_id] = state
         if self.subscriptions:
             rpc = pb.RPC()
@@ -178,7 +185,14 @@ class Gossipsub:
             await state.stream.drain()
 
     def _drop_peer(self, peer_id: PeerId) -> None:
-        self.peers.pop(peer_id, None)
+        state = self.peers.pop(peer_id, None)
+        if state is not None:
+            if state.score < 0:
+                self.retained_scores[peer_id] = state.score
+            else:
+                # left in good standing: a previously-retained debt the
+                # peer has since worked off must not be re-applied
+                self.retained_scores.pop(peer_id, None)
         for members in self.mesh.values():
             members.discard(peer_id)
         for members, _ in self.fanout.values():
@@ -189,6 +203,7 @@ class Gossipsub:
         state = self.peers.get(peer_id)
         if state is None:
             state = _PeerState(peer_id)
+            state.score = self.retained_scores.get(peer_id, 0.0)
             self.peers[peer_id] = state
         try:
             while True:
@@ -382,6 +397,17 @@ class Gossipsub:
         for topic, (members, expiry) in list(self.fanout.items()):
             if expiry < now:
                 del self.fanout[topic]
+        # score decay: positive washes out fast, negative slowly; retained
+        # (offline) penalties are forgiven once back above the prune bar
+        for state in self.peers.values():
+            state.score *= SCORE_DECAY if state.score >= 0 else BAN_DECAY
+        for peer_id in list(self.retained_scores):
+            self.retained_scores[peer_id] *= BAN_DECAY
+            # forgive only once the debt has decayed to noise (a -40
+            # single-REJECT debt takes ~86 min at 0.9995/0.7 s) — NOT at
+            # the prune bar, which one decay step would cross
+            if self.retained_scores[peer_id] > -1.0:
+                del self.retained_scores[peer_id]
         for topic in list(self.subscriptions):
             await self._maintain(topic)
             await self._emit_gossip(topic)

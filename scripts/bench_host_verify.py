@@ -1,0 +1,76 @@
+"""Host-path batch_verify microbench (VERDICT r2 weak #4).
+
+Measures ``crypto/bls.batch.verify_points`` below the device thresholds
+— the realistic per-slot drain (tens of aggregates) a TPU-less node or
+small batch runs — comparing the native C++ RLC path (bls381_rlc_verify:
+Montgomery MSM + lockstep Miller + one final exp) against the pure-
+Python oracle it replaced.  The bar being stood in for is the
+reference's blst-backed ``bls_nif`` (ref: native/bls_nif/src/lib.rs).
+
+Usage: python scripts/bench_host_verify.py [sizes ...]   (default 16 64)
+Prints one JSON line per size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lambda_ethereum_consensus_tpu.crypto.bls import batch as HB
+from lambda_ethereum_consensus_tpu.crypto.bls import curve as C, native
+from lambda_ethereum_consensus_tpu.crypto.bls.hash_to_curve import DST_POP, hash_to_g2
+
+
+def make_entries(n: int):
+    msgs = [b"host-bench-%d" % (i % 8) for i in range(n)]
+    hs = {m: hash_to_g2(m, DST_POP) for m in set(msgs)}
+    entries = []
+    for i in range(n):
+        sk = secrets.randbits(128) | 1
+        pk = C.g1.multiply_raw(C.G1_GENERATOR, sk)
+        sig = C.g2.multiply_raw(hs[msgs[i]], sk)
+        entries.append((pk, msgs[i], sig))
+    return entries
+
+
+def bench(n: int, reps: int = 3) -> dict:
+    entries = make_entries(n)
+    os.environ["BLS_DEVICE_CHAIN"] = "0"  # host path only
+
+    def timed(env_native: str) -> float:
+        os.environ["BLS_NO_NATIVE_RLC"] = env_native
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            assert HB.verify_points(entries)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    native_s = timed("0") if native.available() else None
+    python_s = timed("1")
+    rec = {
+        "metric": "host_batch_verify",
+        "n": n,
+        "python_s": round(python_s, 3),
+        "python_per_sec": round(n / python_s, 1),
+    }
+    if native_s is not None:
+        rec["native_s"] = round(native_s, 3)
+        rec["native_per_sec"] = round(n / native_s, 1)
+        rec["speedup"] = round(python_s / native_s, 1)
+    return rec
+
+
+def main() -> None:
+    sizes = [int(a) for a in sys.argv[1:]] or [16, 64]
+    for n in sizes:
+        print(json.dumps(bench(n)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

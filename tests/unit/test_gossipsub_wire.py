@@ -144,6 +144,34 @@ def test_reject_downscores_and_does_not_forward():
     assert seen and not cached
 
 
+def test_negative_score_survives_reconnect():
+    """A misbehaving peer's negative score is retained across disconnect
+    (go-libp2p-pubsub RetainScore semantics): the reconnecting peer
+    starts from its debt, not from zero."""
+
+    async def scenario():
+        (h1, g1), (h2, g2) = await _mesh_pair()
+
+        async def reject_all(topic, data, msg_id, peer_id):
+            return gs.REJECT
+
+        g2.validator = reject_all
+        await g1.publish(TOPIC, raw_compress(b"bad-1"))
+        await asyncio.sleep(0.1)
+        [bad_peer] = list(g2.peers)
+        score_before = g2.peers[bad_peer].score
+        g2._drop_peer(bad_peer)  # connection dies
+        assert g2.retained_scores[bad_peer] == score_before
+        await g2._on_peer(bad_peer, "127.0.0.1:1")  # reconnects
+        score_after = g2.peers[bad_peer].score
+        await h1.close()
+        await h2.close()
+        return score_before, score_after
+
+    before, after = asyncio.run(scenario())
+    assert before <= -gs.REJECT_PENALTY + 1e-9 and after == before
+
+
 def test_ihave_iwant_recovery():
     """A peer OUTSIDE the mesh learns a message id via IHAVE gossip and
     pulls the full message with IWANT."""
