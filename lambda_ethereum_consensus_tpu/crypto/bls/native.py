@@ -72,6 +72,12 @@ def _load():
             ctypes.c_size_t,                      # n_groups
             ctypes.c_int,                         # nthreads (0 = auto)
         ]
+        lib.bls381_final_exp_is_one.restype = ctypes.c_int
+        lib.bls381_final_exp_is_one.argtypes = [
+            ctypes.c_char_p,                      # fq12s: n * 576 BE bytes
+            ctypes.c_size_t,                      # n
+            ctypes.c_char_p,                      # out: n bools
+        ]
     except AttributeError:
         pass
     lib.bls381_init()
@@ -171,6 +177,32 @@ def hash_to_g2_batch(msgs: list[bytes], dst: bytes):
 
 def rlc_available() -> bool:
     return _LIB is not None and hasattr(_LIB, "bls381_rlc_verify")
+
+
+def final_exp_available() -> bool:
+    return _LIB is not None and hasattr(_LIB, "bls381_final_exp_is_one")
+
+
+def final_exp_is_one(fq12s) -> list[bool] | None:
+    """Batch final exponentiation + identity check over host fq12 tuples
+    ``((c0..), (c1..))`` — the host tail for the device chained verify
+    (everything up to the masked Miller product stays on-chip; this
+    finishes the O(checks) remainder in C++ instead of ~29 more device
+    dispatches)."""
+    if not final_exp_available():
+        return None
+    n = len(fq12s)
+    if n == 0:
+        return []
+    buf = bytearray()
+    for f in fq12s:
+        for c6 in f:
+            for c2 in c6:
+                for c in c2:
+                    buf += int(c).to_bytes(48, "big")
+    out = ctypes.create_string_buffer(n)
+    _LIB.bls381_final_exp_is_one(bytes(buf), n, out)
+    return [b == 1 for b in out.raw]
 
 
 def rlc_verify(entries, h_points, group_ids, coeff_bits: int = 128) -> bool:

@@ -9,9 +9,18 @@ executable is pickled via ``jax.experimental.serialize_executable``, and
 any later process deserializes it in milliseconds instead of recompiling.
 
 Keys are OURS (stable): function name + flattened arg shapes/dtypes +
-backend + device kind + jax version.  Any load/serialize failure falls
-back to a normal in-memory compile, so this layer can never make a result
-wrong — only a cold start slower.
+backend + device kind + jax version + a source-content hash of this
+``ops`` package.  The key deliberately does NOT hash the lowered HLO:
+``lowered.as_text()`` is not stable across processes (round-3 diagnosis:
+every cross-process lookup missed, making the cache write-only), and —
+more importantly — computing it requires tracing, which at 10-80 s per
+big staged program is the bulk of a warm process's startup.  A disk hit
+therefore skips tracing entirely; the source hash keeps a code change
+from serving stale executables (coarser than per-function identity, so a
+any-file edit in ops/ invalidates the whole cache — the safe direction).
+
+Any load/serialize failure falls back to a normal in-memory compile, so
+this layer can never make a result wrong — only a cold start slower.
 
 Role in the reference mapping: the reference's NIF .so files are its
 "compile once, load forever" boundary (ref: native/bls_nif/src/lib.rs:147-158);
@@ -58,6 +67,31 @@ def _env_tag() -> str:
     )
 
 
+_SRC_VERSION: str | None = None
+
+
+def _src_version() -> str:
+    """Content hash of this package's source files (code identity for
+    cache keys — computed once per process, no tracing needed)."""
+    global _SRC_VERSION
+    if _SRC_VERSION is None:
+        h = hashlib.sha256()
+        pkg_dir = os.path.dirname(os.path.abspath(__file__))
+        crypto_dir = os.path.join(
+            os.path.dirname(pkg_dir), "crypto", "bls"
+        )  # traced programs bake in fields.py constants/functions too
+        for d in (pkg_dir, crypto_dir):
+            if not os.path.isdir(d):
+                continue
+            for fname in sorted(os.listdir(d)):
+                if fname.endswith(".py"):
+                    with open(os.path.join(d, fname), "rb") as fh:
+                        h.update(f"{os.path.basename(d)}/{fname}".encode())
+                        h.update(fh.read())
+        _SRC_VERSION = h.hexdigest()[:16]
+    return _SRC_VERSION
+
+
 def _sig(args) -> str:
     import jax
 
@@ -79,16 +113,57 @@ def aot_jit(fn, name: str):
     """
     compiled_by_sig: dict = {}
 
+    def _log(msg: str) -> None:
+        if os.environ.get("BLS_AOT_LOG"):
+            import sys
+            import time
+
+            print(f"[aot {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
     def call(*args):
         sig = _sig(args)
         hit = compiled_by_sig.get(sig)
         if hit is not None:
             return hit(*args)
 
-        # Trace/lower first (seconds even for the big programs — the
-        # minutes are all in the compile): the disk key hashes the lowered
-        # HLO, so a SOURCE change to the function can never serve the
-        # stale pre-change executable (code identity, not just shapes).
+        import time as _t
+
+        base = aot_dir()
+        path = None
+        if base is not None:
+            key = hashlib.sha256(
+                f"{name}||{_env_tag()}||{sig}||{_src_version()}".encode()
+            ).hexdigest()[:32]
+            path = os.path.join(base, f"{name}-{key}.aot")
+
+        # 1) disk hit: deserialize — BEFORE any lowering, which is the
+        # dominant warm-start cost (10-80 s of tracing per big program)
+        if path is not None and os.path.exists(path):
+            try:
+                from jax.experimental.serialize_executable import (
+                    deserialize_and_load,
+                )
+
+                t1 = _t.perf_counter()
+                with open(path, "rb") as fh:
+                    payload, in_tree, out_tree = pickle.load(fh)
+                loaded = deserialize_and_load(payload, in_tree, out_tree)
+                _log(f"{name}: AOT loaded in {_t.perf_counter() - t1:.1f}s")
+                with _LOCK:
+                    _STATS["loads"] += 1
+                compiled_by_sig[sig] = loaded
+            except Exception as e:
+                _log(f"{name}: AOT load FAILED ({type(e).__name__}: {e})")
+                with _LOCK:
+                    _STATS["errors"] += 1
+                loaded = None  # fall through to a fresh compile
+            if loaded is not None:
+                # invoke OUTSIDE the try: a genuine runtime error from the
+                # program must surface, not masquerade as a load failure
+                # and trigger a silent recompile + second execution
+                return loaded(*args)
+
+        t0 = _t.perf_counter()
         try:
             lowered = fn.lower(*args)
         except Exception:
@@ -96,39 +171,7 @@ def aot_jit(fn, name: str):
             # callables slipped in) just run directly, uncached
             compiled_by_sig[sig] = fn
             return fn(*args)
-
-        base = aot_dir()
-        path = None
-        if base is not None:
-            try:
-                code_id = hashlib.sha256(
-                    lowered.as_text().encode()
-                ).hexdigest()[:16]
-            except Exception:
-                code_id = "nohlo"
-            key = hashlib.sha256(
-                f"{name}||{_env_tag()}||{sig}||{code_id}".encode()
-            ).hexdigest()[:32]
-            path = os.path.join(base, f"{name}-{key}.aot")
-
-        # 1) disk hit: deserialize (ms) instead of compiling (minutes)
-        if path is not None and os.path.exists(path):
-            try:
-                from jax.experimental.serialize_executable import (
-                    deserialize_and_load,
-                )
-
-                with open(path, "rb") as fh:
-                    payload, in_tree, out_tree = pickle.load(fh)
-                loaded = deserialize_and_load(payload, in_tree, out_tree)
-                with _LOCK:
-                    _STATS["loads"] += 1
-                compiled_by_sig[sig] = loaded
-                return loaded(*args)
-            except Exception:
-                with _LOCK:
-                    _STATS["errors"] += 1
-                # fall through to a fresh compile
+        _log(f"{name}: lowered in {_t.perf_counter() - t0:.1f}s")
 
         # 2) compile (and best-effort persist).  The axon tunnel's
         # remote_compile endpoint occasionally drops the connection
@@ -136,9 +179,11 @@ def aot_jit(fn, name: str):
         # read") — a transient infra fault, not a program error — so
         # retry a couple of times before giving up.
         compiled = None
+        t2 = _t.perf_counter()
         for attempt in range(3):
             try:
                 compiled = lowered.compile()
+                _log(f"{name}: COMPILED in {_t.perf_counter() - t2:.1f}s")
                 break
             except Exception as e:
                 # only the tunnel's transport faults are retryable —

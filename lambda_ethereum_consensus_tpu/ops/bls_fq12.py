@@ -59,6 +59,41 @@ def fq12_from_limbs(arr) -> tuple:
     )
 
 
+def fq12_batch_from_limbs(arr: np.ndarray, plane: bool = False) -> list:
+    """Batched limb array -> list of host Fq12 tuples.
+
+    einsum layout: ``(batch..., 2, 3, 2, 32)``; plane layout:
+    ``(32, 2, 3, 2, batch...)`` (limb planes outermost, batch trailing).
+    The single conversion point for both layouts (bls_pairing's Miller
+    pull-back and the hybrid tail both route here).
+    """
+    from .bls_g1 import _ints_batch  # batched limb->int (no per-element loop)
+
+    arr = np.asarray(arr)
+    if plane:
+        # (32, 2, 3, 2, batch...) -> (batch..., 2, 3, 2, 32)
+        arr = np.moveaxis(arr, [0, 1, 2, 3], [-1, -4, -3, -2])
+    batch_shape = arr.shape[:-4]
+    flat = arr.reshape((-1,) + arr.shape[-4:]) if batch_shape else arr[None]
+    n = flat.shape[0]
+    slot_ints = {
+        (i, j, k): _ints_batch(np.ascontiguousarray(flat[:, i, j, k]))
+        for i in range(2)
+        for j in range(3)
+        for k in range(2)
+    }
+    return [
+        tuple(
+            tuple(
+                (slot_ints[(i, j, 0)][e], slot_ints[(i, j, 1)][e])
+                for j in range(3)
+            )
+            for i in range(2)
+        )
+        for e in range(n)
+    ]
+
+
 def _bits_lsb(e: int) -> np.ndarray:
     return np.array([(e >> i) & 1 for i in range(e.bit_length())], np.int32)
 

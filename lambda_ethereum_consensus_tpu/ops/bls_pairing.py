@@ -33,6 +33,8 @@ tests) and the limb-plane Pallas stack (TPU fast path).
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..crypto.bls.fields import BLS_X, BLS_X_IS_NEG
@@ -58,6 +60,21 @@ assert BLS_X_IS_NEG, "device pairing assumes the negative BLS12-381 parameter"
 
 # w-power -> (c1?, v-power) tower slot, per w^2 = v, v^3 = xi.
 _W_SLOTS = [(0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2)]
+
+_WARNED_TAILS: set = set()
+
+
+def _warn_tail_fallback(mode: str) -> None:
+    """A broken fast tail silently reinstating the ~10 s/drain composed
+    path is a 50x latency regression — say so, once per mode."""
+    if mode not in _WARNED_TAILS:
+        _WARNED_TAILS.add(mode)
+        import logging
+
+        logging.getLogger("ops.pairing").exception(
+            "%s tail failed; falling back to the composed device tail "
+            "(expect much higher per-drain latency)", mode
+        )
 
 
 def make_pairing_ops(
@@ -306,8 +323,69 @@ def make_pairing_ops(
         d = mul(mul(pow_x(pow_x(c)), frob(frob(c))), conj(c))
         return mul(d, mul(sq(m), m))
 
+    def _tail_raw(f, mask):
+        """The WHOLE tail — masked product, easy part, hard part,
+        is-one — traced as ONE program.  The scans (pow_x_abs, inv,
+        masked product) stay lax loops inside it, so the program is
+        bounded; what fuses away is ~29 per-dispatch tunnel round trips
+        (~0.35 s each on axon — the 10 s/drain wall BENCH r3 measured
+        on the composed path)."""
+        m = masked_product(f, mask)
+        t = f12m(f12conj(m), f12inv(m))
+        e = f12m(f12frob(f12frob(t)), t)
+
+        def pxr(a):
+            return f12conj(pow_x_abs(a))
+
+        a = f12m(pxr(e), f12conj(e))
+        b = f12m(pxr(a), f12conj(a))
+        c = f12m(pxr(b), f12frob(b))
+        d = f12m(f12m(pxr(pxr(c)), f12frob(f12frob(c))), f12conj(c))
+        return ops["fq12_is_one"](f12m(d, f12m(f12sq(e), e)))
+
+    if not eager:
+        jits["check_tail_fused"] = wrap(_tail_raw, "check_tail_fused")
+
+    def _tail_hybrid(f, mask):
+        """Device masked product (ONE dispatch) -> pull the O(checks)
+        fq12 products -> C++ final exp + identity check.  The default
+        TPU tail: the composed on-device final exp costs ~29 dispatches
+        x ~0.35 s tunnel overhead (the 10 s/drain wall BENCH r3
+        measured), while the pulled remainder is 576 bytes and ~2 ms of
+        native math per check."""
+        from ..crypto.bls import native
+
+        m = jits["masked_product"](f, mask)
+        vals = FQ.fq12_batch_from_limbs(np.asarray(m), plane=plane)
+        return np.asarray(native.final_exp_is_one(vals), dtype=bool)
+
     def check_tail(f, mask):
-        """Miller outputs grouped (batch..., K) + live mask -> bools."""
+        """Miller outputs grouped (batch..., K) + live mask -> bools.
+
+        Tail modes (BLS_TAIL overrides: fused | hybrid | composed):
+        - TPU default: hybrid (device product, native host final exp);
+        - BLS_TAIL=fused: the single-program on-device tail (first use
+          pays its multi-minute compile; AOT-cached after);
+        - composed: the per-piece device dispatches — always the
+          fallback, and the only mode for CPU/staged (the multichip
+          dryrun's virtual mesh), where one giant XLA CPU program is
+          the compiler-memory failure mode the module docstring records.
+        """
+        mode = os.environ.get("BLS_TAIL", "")
+        on_tpu = not eager and jax.default_backend() == "tpu"
+        if mode == "fused" and "check_tail_fused" in jits:
+            try:
+                return jits["check_tail_fused"](f, mask)
+            except Exception:
+                _warn_tail_fallback("fused")
+        if on_tpu and mode != "composed":
+            from ..crypto.bls import native
+
+            if native.final_exp_available():
+                try:
+                    return _tail_hybrid(f, mask)
+                except Exception:
+                    _warn_tail_fallback("hybrid")
         return jits["is_one"](final_exp(jits["masked_product"](f, mask)))
 
     jits["final_exp"] = final_exp
@@ -365,24 +443,7 @@ def _pack_pairs(pairs, plane: bool):
 
 def _fq12_tuples_from_planes(f: np.ndarray, n: int) -> list:
     """(32, 2, 3, 2, B) plane Fq12 batch -> host tuples for the first n."""
-    out = []
-    slot_ints = {
-        (i, j, k): _ints_batch(np.ascontiguousarray(f[:, i, j, k, :n].T))
-        for i in range(2)
-        for j in range(3)
-        for k in range(2)
-    }
-    for e in range(n):
-        out.append(
-            tuple(
-                tuple(
-                    (slot_ints[(i, j, 0)][e], slot_ints[(i, j, 1)][e])
-                    for j in range(3)
-                )
-                for i in range(2)
-            )
-        )
-    return out
+    return FQ.fq12_batch_from_limbs(f[..., :n], plane=True)
 
 
 def miller_loop_batch(pairs, plane: bool | None = None):

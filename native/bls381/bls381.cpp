@@ -1774,6 +1774,30 @@ void bls381_hash_to_g2_batch(const uint8_t* msgs, const size_t* lens, size_t n,
 // scalars, gids: group index per entry, hs: n_groups*192B hashed message
 // points.  The per-entry scalar muls fan out across threads; group sums,
 // lockstep Miller loops and the shared final exponentiation finish on one.
+// Final exponentiation + identity check over a batch of Fq12 elements
+// (12 * 48 big-endian bytes each, coefficient order c0.c0.c0 .. c1.c2.c1).
+// Serves as the host tail for the DEVICE chained verify: the TPU runs
+// everything through the masked Miller-product, this finishes the
+// O(checks) remainder — the role the shared final exp plays inside
+// bls381_rlc_verify for the pure-host path.
+int bls381_final_exp_is_one(const uint8_t* fq12s, size_t n, uint8_t* out) {
+    bls381_init();
+    for (size_t i = 0; i < n; i++) {
+        Fq12 f;
+        const uint8_t* p = fq12s + i * 576;
+        Fp* slots[12] = {
+            &f.c0.c0.c0, &f.c0.c0.c1, &f.c0.c1.c0, &f.c0.c1.c1,
+            &f.c0.c2.c0, &f.c0.c2.c1, &f.c1.c0.c0, &f.c1.c0.c1,
+            &f.c1.c1.c0, &f.c1.c1.c1, &f.c1.c2.c0, &f.c1.c2.c1,
+        };
+        for (int j = 0; j < 12; j++) fp_from_bytes(*slots[j], p + j * 48);
+        Fq12 r;
+        final_exponentiation(r, f);
+        out[i] = fq12_is_one(r) ? 1 : 0;
+    }
+    return 0;
+}
+
 int bls381_rlc_verify(const uint8_t* pks, const uint8_t* sigs,
                       const uint8_t* coeffs, size_t coeff_len,
                       const int32_t* gids, size_t n, const uint8_t* hs,

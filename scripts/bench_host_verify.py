@@ -40,7 +40,10 @@ def make_entries(n: int):
 
 def bench(n: int, reps: int = 3) -> dict:
     entries = make_entries(n)
-    os.environ["BLS_DEVICE_CHAIN"] = "0"  # host path only
+    # host path only: BLS_NO_DEVICE is the actual kill-switch (an unset
+    # BLS_DEVICE_CHAIN still routes to the device chain on TPU hosts via
+    # device_default())
+    os.environ["BLS_NO_DEVICE"] = "1"
 
     def timed(env_native: str) -> float:
         os.environ["BLS_NO_NATIVE_RLC"] = env_native
