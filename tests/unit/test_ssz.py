@@ -349,3 +349,36 @@ def test_load_config_file_hex_fields(tmp_path):
     assert spec.DEPOSIT_CONTRACT_ADDRESS == bytes.fromhex("1234567890123456789012345678901234567890")
     assert spec.SECONDS_PER_SLOT == 3
     assert spec.SLOTS_PER_EPOCH == 32  # inherited from mainnet preset
+
+
+def test_batched_element_roots_match_loop(mainnet):
+    """The vectorized registry-root path (ssz/core._element_roots_batched)
+    must agree byte-for-byte with the per-element loop (the oracle) —
+    covering Uint, Boolean and both ByteVector chunk shapes."""
+    import numpy as np
+
+    from lambda_ethereum_consensus_tpu.ssz import core
+    from lambda_ethereum_consensus_tpu.ssz.hash import get_hash_backend
+    from lambda_ethereum_consensus_tpu.types.beacon import Validator
+
+    spec = mainnet
+    vals = [
+        Validator(
+            pubkey=bytes([i % 251] * 48),
+            withdrawal_credentials=bytes([i % 7] * 32),
+            effective_balance=32_000_000_000 + i,
+            slashed=(i % 3 == 0),
+            activation_eligibility_epoch=i,
+            activation_epoch=i + 1,
+            exit_epoch=2**64 - 1,
+            withdrawable_epoch=2**64 - 1,
+        )
+        for i in range(130)  # > the 64-element fast-path threshold
+    ]
+    be = get_hash_backend()
+    fast = core._element_roots_batched(Validator, vals, spec, be)
+    assert fast is not None
+    slow = np.stack(
+        [np.frombuffer(Validator.hash_tree_root(v, spec, be), np.uint8) for v in vals]
+    )
+    assert (fast == slow).all()
