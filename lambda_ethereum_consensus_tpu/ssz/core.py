@@ -400,6 +400,11 @@ def _element_roots_batched(elem, values, spec, backend) -> np.ndarray | None:
             size = ftype.size if isinstance(ftype, Uint) else 1
             if size > 8:
                 return None  # uint128/256 packing not specialized
+            if isinstance(ftype, Boolean) and any(
+                getattr(v, fname) not in (True, False, 0, 1) for v in values
+            ):
+                return None  # e.g. 1.5: int() would coerce what the loop
+                # path's serialize rejects — same validity either path
             try:
                 ints = np.fromiter(
                     (int(getattr(v, fname)) for v in values), np.uint64, count=n
