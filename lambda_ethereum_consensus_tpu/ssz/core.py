@@ -400,15 +400,22 @@ def _element_roots_batched(elem, values, spec, backend) -> np.ndarray | None:
             size = ftype.size if isinstance(ftype, Uint) else 1
             if size > 8:
                 return None  # uint128/256 packing not specialized
-            if isinstance(ftype, Boolean) and any(
-                getattr(v, fname) not in (True, False, 0, 1) for v in values
-            ):
-                return None  # e.g. 1.5: int() would coerce what the loop
-                # path's serialize rejects — same validity either path
+            if isinstance(ftype, Boolean):
+                # validate inside the single pass: int() would coerce
+                # values (e.g. 1.5) the loop path's serialize rejects —
+                # validity must not depend on list size
+                def conv(v, _f=fname):
+                    x = getattr(v, _f)
+                    if x not in (True, False, 0, 1):
+                        raise ValueError("invalid boolean")
+                    return int(x)
+
+            else:
+                def conv(v, _f=fname):
+                    return int(getattr(v, _f))
+
             try:
-                ints = np.fromiter(
-                    (int(getattr(v, fname)) for v in values), np.uint64, count=n
-                )
+                ints = np.fromiter((conv(v) for v in values), np.uint64, count=n)
             except (OverflowError, TypeError, ValueError):
                 return None  # let the loop path produce the typed error
             # range bound: Booleans admit only 0/1 (the loop path's

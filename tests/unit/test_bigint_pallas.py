@@ -13,6 +13,9 @@ import pytest
 from lambda_ethereum_consensus_tpu.crypto.bls.fields import P
 from lambda_ethereum_consensus_tpu.ops import bigint_pallas as BP
 
+from tests.markers import heavy
+
+
 # heavy XLA/kernel compiles: run in the `make test-device` lane
 pytestmark = pytest.mark.device
 
@@ -44,6 +47,7 @@ def _planes(xs):
     return jnp.asarray(BP.to_planes(xs, B_TILE // BP.LANES)).reshape(32, -1)
 
 
+@heavy
 def test_mul_mod_kernel_matches_host(plane_ops):
     xs, ys = _rand_elems(8), _rand_elems(8)[::-1]
     out = plane_ops["mul_mod"](_planes(xs), _planes(ys))
@@ -149,6 +153,7 @@ def test_plane_marshalling_round_trip(monkeypatch):
             assert g == C.G2_GENERATOR
 
 
+@heavy
 def test_broadcast_constant_operand(plane_ops):
     import jax.numpy as jnp
 

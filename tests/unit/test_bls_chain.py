@@ -16,6 +16,9 @@ from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
 from lambda_ethereum_consensus_tpu.crypto.bls.hash_to_curve import DST_POP, hash_to_g2
 from lambda_ethereum_consensus_tpu.ops import bls_batch as BB
 
+
+from tests.markers import heavy
+
 MSGS = [b"chain-msg-a", b"chain-msg-b", b"chain-msg-c"]
 
 
@@ -113,6 +116,7 @@ def test_verify_points_routes_through_chain(hs, monkeypatch):
 
 
 @pytest.mark.device
+@heavy
 def test_bisection_blame_routes_through_chain(hs, monkeypatch):
     """Level-synchronous bisection: each level is ONE chain_verify call
     with the sub-batches batched on the C axis."""

@@ -17,6 +17,8 @@ from lambda_ethereum_consensus_tpu.ops.bls_shard import sharded_chain_verify
 
 pytestmark = pytest.mark.device
 
+from tests.markers import heavy
+
 MSGS = [b"shard-a", b"shard-b", b"shard-c"]
 
 
@@ -37,6 +39,7 @@ def _mk_check(hs, n, n_msgs, bad_index=None):
     return (entries, hs[:n_msgs], gids)
 
 
+@heavy
 def test_sharded_chain_verify_on_virtual_mesh():
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-device CPU mesh (conftest)")

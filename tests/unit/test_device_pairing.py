@@ -15,13 +15,7 @@ import random
 
 import pytest
 
-from lambda_ethereum_consensus_tpu.utils.env import env_flag
-
-heavy = pytest.mark.skipif(
-    not env_flag("BLS_HEAVY_TESTS"),
-    reason="einsum-stack pairing compile needs tens of GB / many minutes "
-    "on CPU; set BLS_HEAVY_TESTS=1 (TPU-verified otherwise)",
-)
+from tests.markers import heavy
 
 from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
 from lambda_ethereum_consensus_tpu.crypto.bls import fields as F
