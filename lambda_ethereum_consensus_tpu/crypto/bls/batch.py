@@ -6,10 +6,17 @@ single pairing-product equation instead of 2N pairings:
     prod_j e( sum_{i in group_j} r_i * pk_i , H(m_j) )
          * e( -g1, sum_i r_i * sig_i )  ==  1
 
-with independent random 128-bit coefficients ``r_i`` (so a forged signature
-cannot cancel another item's error except with probability ~2^-128), items
-grouped by distinct message — the common gossip case (many attestations over
-few distinct ``AttestationData``) collapses to ``#messages + 1`` pairings.
+with independent random coefficients ``r_i``, items grouped by distinct
+message — the common gossip case (many attestations over few distinct
+``AttestationData``) collapses to ``#messages + 1`` pairings.
+
+Coefficient width: ``BLS_RLC_BITS`` (default 64).  A forged signature can
+only cancel another item's error with probability ~2^-bits per batch;
+64-bit randomizers are the width production batch verifiers deploy (the
+blst ``mult_n_aggregate`` randomizer convention the reference's bls_nif
+inherits — ref: native/bls_nif/src/lib.rs:14-158), and they halve the
+device ladder depth vs round 3's 128-bit default.  Set ``BLS_RLC_BITS=128``
+to restore the wider margin.
 
 ``batch_verify_each_points`` adds blame attribution by recursive bisection:
 an all-valid batch costs one check; ``b`` invalid items cost O(b log N)
@@ -35,7 +42,7 @@ from .pairing import env_flag, pairing_check
 
 __all__ = ["batch_verify", "batch_verify_each_points", "verify_points"]
 
-_COEFF_BITS = 128
+_COEFF_BITS = int(os.environ.get("BLS_RLC_BITS", "64"))
 
 # entry: (g1 affine point, message bytes, g2 affine point)
 PointEntry = tuple

@@ -1,0 +1,280 @@
+"""Incremental container Merkleization: dirty-subtree reuse across slots.
+
+``process_slot`` needs ``hash_tree_root(BeaconState)`` every slot; a full
+rehash of a 1M-validator state costs tens of seconds even with the device
+backend (BENCH_r03: 50.4 s warm — 4.2x the 12 s slot budget), while the
+slot-to-slot *delta* is tiny: a couple of history rows, the balances the
+epoch touched, the validators an operation replaced.  The reference stays
+inside the budget because its Rust ``tree_hash`` crate recomputes roots
+natively per slot (ref: native/ssz_nif/src/lib.rs:26-153); the TPU build
+gets there by not recomputing at all.
+
+``IncrementalStateRoot`` keeps, per big field, the packed chunk array and
+every Merkle level of its populated subtree.  Each call diffs the current
+value against the cached chunks (value diff for packed uint columns,
+identity diff for lists of immutable containers — every mutation path
+replaces elements, ``Container.__setattr__`` raises) and rehashes only
+the paths from dirty leaves to the root: O(k log N) host hashes instead
+of O(N).  Wholesale changes (epoch balance sweeps) fall back to a full
+field rebuild through the configured backend — the device path for big
+arrays — chosen automatically when a quarter of the chunks moved.
+
+The engine is exact, not approximate: a false-positive diff only costs
+extra hashes, and every strategy's output is pinned against the plain
+``hash_tree_root`` oracle in tests/unit/test_incremental.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from .core import (
+    ByteVector,
+    Container,
+    List,
+    SSZError,
+    Uint,
+    Vector,
+    _element_roots,
+    _resolve,
+    _typ,
+    mix_in_length,
+)
+from .hash import ZERO_HASHES, get_hash_backend, HashlibBackend
+
+__all__ = ["IncrementalStateRoot"]
+
+# a field whose dirty fraction exceeds this rebuilds through the backend
+# (batched device hashing) instead of per-path host hashing
+_REBUILD_FRACTION = 4
+
+
+def _sha(pair: bytes) -> bytes:
+    return hashlib.sha256(pair).digest()
+
+
+def _build_levels(chunks: np.ndarray, backend) -> list[np.ndarray]:
+    """All levels of the populated subtree, bottom (chunks) first."""
+    levels = [chunks]
+    level = chunks
+    d = 0
+    while level.shape[0] > 1:
+        if level.shape[0] % 2:
+            zrow = np.frombuffer(ZERO_HASHES[d], np.uint8).reshape(1, 32)
+            level = np.concatenate([level, zrow], axis=0)
+        level = backend.hash_level(level.reshape(-1, 64))
+        levels.append(level)
+        d += 1
+    return levels
+
+
+def _update_paths(levels: list[np.ndarray], dirty: np.ndarray) -> None:
+    """Rehash the root paths of ``dirty`` leaf indices in place (host)."""
+    for d in range(len(levels) - 1):
+        parents = np.unique(dirty >> 1)
+        src, dst = levels[d], levels[d + 1]
+        n = src.shape[0]
+        for p in parents:
+            li = 2 * int(p)
+            ri = li + 1
+            left = src[li].tobytes()
+            right = src[ri].tobytes() if ri < n else ZERO_HASHES[d]
+            dst[p] = np.frombuffer(_sha(left + right), np.uint8)
+        dirty = parents
+
+
+def _cap_root(levels: list[np.ndarray], limit_chunks: int) -> bytes:
+    """Extend the populated-subtree root to the type's limit depth."""
+    depth = max(limit_chunks - 1, 0).bit_length()
+    if not levels or levels[0].shape[0] == 0:
+        return ZERO_HASHES[depth]
+    root = levels[-1][0].tobytes()
+    for d in range(len(levels) - 1, depth):
+        root = _sha(root + ZERO_HASHES[d])
+    return root
+
+
+class _FieldCache:
+    __slots__ = ("strategy", "prev", "chunks", "levels", "count", "root")
+
+    def __init__(self, strategy: str):
+        self.strategy = strategy
+        self.prev = None  # identity snapshot (object-element strategies)
+        self.chunks = None  # packed (m, 32) leaf chunks
+        self.levels = None
+        self.count = -1
+        self.root = None
+
+
+def _uint_dtype(t: Uint) -> str | None:
+    return {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}.get(t.size)
+
+
+class IncrementalStateRoot:
+    """Stateful ``hash_tree_root`` for one evolving container instance.
+
+    ``backend`` is used for full-field (re)builds — pass the device
+    backend for 1M-validator states; dirty-path updates always hash on
+    host (a path is ~20 nodes; a tunneled device dispatch costs more
+    than the hashes).  One engine tracks ONE logical state lineage:
+    feed it successive snapshots of the same advancing state, not
+    unrelated states.
+    """
+
+    def __init__(self, cls: type, backend=None):
+        self.cls = cls
+        self.backend = backend
+        self._host = HashlibBackend()
+        self._fields: dict[str, _FieldCache] = {}
+        self._spec_name = None
+
+    # ------------------------------------------------------------- public
+    def root(self, state, spec=None) -> bytes:
+        from ..config import get_chain_spec
+
+        spec = spec or get_chain_spec()
+        if self._spec_name != spec.name:
+            # config swap invalidates every cached limit/shape
+            self._fields.clear()
+            self._spec_name = spec.name
+        backend = self.backend or get_hash_backend()
+        schema = self.cls.__ssz_schema__
+        roots = np.empty((len(schema), 32), np.uint8)
+        for i, (fname, ftype) in enumerate(schema.items()):
+            roots[i] = np.frombuffer(
+                self._field_root(fname, _typ(ftype), getattr(state, fname), spec, backend),
+                np.uint8,
+            )
+        # top-level container tree: ~32 leaves, host hashing
+        levels = _build_levels(roots, self._host)
+        return _cap_root(levels, len(schema))
+
+    # ------------------------------------------------------------ fields
+    def _field_root(self, fname, ftype, value, spec, backend) -> bytes:
+        strategy = self._classify(ftype, spec)
+        if strategy == "small":
+            return ftype.hash_tree_root(value, spec, self._host)
+        cache = self._fields.get(fname)
+        if cache is None or cache.strategy != strategy:
+            cache = self._fields[fname] = _FieldCache(strategy)
+        if strategy == "uint":
+            return self._uint_field(cache, ftype, value, spec, backend)
+        return self._object_field(cache, ftype, value, spec, backend)
+
+    def _classify(self, ftype, spec) -> str:
+        if isinstance(ftype, (List, Vector)):
+            elem = _typ(ftype.elem)
+            n_max = _resolve(
+                ftype.limit if isinstance(ftype, List) else ftype.length, spec
+            )
+            if n_max < 4096:
+                return "small"  # full recompute is microseconds
+            if isinstance(elem, Uint) and _uint_dtype(elem) is not None:
+                return "uint"
+            is_container = getattr(elem, "cls", None) is not None
+            if is_container or isinstance(elem, ByteVector):
+                # containers (via adapter) and ByteVector elements: one
+                # leaf per element, identity-diffed
+                return "object"
+        return "small"
+
+    # ---- packed basic columns: balances, participation, inactivity, slashings
+    def _uint_field(self, cache, ftype, value, spec, backend) -> bytes:
+        elem = _typ(ftype.elem)
+        dtype = _uint_dtype(elem)
+        is_list = isinstance(ftype, List)
+        n = len(value)
+        if is_list:
+            limit = _resolve(ftype.limit, spec)
+            if n > limit:
+                raise SSZError(f"{ftype!r} over limit: {n}")
+            limit_chunks = (limit * elem.size + 31) // 32
+        else:
+            if n != _resolve(ftype.length, spec):
+                raise SSZError(f"{ftype!r} length mismatch: {n}")
+            limit_chunks = (n * elem.size + 31) // 32
+        try:
+            # numpy >= 2 raises on out-of-range Python ints instead of
+            # silently wrapping, so this conversion doubles as validation
+            arr = np.asarray(value, dtype)
+        except (OverflowError, ValueError, TypeError) as e:
+            raise SSZError(f"{ftype!r}: {e}") from None
+        raw = arr.tobytes()
+        pad = (-len(raw)) % 32
+        chunks = np.frombuffer(raw + b"\x00" * pad, np.uint8).reshape(-1, 32)
+        m = chunks.shape[0]
+        if cache.chunks is None or cache.count != m:
+            cache.levels = _build_levels(chunks, backend if m > 4096 else self._host)
+            cache.chunks, cache.count = chunks, m
+        else:
+            dirty = np.nonzero(np.any(cache.chunks != chunks, axis=1))[0]
+            if dirty.size:
+                if dirty.size > m // _REBUILD_FRACTION:
+                    cache.levels = _build_levels(
+                        chunks, backend if m > 4096 else self._host
+                    )
+                else:
+                    cache.levels[0] = chunks.copy()
+                    _update_paths(cache.levels, dirty)
+                cache.chunks = chunks
+        root = _cap_root(cache.levels, limit_chunks)
+        return mix_in_length(root, n) if is_list else root
+
+    # ---- element-rooted lists/vectors: validators, block_roots, randao_mixes
+    def _object_field(self, cache, ftype, value, spec, backend) -> bytes:
+        elem = ftype.elem  # raw schema entry: _element_roots' batched
+        # fast path matches on the Container CLASS, not the adapter
+        is_list = isinstance(ftype, List)
+        n = len(value)
+        if is_list:
+            limit = _resolve(ftype.limit, spec)
+            if n > limit:
+                raise SSZError(f"{ftype!r} over limit: {n}")
+            limit_chunks = limit
+        else:
+            if n != _resolve(ftype.length, spec):
+                raise SSZError(f"{ftype!r} length mismatch: {n}")
+            limit_chunks = n
+        if cache.prev is None or cache.count != n:
+            leaves = self._element_leaves(elem, value, spec, backend)
+            cache.levels = _build_levels(
+                leaves, backend if n > 4096 else self._host
+            )
+            cache.prev, cache.count = list(value), n
+        else:
+            prev = cache.prev
+            dirty = [i for i in range(n) if value[i] is not prev[i]]
+            if dirty:
+                if len(dirty) > max(n // _REBUILD_FRACTION, 8):
+                    leaves = self._element_leaves(elem, value, spec, backend)
+                    cache.levels = _build_levels(
+                        leaves, backend if n > 4096 else self._host
+                    )
+                else:
+                    sub = self._element_leaves(
+                        elem, [value[i] for i in dirty], spec, self._host
+                    )
+                    cache.levels[0][dirty] = sub
+                    _update_paths(cache.levels, np.asarray(dirty, np.int64))
+                cache.prev = list(value)
+        root = _cap_root(cache.levels, limit_chunks)
+        return mix_in_length(root, n) if is_list else root
+
+    def _element_leaves(self, elem, values, spec, backend) -> np.ndarray:
+        if not values:
+            return np.zeros((0, 32), np.uint8)
+        t = _typ(elem)
+        if isinstance(t, ByteVector) and _resolve(t.length, spec) == 32:
+            # Bytes32 history/randao rows ARE their own leaves
+            raws = []
+            for v in values:
+                b = bytes(v)
+                if len(b) != 32:
+                    raise SSZError("Bytes32 row of wrong length")
+                raws.append(b)
+            # copy: frombuffer views are read-only, but these leaves are
+            # updated in place on later dirty-path passes
+            return np.frombuffer(b"".join(raws), np.uint8).reshape(-1, 32).copy()
+        return _element_roots(elem, values, spec, backend)

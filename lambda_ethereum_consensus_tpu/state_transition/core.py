@@ -20,7 +20,13 @@ from .mutable import BeaconStateMut
 def process_slot(state: BeaconStateMut, spec: ChainSpec | None = None) -> None:
     """Cache the previous state/block root into the history vectors."""
     spec = spec or get_chain_spec()
-    previous_state_root = state.freeze().hash_tree_root(spec)
+    if state._root_engine is None:
+        from ..ssz.incremental import IncrementalStateRoot
+
+        state._root_engine = IncrementalStateRoot(BeaconState)
+    # dirty-subtree reuse: a full 1M-validator rehash busts the 12 s slot
+    # budget (BENCH_r03: 50 s); the engine rehashes only what moved
+    previous_state_root = state._root_engine.root(state, spec)
     state.state_roots[state.slot % spec.SLOTS_PER_HISTORICAL_ROOT] = previous_state_root
     if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
         state.latest_block_header = state.latest_block_header.copy(

@@ -46,6 +46,9 @@ class BeaconStateMut:
             object.__setattr__(self, name, value)
         self._registry_cache: dict | None = None
         self._pubkey_index: dict[bytes, int] | None = None
+        # incremental-root engine rides the state lineage (ssz/incremental):
+        # process_slot reuses it across slots AND across freeze/thaw cycles
+        self._root_engine = getattr(state, "_root_engine", None)
 
     # -- freeze back to the immutable container
     def freeze(self) -> BeaconState:
@@ -53,6 +56,8 @@ class BeaconStateMut:
         out = object.__new__(BeaconState)
         for k, v in fields.items():
             object.__setattr__(out, k, v)
+        if self._root_engine is not None:
+            object.__setattr__(out, "_root_engine", self._root_engine)
         return out
 
     # -- registry columns (numpy views over the validators list)

@@ -149,3 +149,54 @@ def test_bisection_blame_routes_through_chain(hs, monkeypatch):
     # level-synchronous: 1 (full) + 1 (two halves) + 1 (two singles) calls,
     # each a single device dispatch regardless of sub-batch count
     assert calls == [1, 2, 2]
+
+
+@pytest.mark.device
+def test_device_committee_cache_matches_host_sums():
+    """Full-committee sums and corrected aggregates vs host affine math
+    (the epoch cache that replaces the per-drain full registry gather)."""
+    n_reg = 16
+    reg = [
+        C.g1.multiply_raw(C.G1_GENERATOR, 3 + 5 * i) for i in range(n_reg)
+    ]
+    rx, ry = BB._g1_planes(reg)
+    committees = np.array(
+        [[0, 1, 2, 3, 4, 5, 6, 7], [8, 9, 10, 11, 12, 13, 14, 15]],
+        np.int32,
+    )
+    cache = BB.DeviceCommitteeCache((rx, ry), committees, interpret=True, chunk=2)
+
+    def host_sum(idxs):
+        acc = None
+        for i in idxs:
+            acc = reg[i] if acc is None else C.g1.affine_add(acc, reg[i])
+        return acc
+
+    from lambda_ethereum_consensus_tpu.ops.bls_g1 import _ints_batch
+
+    sx = _ints_batch(np.asarray(cache.sum_x).T.astype(np.int32))
+    sy = _ints_batch(np.asarray(cache.sum_y).T.astype(np.int32))
+    for ci in range(2):
+        assert (sx[ci], sy[ci]) == host_sum(committees[ci])
+
+    # entry 0: committee 0 missing members {1, 4}; entry 1: committee 1
+    # full participation (all-dead correction); entry 2: committee 0 with
+    # EVERY member missing -> infinity flag
+    mm = 8
+    comm_ids = np.array([0, 1, 0], np.int32)
+    miss_idx = np.zeros((3, mm), np.int32)
+    miss_inf = np.ones((3, mm), bool)
+    miss_idx[0, :2] = [1, 4]
+    miss_inf[0, :2] = False
+    miss_idx[2, :8] = committees[0]
+    miss_inf[2, :8] = False
+    ax, ay, inf = cache.aggregate(comm_ids, miss_idx, miss_inf)
+    axi = _ints_batch(np.asarray(ax).T.astype(np.int32))
+    ayi = _ints_batch(np.asarray(ay).T.astype(np.int32))
+    inf = np.asarray(inf)
+
+    expect0 = host_sum([0, 2, 3, 5, 6, 7])
+    assert not inf[0] and (axi[0], ayi[0]) == expect0
+    expect1 = host_sum(committees[1])
+    assert not inf[1] and (axi[1], ayi[1]) == expect1
+    assert bool(inf[2])
