@@ -125,10 +125,11 @@ def _bench_bls() -> tuple[list[dict], str | None]:
     return recs, "; ".join(notes) or "disabled (BENCH_BLS_ATTEMPTS=0)"
 
 
-def _bench_mainnet_root(budget_s: float = 600.0) -> dict | None:
-    """Full 1M-validator BeaconState root through the SSZ engine +
-    device hash backend (VERDICT r2 #6: the product path, not the raw
-    kernel).  Subprocess-guarded like the BLS bench; None on failure."""
+def _bench_mainnet_root(budget_s: float = 600.0) -> list[dict]:
+    """Full + incremental 1M-validator BeaconState roots through the SSZ
+    engine + device hash backend (VERDICT r2 #6: the product path, not
+    the raw kernel; r3 next #2: the incremental per-slot root).
+    Subprocess-guarded like the BLS bench; empty list on failure."""
     here = os.path.dirname(os.path.abspath(__file__))
     argv = [
         sys.executable,
@@ -142,21 +143,38 @@ def _bench_mainnet_root(budget_s: float = 600.0) -> dict | None:
         )
         stdout = out.stdout or ""
     except subprocess.TimeoutExpired as e:
-        # the warm-root line prints BEFORE the epoch/head tail stages —
-        # a timeout (or a later-stage failure) must not discard it
+        # the root lines print BEFORE the epoch/head tail stages —
+        # a timeout (or a later-stage failure) must not discard them
         stdout = e.stdout or ""
         if isinstance(stdout, bytes):
             stdout = stdout.decode(errors="replace")
+    renames = {
+        "beacon_state_hash_tree_root_warm": "mainnet_state_root_warm_s",
+        "beacon_state_root_incremental_slot": "mainnet_state_root_incremental_slot_s",
+    }
+    recs = []
     for line in stdout.splitlines():
         try:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if rec.get("metric") == "beacon_state_hash_tree_root_warm":
-            rec["metric"] = "mainnet_state_root_warm_s"
+        new_name = renames.get(rec.get("metric"))
+        if new_name:
+            rec["metric"] = new_name
             rec["vs_baseline"] = rec.pop("slot_budget_frac", None)
-            return rec
-    return None
+            recs.append(rec)
+    # per-metric honest absence: a timeout after the first line must not
+    # silently drop the second metric
+    got = {r["metric"] for r in recs}
+    for name in renames.values():
+        if name not in got:
+            recs.append({
+                "metric": name, "value": None, "unit": "s",
+                "note": "mainnet bench produced no such line within budget",
+            })
+    # all-absent means the subprocess never got going; let the caller's
+    # single-fallback path report that
+    return [] if not got else recs
 
 
 def main() -> None:
@@ -175,17 +193,18 @@ def main() -> None:
     }
 
     if not os.environ.get("BENCH_NO_MAINNET"):
-        mainnet_rec = _bench_mainnet_root()
-        if mainnet_rec is None:
+        mainnet_recs = _bench_mainnet_root()
+        if not mainnet_recs:
             # honest absence, like the BLS guard: "broke" must be
             # distinguishable from "skipped"
-            mainnet_rec = {
+            mainnet_recs = [{
                 "metric": "mainnet_state_root_warm_s",
                 "value": None,
                 "unit": "s",
                 "note": "mainnet bench produced no warm-root line within budget",
-            }
-        print(json.dumps(mainnet_rec), flush=True)
+            }]
+        for rec in mainnet_recs:
+            print(json.dumps(rec), flush=True)
 
     bls_recs, err = _bench_bls()
     if err is not None:

@@ -184,7 +184,16 @@ def mint_corpus(root: str):
             ],
         )
 
-        return spec, genesis
+    # full-width corpus: every operation/epoch handler on both presets,
+    # ssz_static over every exported container, the seven bls handler
+    # formats, multi-step fork_choice, with negatives (VERDICT r3 #3)
+    from .mint_full import mint_bls_cases, mint_config_cases, mint_shuffling_cases
+
+    mint_config_cases(root, "minimal")
+    mint_config_cases(root, "mainnet")
+    mint_bls_cases(root)
+    mint_shuffling_cases(root)
+    return spec, genesis
 
 
 def main() -> None:

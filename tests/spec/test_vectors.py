@@ -79,7 +79,9 @@ def test_discovery_and_all_minted_cases_pass(minted):
     assert {c[2] for c in cases} == set(RUNNERS), "every runner format-proven"
     for config, fork, runner, handler, case_dir in cases:
         assert not RUNNERS[runner].skip(handler), (runner, handler)
-        run_case(config, runner, handler, case_dir, spec=spec)
+        # per-config spec resolution: the corpus now spans minimal AND
+        # mainnet presets, so run_case must pick the spec itself
+        run_case(config, runner, handler, case_dir)
 
 
 def test_corrupted_post_state_fails_with_diff(minted, tmp_path):
