@@ -95,11 +95,25 @@ def _ec_add(p, q):
 
 
 def _ec_mul(point, scalar: int):
+    """Fixed-structure double-and-add: always 256 iterations, the add
+    computed every round and selected by the bit.
+
+    This runs with the node's discv5 private key in ``ecdh_compressed``
+    (cryptography's ``exchange()`` can't replace it: it yields only the x
+    coordinate, and the y PARITY discv5's compressed secret needs cannot
+    be recovered from x alone — both square roots are candidates).  Python
+    big-int timing still varies by value, so the loop shape alone is not
+    constant-time; the deliberate mitigation is the key's lifetime: the
+    discovery key is regenerated per process (sidecar_libp2p never
+    persists it), so a remote timing oracle has one process lifetime to
+    work with, against UDP jitter.  go-ethereum's equivalent path is
+    constant-time native code.
+    """
     result = None
     addend = point
-    while scalar:
-        if scalar & 1:
-            result = _ec_add(result, addend)
+    for _ in range(256):
+        added = _ec_add(result, addend)
+        result = added if scalar & 1 else result
         addend = _ec_add(addend, addend)
         scalar >>= 1
     return result

@@ -46,16 +46,26 @@ def _decode_at(data: bytes, pos: int):
         return bytes([b0]), pos + 1
     if b0 < 0xB8:  # short string
         length = b0 - 0x80
-        return _take(data, pos + 1, length)
+        out, end = _take(data, pos + 1, length)
+        # canonical form: a single byte < 0x80 encodes as itself, never
+        # wrapped in 0x81 (go-ethereum rejects the wrapped form; accepting
+        # it gives one signed ENR multiple wire encodings)
+        if length == 1 and out[0] < 0x80:
+            raise RLPError("non-canonical RLP (0x81-wrapped single byte)")
+        return out, end
     if b0 < 0xC0:  # long string
         lsize = b0 - 0xB7
         length, pos = _read_length(data, pos + 1, lsize)
+        if length < 56:
+            raise RLPError("non-canonical RLP (long form for short string)")
         return _take(data, pos, length)
     if b0 < 0xF8:  # short list
         length = b0 - 0xC0
         return _decode_list(data, pos + 1, length)
     lsize = b0 - 0xF7
     length, pos = _read_length(data, pos + 1, lsize)
+    if length < 56:
+        raise RLPError("non-canonical RLP (long form for short list)")
     return _decode_list(data, pos, length)
 
 

@@ -31,6 +31,10 @@ REQUEST_TIMEOUT_S = 2.0
 CHALLENGE_TTL_S = 5.0
 WALK_INTERVAL_S = 30.0
 MAX_NODES_PER_MESSAGE = 4  # response size bound (fits typical MTU)
+# unauthenticated-surface bounds (see _sweep_state)
+CHALLENGES_CAP = 1024
+SESSIONS_CAP = 4096
+SWEEP_EVERY_PACKETS = 256
 
 
 def log_distance(a: bytes, b: bytes) -> int:
@@ -86,6 +90,7 @@ class Discv5Service(asyncio.DatagramProtocol):
         # first handshake verifies against the wrong challenge-data
         self.challenges: dict[tuple[str, int], tuple[bytes, float]] = {}
         self._walk_task: asyncio.Task | None = None
+        self._packets = 0  # sweep cadence counter (datagram path)
         # node_id -> monotonic expiry; peers re-surface after the TTL so a
         # transiently-failed dial (or an ENR update) isn't lost forever
         self._fed_until: dict[bytes, float] = {}
@@ -118,10 +123,40 @@ class Discv5Service(asyncio.DatagramProtocol):
             == log_distance(self.node_id, nid)
         ]
         if nid not in self.known and len(bucket) >= K_BUCKET:
-            del self.known[bucket[0]]  # replace oldest in the bucket
+            evicted = bucket[0]  # replace oldest in the bucket
+            del self.known[evicted]
+            # per-node satellite state dies with the bucket slot, so the
+            # k-bucket cap bounds these maps too
+            self.addrs.pop(evicted, None)
+            self.sessions.pop(evicted, None)
+            self._fed_until.pop(evicted, None)
         self.known[nid] = record
         if record.ip and record.udp:
             self.addrs[nid] = (record.ip, record.udp)
+
+    def _sweep_state(self, now: float) -> None:
+        """Expire/bound the unauthenticated-surface maps.
+
+        ``challenges`` is keyed by spoofable (ip, port) and minted for any
+        undecryptable packet, so an attacker cycling source addresses can
+        grow it without ever completing a handshake; ``sessions`` and
+        ``_fed_until`` can outlive their nodes.  Expired entries go first,
+        then a hard cap evicts oldest-first (dict order = insertion order)
+        — mirroring the gossip layer's PENDING_CAP discipline.
+        """
+        expired = [
+            a for a, (_, ts) in self.challenges.items()
+            if now - ts >= CHALLENGE_TTL_S
+        ]
+        for a in expired:
+            del self.challenges[a]
+        while len(self.challenges) > CHALLENGES_CAP:
+            del self.challenges[next(iter(self.challenges))]
+        gone = [n for n, t in self._fed_until.items() if t <= now]
+        for n in gone:
+            del self._fed_until[n]
+        while len(self.sessions) > SESSIONS_CAP:
+            del self.sessions[next(iter(self.sessions))]
 
     # ------------------------------------------------------------ requests
     async def ping(self, record: ENR, timeout: float = REQUEST_TIMEOUT_S) -> list:
@@ -180,6 +215,11 @@ class Discv5Service(asyncio.DatagramProtocol):
 
     # ------------------------------------------------------------- inbound
     def datagram_received(self, data: bytes, addr) -> None:
+        # periodic sweep on the packet path itself: the walk loop may not
+        # be running, and this is the surface an attacker drives
+        self._packets += 1
+        if self._packets % SWEEP_EVERY_PACKETS == 0:
+            self._sweep_state(time.monotonic())
         try:
             iv, header, message = discv5.decode_packet(self.node_id, data)
         except discv5.Discv5Error:
@@ -219,6 +259,9 @@ class Discv5Service(asyncio.DatagramProtocol):
         send_key, recv_key = discv5.derive_session_keys(
             secret, self.node_id, dest_id, cdata
         )
+        # pop-then-set keeps dict order = recency, so the cap sweep
+        # evicts the genuinely oldest session, not a refreshed one
+        self.sessions.pop(dest_id, None)
         self.sessions[dest_id] = _Session(send_key, recv_key)
         sig = discv5.id_sign(self.private, cdata, eph_pub, dest_id)
         enr_seq = struct.unpack(">Q", header.authdata[16:24])[0]
@@ -283,6 +326,7 @@ class Discv5Service(asyncio.DatagramProtocol):
             secret, src_id, self.node_id, cdata
         )
         # they initiated: they send with initiator-key, we with recipient-key
+        self.sessions.pop(src_id, None)  # order = recency (see above)
         self.sessions[src_id] = _Session(recipient_key, initiator_key)
         self.add_record(record)
         self._feed_peer(record)
@@ -317,6 +361,7 @@ class Discv5Service(asyncio.DatagramProtocol):
         enr_seq = known.seq if known is not None else 0
         why = discv5.build_whoareyou(id_nonce, enr_seq, header.nonce)
         out_iv = os.urandom(16)
+        self.challenges.pop(addr, None)  # order = recency for the cap sweep
         self.challenges[addr] = (
             discv5.challenge_data(out_iv, why),
             time.monotonic(),
@@ -424,6 +469,7 @@ class Discv5Service(asyncio.DatagramProtocol):
                 for r in found:
                     self.add_record(r)
                     self._feed_peer(r)
+            self._sweep_state(time.monotonic())
             await asyncio.sleep(WALK_INTERVAL_S)
 
     async def bootstrap(self, enr_texts: list[str]) -> int:

@@ -49,6 +49,10 @@ HISTORY_LENGTH = 6
 HISTORY_GOSSIP = 3
 SEEN_TTL_S = 550 * HEARTBEAT_S
 FANOUT_TTL_S = 60.0
+# bandwidth-amplification bounds (gossipsub v1.1 MaxIHaveLength /
+# GossipRetransmission roles)
+MAX_IHAVE_IDS = 5000
+MAX_IWANT_RETRANSMIT = 3
 
 ACCEPT, REJECT, IGNORE = 1, 2, 3
 
@@ -103,6 +107,10 @@ class _PeerState:
         self.score = 0.0
         self.stream = None  # our outbound meshsub stream
         self.send_lock = asyncio.Lock()
+        # msg_id -> times served to THIS peer (IWANT retransmission budget)
+        self.iwant_served: dict[bytes, int] = {}
+        # ids we will IWANT from this peer per heartbeat window
+        self.ihave_budget = MAX_IHAVE_IDS
 
 
 class Gossipsub:
@@ -266,9 +274,22 @@ class Gossipsub:
         for prune in ctl.prune:
             self.mesh.get(prune.topic_id, set()).discard(state.peer_id)
         wanted: list[bytes] = []
+        seen_this_rpc: set[bytes] = set()
         for ihave in ctl.ihave:
-            if ihave.topic_id in self.subscriptions:
-                wanted += [m for m in ihave.message_ids if m not in self.seen]
+            if ihave.topic_id not in self.subscriptions:
+                continue
+            for m in ihave.message_ids:
+                # per-peer budget refilled each heartbeat (gossipsub
+                # v1.1's MaxIHaveLength x MaxIHaveMessages role), and
+                # dedup: one repeated 10 MB id must cost one IWANT, and
+                # splitting ids across many RPCs must not reset the cap
+                if state.ihave_budget <= 0:
+                    break
+                if m in self.seen or m in seen_this_rpc:
+                    continue
+                seen_this_rpc.add(m)
+                state.ihave_budget -= 1
+                wanted.append(m)
         if wanted:
             rpc = pb.RPC()
             rpc.control.iwant.add().message_ids.extend(wanted)
@@ -276,8 +297,17 @@ class Gossipsub:
         serve: list[tuple[str, bytes]] = []
         for iwant in ctl.iwant:
             for mid in iwant.message_ids:
+                # per-(peer, msg) retransmission budget (the spec's
+                # GossipRetransmission role): re-IWANTing the same cached
+                # 10 MB entry must not amplify bandwidth forever
+                served = state.iwant_served.get(mid, 0)
+                if served >= MAX_IWANT_RETRANSMIT:
+                    continue
                 entry = self.mcache.get(mid)
                 if entry is not None:
+                    state.iwant_served[mid] = served + 1
+                    if len(state.iwant_served) > MAX_IHAVE_IDS * 4:
+                        state.iwant_served.pop(next(iter(state.iwant_served)))
                     serve.append(entry)
         if serve:
             rpc = pb.RPC()
@@ -401,6 +431,7 @@ class Gossipsub:
         # (offline) penalties are forgiven once back above the prune bar
         for state in self.peers.values():
             state.score *= SCORE_DECAY if state.score >= 0 else BAN_DECAY
+            state.ihave_budget = MAX_IHAVE_IDS  # per-heartbeat IWANT quota
         for peer_id in list(self.retained_scores):
             self.retained_scores[peer_id] *= BAN_DECAY
             # forgive only once the debt has decayed to noise (a -40

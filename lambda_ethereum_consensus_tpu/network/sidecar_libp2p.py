@@ -269,6 +269,12 @@ class Libp2pSidecar:
         self._req_counter += 1
         request_id = self._req_counter.to_bytes(8, "big")
         self.incoming_requests[request_id] = stream
+        # a request the host never answers (or whose peer resets the
+        # stream) must not pin its stream object forever: expire it after
+        # the response window, like pending_validation's cap
+        asyncio.get_running_loop().call_later(
+            self.RESPONSE_TIMEOUT_S * 2, self._expire_request, request_id
+        )
         n = port_pb2.Notification()
         n.request.protocol_id = protocol
         n.request.request_id = request_id
@@ -277,6 +283,14 @@ class Libp2pSidecar:
         await self.notify(n)
 
     RESPONSE_TIMEOUT_S = 10.0
+
+    def _expire_request(self, request_id: bytes) -> None:
+        stream = self.incoming_requests.pop(request_id, None)
+        if stream is not None:
+            task = asyncio.ensure_future(stream.reset())  # async close
+            task.add_done_callback(  # already-dead / cancelled: both fine
+                lambda t: None if t.cancelled() else t.exception()
+            )
 
     async def _send_response(self, cmd: port_pb2.Command) -> None:
         stream = self.incoming_requests.pop(cmd.send_response.request_id, None)

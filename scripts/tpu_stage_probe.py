@@ -62,7 +62,8 @@ def main() -> None:
         t0 = time.perf_counter()
         out = fn()
         leaves = jax.tree_util.tree_leaves(out)
-        leaves[0].block_until_ready()
+        if hasattr(leaves[0], "block_until_ready"):
+            leaves[0].block_until_ready()  # hybrid tail returns host numpy
         print(f"{name}: {time.perf_counter() - t0:.1f}s", flush=True)
         return out
 

@@ -267,3 +267,43 @@ def test_discv5_handshake_ping_findnode_loopback():
             await svc.stop()
 
     asyncio.run(scenario())
+
+
+def test_rlp_rejects_non_canonical_forms():
+    """go-ethereum-parity malleability bounds: one signed payload, one
+    accepted wire form (ADVICE r3)."""
+    import pytest
+
+    # single byte < 0x80 wrapped in 0x81
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(bytes([0x81, 0x7F]))
+    # long-form length below 56 (string)
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(bytes([0xB8, 0x03]) + b"abc")
+    # long-form length below 56 (list)
+    with pytest.raises(rlp.RLPError):
+        rlp.decode(bytes([0xF8, 0x02, 0x80, 0x80]))
+    # the canonical forms still decode
+    assert rlp.decode(b"\x7f") == b"\x7f"
+    assert rlp.decode(bytes.fromhex("83646f67")) == b"dog"
+
+
+def test_discv5_service_sweeps_unauthenticated_state():
+    """challenges (spoofable key) expire + cap; satellite maps follow the
+    k-bucket eviction (ADVICE r3 medium)."""
+    import time as time_mod
+
+    from lambda_ethereum_consensus_tpu.network.discovery import service as svc
+
+    s = svc.Discv5Service()
+    now = time_mod.monotonic()
+    # stale + fresh challenges; flood past the cap
+    s.challenges[("10.0.0.1", 1)] = (b"old", now - svc.CHALLENGE_TTL_S - 1)
+    for i in range(svc.CHALLENGES_CAP + 10):
+        s.challenges[("10.0.0.2", i)] = (b"x", now)
+    s._fed_until[b"\x01" * 32] = now - 1  # expired
+    s._fed_until[b"\x02" * 32] = now + 60
+    s._sweep_state(now)
+    assert ("10.0.0.1", 1) not in s.challenges
+    assert len(s.challenges) <= svc.CHALLENGES_CAP
+    assert b"\x01" * 32 not in s._fed_until and b"\x02" * 32 in s._fed_until
