@@ -32,7 +32,7 @@ from . import bls_batch as BB
 from .bls_g1 import g1_plane_field
 from .bls_g2 import g2_plane_field
 
-__all__ = ["sharded_chain_verify", "make_shard_ops"]
+__all__ = ["sharded_chain_verify", "sharded_group_sums", "make_shard_ops"]
 
 
 _DEFAULT_MESH = None
@@ -85,58 +85,62 @@ def make_shard_ops(mesh, interpret: bool):
         else {"check_rep": False}
     )
 
-    def smap(fn, in_specs, out_specs):
-        return jax.jit(
+    def smap(fn, in_specs, out_specs, name=None):
+        jitted = jax.jit(
             shard_map(
                 fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **check_kw
             )
         )
+        if name is None or jax.default_backend() != "tpu":
+            # CPU: deserialized executables can crash at run time
+            # ("Buffer Definition Event ... not found", measured round 4)
+            # and jax's own persistent cache misses for these programs —
+            # the CPU mesh path instead keeps every body scan-based so
+            # the per-process compile stays small (see the reduce note)
+            return jitted
+        from .aot import aot_jit
+
+        return aot_jit(jitted, f"shard_{name}")
 
     def _with_live(pt, live):
         X, Y, Z, inf = pt
         return X, Y, Z, inf | ~live
 
     # ---- stage 1: per-entry ladders, zero communication ----------------
-    # interpret (CPU mesh): the eager ladder runs directly on the
-    # dp-sharded inputs — eager ops follow their operands' shardings, so
-    # every step executes data-parallel across the mesh without staging
-    # the 128-step scan (whose einsum-base CPU compile is the round-1
-    # blowup).  Compiled (TPU) path: the staged scan under shard_map.
-    if interpret:
-        ladder_g1 = lambda bx, by, kb, lv: _with_live(
-            g1j["ladder"]((bx, by), kb), lv
-        )
-        ladder_g2 = lambda bx, by, kb, lv: _with_live(
-            g2j["ladder"]((bx, by), kb), lv
-        )
-    else:
-        ladder_g1 = smap(
-            lambda bx, by, kb, lv: _with_live(g1j["ladder"]((bx, by), kb), lv),
-            (P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp")),
-            (P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp")),
-        )
-        ladder_g2 = smap(
-            lambda bx, by, kb, lv: _with_live(g2j["ladder"]((bx, by), kb), lv),
-            (P(None, None, "dp"), P(None, None, "dp"), P(None, "dp"), P("dp")),
-            (
-                P(None, None, "dp"),
-                P(None, None, "dp"),
-                P(None, None, "dp"),
-                P("dp"),
-            ),
-        )
+    # BOTH modes run the staged lax.scan ladder under shard_map: the scan
+    # body compiles once per shape, and the AOT executable cache (smap
+    # name=) makes later processes load it in milliseconds.  (Round 4
+    # retired the interpret-mode eager ladder here: its ~50 per-op XLA
+    # CPU compiles cost minutes per fresh process and jax's persistent
+    # cache missed them, dominating the driver's multichip dryrun.)
+    g1j_staged = make_jacobian_ops(g1_plane_field(interpret), eager=False)
+    g2j_staged = make_jacobian_ops(g2_plane_field(interpret), eager=False)
+    ladder_g1 = smap(
+        lambda bx, by, kb, lv: _with_live(g1j_staged["ladder"]((bx, by), kb), lv),
+        (P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp")),
+        (P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp")),
+        name="ladder_g1",
+    )
+    ladder_g2 = smap(
+        lambda bx, by, kb, lv: _with_live(g2j_staged["ladder"]((bx, by), kb), lv),
+        (P(None, None, "dp"), P(None, None, "dp"), P(None, "dp"), P("dp")),
+        (
+            P(None, None, "dp"),
+            P(None, None, "dp"),
+            P(None, None, "dp"),
+            P("dp"),
+        ),
+        name="ladder_g2",
+    )
 
-    # interpret (CPU mesh): eager pairwise tree; compiled path: the
-    # scan-based staged reduce (one program per shape — unrolled tree
-    # LEVELS inside one shard_map jit are the minutes-per-program axon
-    # compile failure mode, see bls_batch)
-    if interpret:
-        _tree = chain["tree_reduce"]
-        _reduce_g1_local = lambda pt: _tree(g1j["jac_add"], pt)
-        _reduce_g2_local = lambda pt: _tree(g2j["jac_add"], pt)
-    else:
-        _reduce_g1_local = chain["staged_reduce_g1"]
-        _reduce_g2_local = chain["staged_reduce_g2"]
+    # BOTH modes: scan-based staged reduces.  One jac_add body compiles
+    # once per operand shape; round 4 measured the interpret-mode
+    # pairwise tree (log2 levels UNROLLED inside one shard_map jit) at
+    # 10+ minutes of XLA CPU compile per process — the same
+    # minutes-per-program failure mode bls_batch documents for the axon
+    # path, and neither cache layer reliably amortizes it on CPU.
+    _reduce_g1_local = chain["staged_reduce_g1"]
+    _reduce_g2_local = chain["staged_reduce_g2"]
 
     # ---- stage 2: local partial sums + all_gather + device-axis tree ---
     def _reduce_g1_body(X, Y, Z, inf, idx):
@@ -162,6 +166,7 @@ def make_shard_ops(mesh, interpret: bool):
         _reduce_g1_body,
         (P(None, "dp"), P(None, "dp"), P(None, "dp"), P("dp"), P("dp")),
         (P(None, None, None), P(None, None, None), P(None, None, None), P(None, None)),
+        name="reduce_g1",
     )
 
     def _reduce_g2_body(X, Y, Z, inf, idx):
@@ -190,6 +195,7 @@ def make_shard_ops(mesh, interpret: bool):
             P("dp"),
         ),
         (P(None, None, None), P(None, None, None), P(None, None, None), P(None,)),
+        name="reduce_g2",
     )
 
     ops = {
@@ -217,6 +223,110 @@ def sharded_chain_verify(
     Same inputs/outputs and infinity semantics as ``chain_verify``; the
     per-entry stages run data-parallel over the mesh's ``dp`` axis.
     """
+    import numpy as np
+
+    reduced = _sharded_reduced(checks, mesh, interpret, coeff_bits)
+    if reduced is None:
+        return []
+    ops, group_jac, sig_jac, hx, hy, static_live = reduced
+    import jax.numpy as jnp
+
+    chain = ops["chain"]
+    px, py, qx, qy, mask = chain["finish"](
+        group_jac, sig_jac, jnp.asarray(hx), jnp.asarray(hy),
+        jnp.asarray(static_live),
+    )
+    f = chain["miller"](px, py, qx, qy)
+    ok = chain["check_tail"](f, mask)
+    return [bool(v) for v in np.asarray(ok)]
+
+
+def sharded_group_sums(
+    checks,
+    mesh=None,
+    interpret: bool | None = None,
+    coeff_bits: int = _COEFF_BITS,
+):
+    """Run ONLY the sharded stages (ladders, per-device partial sums, the
+    ``all_gather``) and return host affine integers:
+
+        ([per-check list of per-group sum points], [per-check sig sum])
+
+    with ``None`` for a sum that reduced to infinity.  This is the
+    distributed portion of the verify — everything after it (Miller,
+    final exp) runs replicated and is covered by the single-device chain
+    tests — so the multi-chip dryrun can check the collective path
+    against a host EC oracle without paying the replicated pairing's
+    tracing cost on a virtual CPU mesh.
+    """
+    reduced = _sharded_reduced(checks, mesh, interpret, coeff_bits)
+    if reduced is None:
+        return [], []
+    _, group_jac, sig_jac, _, _, static_live = reduced
+    import numpy as np
+
+    from .bls_g1 import _ints_batch
+    from ..crypto.bls.fields import P as FIELD_P
+
+    def _to_affine(X, Y, Z, inf, fq2: bool):
+        # host Jacobian -> affine over the pulled (tiny) partials
+        shape = np.asarray(inf).shape
+        flat = int(np.prod(shape)) if shape else 1
+        lead = (32, 2) if fq2 else (32,)
+        Xs = np.asarray(X).reshape(*lead, flat)
+        Ys = np.asarray(Y).reshape(*lead, flat)
+        Zs = np.asarray(Z).reshape(*lead, flat)
+        infs = np.asarray(inf).reshape(flat)
+        out = []
+        for i in range(flat):
+            if infs[i]:
+                out.append(None)
+                continue
+            if fq2:
+                xi = [_ints_batch(Xs[:, c, i].T.reshape(1, 32).astype(np.int32))[0]
+                      for c in range(2)]
+                yi = [_ints_batch(Ys[:, c, i].T.reshape(1, 32).astype(np.int32))[0]
+                      for c in range(2)]
+                zi = [_ints_batch(Zs[:, c, i].T.reshape(1, 32).astype(np.int32))[0]
+                      for c in range(2)]
+                from ..crypto.bls import fields as F
+
+                z2 = F.fq2_mul(tuple(zi), tuple(zi))
+                z3 = F.fq2_mul(z2, tuple(zi))
+                x = F.fq2_mul(tuple(xi), F.fq2_inv(z2))
+                y = F.fq2_mul(tuple(yi), F.fq2_inv(z3))
+                out.append((x, y))
+            else:
+                xi = _ints_batch(Xs[:, i].T.reshape(1, 32).astype(np.int32))[0]
+                yi = _ints_batch(Ys[:, i].T.reshape(1, 32).astype(np.int32))[0]
+                zi = _ints_batch(Zs[:, i].T.reshape(1, 32).astype(np.int32))[0]
+                z2 = pow(zi, 2, FIELD_P)
+                z3 = (z2 * zi) % FIELD_P
+                x = (xi * pow(z2, -1, FIELD_P)) % FIELD_P
+                y = (yi * pow(z3, -1, FIELD_P)) % FIELD_P
+                out.append((x, y))
+        return out, shape
+
+    gX, gY, gZ, ginf = group_jac
+    flat_groups, gshape = _to_affine(gX, gY, gZ, ginf, fq2=False)  # (c, m1)
+    sX, sY, sZ, sinf = sig_jac
+    sig_sums, _ = _to_affine(sX, sY, sZ, sinf, fq2=True)  # (c,)
+    c, m1 = gshape
+    live = np.asarray(static_live)
+    groups_out = []
+    for ci in range(c):
+        row = [
+            flat_groups[ci * m1 + g] if live[ci, g] else None
+            for g in range(m1)
+        ]
+        groups_out.append(row)
+    return groups_out, sig_sums
+
+
+def _sharded_reduced(checks, mesh, interpret, coeff_bits):
+    """Shared front half: pack, shard, ladder, reduce.  Returns ``None``
+    for an empty check list, else ``(ops, group_jac, sig_jac, hx, hy,
+    static_live)`` with the reduced Jacobians living on device."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -235,7 +345,7 @@ def sharded_chain_verify(
 
     n_checks = len(checks)
     if n_checks == 0:
-        return []
+        return None
 
     flat_pk, flat_sig, flat_coeff = [], [], []
     for ci, (entries, _, _) in enumerate(checks):
@@ -333,12 +443,4 @@ def sharded_chain_verify(
     jac2 = ops["ladder_g2"](sgx_d, sgy_d, kb_d, lv_d)
     group_jac = ops["reduce_g1"](*jac1, put(idx_g1, P("dp")))
     sig_jac = ops["reduce_g2"](*jac2, put(idx_sig, P("dp")))
-
-    chain = ops["chain"]
-    px, py, qx, qy, mask = chain["finish"](
-        group_jac, sig_jac, jnp.asarray(hx), jnp.asarray(hy),
-        jnp.asarray(static_live),
-    )
-    f = chain["miller"](px, py, qx, qy)
-    ok = chain["check_tail"](f, mask)
-    return [bool(v) for v in np.asarray(ok)]
+    return ops, group_jac, sig_jac, hx, hy, static_live

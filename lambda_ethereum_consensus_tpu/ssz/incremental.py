@@ -47,8 +47,13 @@ from .hash import ZERO_HASHES, get_hash_backend, HashlibBackend
 __all__ = ["IncrementalStateRoot"]
 
 # a field whose dirty fraction exceeds this rebuilds through the backend
-# (batched device hashing) instead of per-path host hashing
+# instead of per-path host hashing
 _REBUILD_FRACTION = 4
+# full-field rebuilds route to the device backend only above this chunk
+# count: a tunneled dispatch costs ~0.35 s, the host hashes ~1.5M nodes/s,
+# so the crossover sits near 2^18 chunks (measured round 4: the 31k-chunk
+# participation sweep was 0.9 s via device vs 0.27 s on host)
+_DEVICE_CHUNKS = 1 << 18
 
 
 def _sha(pair: bytes) -> bytes:
@@ -206,14 +211,16 @@ class IncrementalStateRoot:
         chunks = np.frombuffer(raw + b"\x00" * pad, np.uint8).reshape(-1, 32)
         m = chunks.shape[0]
         if cache.chunks is None or cache.count != m:
-            cache.levels = _build_levels(chunks, backend if m > 4096 else self._host)
+            cache.levels = _build_levels(
+                chunks, backend if m > _DEVICE_CHUNKS else self._host
+            )
             cache.chunks, cache.count = chunks, m
         else:
             dirty = np.nonzero(np.any(cache.chunks != chunks, axis=1))[0]
             if dirty.size:
                 if dirty.size > m // _REBUILD_FRACTION:
                     cache.levels = _build_levels(
-                        chunks, backend if m > 4096 else self._host
+                        chunks, backend if m > _DEVICE_CHUNKS else self._host
                     )
                 else:
                     cache.levels[0] = chunks.copy()
@@ -240,7 +247,7 @@ class IncrementalStateRoot:
         if cache.prev is None or cache.count != n:
             leaves = self._element_leaves(elem, value, spec, backend)
             cache.levels = _build_levels(
-                leaves, backend if n > 4096 else self._host
+                leaves, backend if n > _DEVICE_CHUNKS else self._host
             )
             cache.prev, cache.count = list(value), n
         else:
@@ -250,7 +257,7 @@ class IncrementalStateRoot:
                 if len(dirty) > max(n // _REBUILD_FRACTION, 8):
                     leaves = self._element_leaves(elem, value, spec, backend)
                     cache.levels = _build_levels(
-                        leaves, backend if n > 4096 else self._host
+                        leaves, backend if n > _DEVICE_CHUNKS else self._host
                     )
                 else:
                     sub = self._element_leaves(

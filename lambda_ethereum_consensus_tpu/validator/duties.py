@@ -92,9 +92,11 @@ def build_signed_block(
         ),
         execution_payload=payload,
     )
+    from ..state_transition.core import state_root
+
     header = pre.latest_block_header
     if bytes(header.state_root) == b"\x00" * 32:
-        header = header.copy(state_root=pre.hash_tree_root(spec))
+        header = header.copy(state_root=state_root(pre, spec))
     block = BeaconBlock(
         slot=slot,
         proposer_index=proposer,
@@ -109,7 +111,7 @@ def build_signed_block(
     post_ws = BeaconStateMut(pre)
     process_block(post_ws, block, None, spec)
     post = post_ws.freeze()
-    block = block.copy(state_root=post.hash_tree_root(spec))
+    block = block.copy(state_root=state_root(post, spec))
     signed = sign_block(ws, block, secret_keys[proposer], spec)
     return signed, post
 
