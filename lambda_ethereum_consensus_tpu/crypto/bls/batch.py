@@ -11,12 +11,15 @@ message — the common gossip case (many attestations over few distinct
 ``AttestationData``) collapses to ``#messages + 1`` pairings.
 
 Coefficient width: ``BLS_RLC_BITS`` (default 64).  A forged signature can
-only cancel another item's error with probability ~2^-bits per batch;
-64-bit randomizers are the width production batch verifiers deploy (the
-blst ``mult_n_aggregate`` randomizer convention the reference's bls_nif
-inherits — ref: native/bls_nif/src/lib.rs:14-158), and they halve the
-device ladder depth vs round 3's 128-bit default.  Set ``BLS_RLC_BITS=128``
-to restore the wider margin.
+only cancel another item's error with probability ~2^-bits per batch.
+The reference's bls_nif exposes no randomized batch verify at all (ref:
+native/bls_nif/src/lib.rs:14-158 — sign/verify/aggregate only), so the
+precedent here is the wider client ecosystem: blst's batch-verification
+API (``blst_pairing_mul_n_aggregate``) is documented and deployed with
+64-bit randomizers by the consensus clients that batch gossip signatures
+(e.g. Lighthouse's ``RandomizedBatch``), trading half the ladder depth
+for a 2^-64 per-batch slip that is still far below any feasible grinding
+attack.  Set ``BLS_RLC_BITS=128`` to restore the wider margin.
 
 ``batch_verify_each_points`` adds blame attribution by recursive bisection:
 an all-valid batch costs one check; ``b`` invalid items cost O(b log N)

@@ -16,10 +16,22 @@ collide — unlike mplex, no initiator/receiver flag variants needed).
 
 Flow control: data consumes the receiver's window (256 KiB initial);
 ``WindowUpdate`` frames return capacity.  This implementation grants the
-window back as data ARRIVES (receiver's prerogative per the spec — the
+window back as data arrives while the stream's buffer stays small (the
 eth2 req/resp exchange reads streams to EOF immediately, so deferring
-grants until application reads would only add latency), and respects the
-peer's window on send, blocking until an update arrives.
+grants until application reads would only add latency) — but once a
+stream buffers more than ``MAX_STREAM_BUFFER`` un-read bytes, further
+grants are DEFERRED until a reader drains the buffer, so a peer cannot
+park unbounded memory in streams nobody reads.  Data beyond the granted
+window is a protocol violation and kills the session (go-yamux does the
+same).  On send we respect the peer's window, blocking until an update
+arrives.
+
+Accept ACK: go-yamux only releases the opener's accept-backlog slot when
+the first response frame carries FLAG_ACK, and tears the WHOLE session
+down when its StreamOpenTimeout fires on an un-ACKed stream — so every
+inbound SYN is answered with an immediate zero-length WindowUpdate+ACK
+(gossipsub streams are one-directional; waiting to piggyback the ACK on
+a data frame would mean never sending it).
 
 Half-close: FIN ends our sending direction — the peer's reader sees EOF
 while ours stays open, exactly the ``write request, close_write, read
@@ -52,6 +64,8 @@ FLAG_RST = 0x8
 
 INITIAL_WINDOW = 256 * 1024
 MAX_FRAME_DATA = 1 << 20  # sanity bound well above any window grant
+# un-read bytes a stream may buffer before window grants are deferred
+MAX_STREAM_BUFFER = 4 * 1024 * 1024
 
 _HEADER = struct.Struct(">BBHII")
 
@@ -82,11 +96,49 @@ class YamuxStream:
         self._send_window = INITIAL_WINDOW
         self._window_event = asyncio.Event()
         self._sent_syn = False
+        # receiver-side flow control: what we have granted minus what the
+        # peer has sent; grants deferred while _buf is over the cap
+        self._recv_window = INITIAL_WINDOW
+        self._deferred_grant = 0
 
     # -- feeding (called by the muxer read loop) --------------------------
     def _feed(self, data: bytes) -> None:
         self._buf += data
         self._recv_event.set()
+
+    def _consume_recv_window(self, n: int) -> None:
+        if n > self._recv_window:
+            raise YamuxError(
+                f"stream {self.stream_id}: peer overran receive window "
+                f"({n} > {self._recv_window})"
+            )
+        self._recv_window -= n
+        self._deferred_grant += n
+
+    def _grant_due(self) -> int:
+        """Window to hand back now: everything consumed, unless the
+        buffer is over the cap (then grants wait for a reader)."""
+        if len(self._buf) > MAX_STREAM_BUFFER or not self._deferred_grant:
+            return 0
+        due, self._deferred_grant = self._deferred_grant, 0
+        self._recv_window += due
+        return due
+
+    def _flush_grants(self) -> None:
+        """Called after a reader drained ``_buf``: release deferred
+        grants (fire-and-forget; the send lock serializes frames)."""
+        due = self._grant_due()
+        if due and not self._muxer._closed:
+
+            async def _grant():
+                try:
+                    await self._muxer._send(
+                        encode_frame(TYPE_WINDOW, 0, self.stream_id, due)
+                    )
+                except (ConnectionError, OSError, YamuxError):
+                    pass  # connection died mid-grant; run() tears down
+
+            asyncio.ensure_future(_grant())
 
     def _feed_eof(self) -> None:
         self._eof = True
@@ -109,27 +161,45 @@ class YamuxStream:
 
     # -- reader side ------------------------------------------------------
     async def readexactly(self, n: int) -> bytes:
-        while len(self._buf) < n:
+        """Drains ``_buf`` incrementally (like ``read_all``) so a read
+        larger than MAX_STREAM_BUFFER keeps granting window as it
+        consumes — waiting for the full ``n`` to buffer first would
+        deadlock against the grant deferral."""
+        out = bytearray()
+        while len(out) < n:
+            if self._buf:
+                take = min(n - len(out), len(self._buf))
+                out += self._buf[:take]
+                del self._buf[:take]
+                self._flush_grants()
+                continue
             if self._reset:
                 raise YamuxError("stream reset by peer")
             if self._eof:
-                raise asyncio.IncompleteReadError(bytes(self._buf), n)
+                raise asyncio.IncompleteReadError(bytes(out), n)
             self._recv_event.clear()
             await self._recv_event.wait()
-        out = bytes(self._buf[:n])
-        del self._buf[:n]
-        return out
+        return bytes(out)
 
     async def read_all(self) -> bytes:
-        """Read until the peer half-closes (the req/resp response read)."""
+        """Read until the peer half-closes (the req/resp response read).
+
+        Drains ``_buf`` into the local accumulator on every wake so the
+        stream buffer (and with it the window-grant deferral) stays
+        small during large responses."""
+        out = bytearray()
         while not self._eof:
+            if self._buf:
+                out += self._buf
+                self._buf.clear()
+                self._flush_grants()
             self._recv_event.clear()
             await self._recv_event.wait()
         if self._reset:
             raise YamuxError("stream reset by peer")
-        out = bytes(self._buf)
+        out += self._buf
         self._buf.clear()
-        return out
+        return bytes(out)
 
     # -- writer side ------------------------------------------------------
     def write(self, data: bytes) -> None:
@@ -190,6 +260,7 @@ class Yamux:
     def __init__(self, channel, on_stream=None, initiator: bool = True):
         self._channel = channel
         self._on_stream = on_stream  # async callback(YamuxStream)
+        self._initiator = initiator
         self._next_id = 1 if initiator else 2
         self._streams: dict[int, YamuxStream] = {}
         self._send_lock = asyncio.Lock()
@@ -254,17 +325,31 @@ class Yamux:
             for stream in list(self._streams.values()):
                 stream._feed_reset()
 
-    def _get_or_open(self, stream_id: int, flags: int) -> YamuxStream | None:
+    async def _get_or_open(self, stream_id: int, flags: int) -> YamuxStream | None:
         stream = self._streams.get(stream_id)
         if stream is None and flags & FLAG_SYN:
+            if stream_id % 2 == (1 if self._initiator else 0):
+                # a SYN in OUR id space would later collide with
+                # open_stream and clobber the entry — protocol violation,
+                # session-fatal (go-yamux rejects wrong-parity SYNs too)
+                raise YamuxError(
+                    f"peer opened stream {stream_id} with our id parity"
+                )
             stream = YamuxStream(self, stream_id, we_initiated=False)
             self._streams[stream_id] = stream
+            # immediate accept-ACK: go-yamux frees its accept-backlog slot
+            # (and arms StreamOpenTimeout session teardown) on this flag,
+            # and inbound gossipsub streams may never see a response frame
+            # to piggyback it on (ADVICE r4 high)
+            await self._send(
+                encode_frame(TYPE_WINDOW, FLAG_ACK, stream_id, 0)
+            )
             if self._on_stream is not None:
                 asyncio.ensure_future(self._on_stream(stream))
         return stream
 
     async def _dispatch_data(self, stream_id: int, flags: int, data: bytes) -> None:
-        stream = self._get_or_open(stream_id, flags)
+        stream = await self._get_or_open(stream_id, flags)
         if stream is None:
             return  # unknown/already-reset stream: drop silently
         if flags & FLAG_RST:
@@ -272,17 +357,21 @@ class Yamux:
             stream._feed_reset()
             return
         if data:
+            # window accounting: overrun is session-fatal (YamuxError
+            # propagates to run()'s teardown), grants deferred while the
+            # stream buffers over MAX_STREAM_BUFFER un-read bytes
+            stream._consume_recv_window(len(data))
             stream._feed(data)
-            # grant the window straight back (receiver's choice; see
-            # module docstring) — without this a >256 KiB response stalls
-            await self._send(
-                encode_frame(TYPE_WINDOW, 0, stream_id, len(data))
-            )
+            due = stream._grant_due()
+            if due:
+                await self._send(
+                    encode_frame(TYPE_WINDOW, 0, stream_id, due)
+                )
         if flags & FLAG_FIN:
             stream._feed_eof()
 
     async def _dispatch_window(self, stream_id: int, flags: int, delta: int) -> None:
-        stream = self._get_or_open(stream_id, flags)
+        stream = await self._get_or_open(stream_id, flags)
         if stream is None:
             return
         if flags & FLAG_RST:

@@ -28,6 +28,7 @@ from ..network.gossip import TopicSubscription, topic_name
 from ..network.peerbook import Peerbook
 from ..network.port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT
 from ..network.reqresp import BlockDownloader, ReqRespServer
+from ..state_transition import misc
 from ..state_transition.errors import SpecError
 from ..store import BlockStore, KvStore, StateStore
 from ..types.beacon import BeaconBlock, BeaconBlockBody, BeaconState, SignedBeaconBlock
@@ -79,6 +80,11 @@ class BeaconNode:
         self._stopping = False
         self.device_backend = None
         self._prev_hash_backend = None
+        # subnet gossip validation state: committees-per-slot memo and the
+        # one-vote-per-validator-per-epoch IGNORE cache (epoch -> cells)
+        self._cps_memo: dict[tuple[int, bytes], tuple[int, bool]] = {}
+        self._cps_fallback_memo: dict[tuple[int, bytes], int] = {}
+        self._seen_subnet_votes: dict[int, set] = {}
 
     # ------------------------------------------------------------- startup
 
@@ -248,10 +254,13 @@ class BeaconNode:
         # drained through the SAME batched-RLC verify as aggregates
         from ..types.beacon import Attestation
 
+        import functools
+
         for i in subnets:
             sub_topic = topic_name(digest, f"beacon_attestation_{i}")
             att_sub = TopicSubscription(
-                self.port, sub_topic, self._on_attestation_batch,
+                self.port, sub_topic,
+                functools.partial(self._on_attestation_batch, i),
                 ssz_type=Attestation, spec=self.spec,
             )
             await att_sub.start()
@@ -317,10 +326,116 @@ class BeaconNode:
             batch, lambda msg: msg.value.message.aggregate, "aggregate_and_proof"
         )
 
-    async def _on_attestation_batch(self, batch) -> list[int]:
-        return self._attestation_drain(
-            batch, lambda msg: msg.value, "beacon_attestation"
+    def _committees_per_slot_at(self, target) -> tuple[int, bool] | None:
+        """``(committees_per_slot, authoritative)`` for the target epoch.
+
+        ``authoritative`` is True only when the materialized checkpoint
+        state answered — approximations (target block's post-state, the
+        justified state during sync) can cross a committee-count boundary,
+        and a REJECT issued from one would penalize honest peers, so the
+        caller must downgrade mismatches to IGNORE for those.  A
+        non-authoritative memo entry upgrades itself once the checkpoint
+        state materializes."""
+        from ..fork_choice.store import checkpoint_key
+        from ..state_transition import accessors
+
+        key = checkpoint_key(target)
+        hit = self._cps_memo.get(key)
+        if hit is not None and (hit[1] or key not in self.store.checkpoint_states):
+            return hit
+        state = self.store.checkpoint_states.get(key)
+        authoritative = state is not None
+        if state is None:
+            state = self.store.block_states.get(bytes(target.root))
+        if state is None:
+            # sync-time fallback: the justified state, memoized under its
+            # own key so gossip doesn't pay an O(registry) active-set scan
+            # per message while targets are still being fetched
+            epoch = int(target.epoch)
+            jroot = bytes(self.store.justified_checkpoint.root)
+            fhit = self._cps_fallback_memo.get((epoch, jroot))
+            if fhit is not None:
+                return fhit, False
+            jstate = self.store.block_states.get(jroot)
+            if jstate is None:
+                return None
+            cps = accessors.get_committee_count_per_slot(jstate, epoch, self.spec)
+            if len(self._cps_fallback_memo) > 64:
+                self._cps_fallback_memo.clear()
+            self._cps_fallback_memo[(epoch, jroot)] = cps
+            return cps, False
+        cps = accessors.get_committee_count_per_slot(
+            state, int(target.epoch), self.spec
         )
+        if len(self._cps_memo) > 64:
+            self._cps_memo.clear()
+        self._cps_memo[key] = (cps, authoritative)
+        return cps, authoritative
+
+    async def _on_attestation_batch(self, subnet: int, batch) -> list[int]:
+        """Subnet gossip validation (p2p spec beacon_attestation_{i}; ADVICE
+        r4: without these REJECTs the node re-propagates misrouted messages
+        compliant peers penalize) then the shared batched drain:
+
+        - REJECT unless exactly one aggregation bit is set
+        - REJECT when the committee maps to a different subnet
+        - IGNORE duplicate (validator, epoch) votes — keyed by the
+          (epoch, slot, index, bit) cell, which pins one validator per
+          epoch under the fixed epoch shuffling
+        """
+        from ..state_transition.misc import compute_subnet_for_attestation
+
+        verdicts: list[int | None] = [None] * len(batch)
+        passed, passed_pos, passed_keys = [], [], []
+        batch_keys: set = set()  # dedupe same-validator cells WITHIN the batch
+        for pos, msg in enumerate(batch):
+            att = msg.value
+            bits = att.aggregation_bits
+            if bits.count() != 1:
+                verdicts[pos] = VERDICT_REJECT
+                continue
+            cps_auth = self._committees_per_slot_at(att.data.target)
+            if cps_auth is not None:
+                cps, authoritative = cps_auth
+                if int(att.data.index) >= cps or compute_subnet_for_attestation(
+                    cps, int(att.data.slot), int(att.data.index), self.spec
+                ) != subnet:
+                    # approximate committee counts can mis-map honest
+                    # messages across a count boundary — only the real
+                    # checkpoint state justifies penalizing the sender
+                    verdicts[pos] = (
+                        VERDICT_REJECT if authoritative else VERDICT_IGNORE
+                    )
+                    continue
+            epoch = int(att.data.target.epoch)
+            key = (int(att.data.slot), int(att.data.index), bits.indices()[0])
+            if (
+                key in self._seen_subnet_votes.get(epoch, ())
+                or (epoch, key) in batch_keys
+            ):
+                verdicts[pos] = VERDICT_IGNORE
+                continue
+            batch_keys.add((epoch, key))
+            passed.append(msg)
+            passed_pos.append(pos)
+            passed_keys.append((epoch, key))
+        if passed:
+            inner = self._attestation_drain(
+                passed, lambda msg: msg.value, "beacon_attestation"
+            )
+            current_epoch = misc.compute_epoch_at_slot(
+                self.store.current_slot(self.spec), self.spec
+            )
+            for pos, verdict, (epoch, key) in zip(passed_pos, inner, passed_keys):
+                verdicts[pos] = verdict
+                if verdict == VERDICT_ACCEPT:
+                    self._seen_subnet_votes.setdefault(epoch, set()).add(key)
+            # prune epochs that can no longer appear on gossip
+            for epoch in [
+                e for e in self._seen_subnet_votes if e < current_epoch - 1
+            ]:
+                del self._seen_subnet_votes[epoch]
+        return verdicts
 
     def _on_applied(self, root: bytes, signed: SignedBeaconBlock) -> None:
         self.blocks_db.store_block(signed, self.spec)

@@ -124,6 +124,22 @@ def compute_committee(
     return [int(indices[perm[i]]) for i in range(start, end)]
 
 
+def compute_subnet_for_attestation(
+    committees_per_slot: int,
+    slot: int,
+    committee_index: int,
+    spec: ChainSpec | None = None,
+) -> int:
+    """Gossip subnet carrying an unaggregated attestation (p2p spec
+    ``compute_subnet_for_attestation``; ref: the reference scaffolds the
+    64-subnet topic set at gossipsub.ex:16-34)."""
+    spec = spec or get_chain_spec()
+    committees_since_epoch_start = committees_per_slot * (slot % spec.SLOTS_PER_EPOCH)
+    return (
+        committees_since_epoch_start + committee_index
+    ) % constants.ATTESTATION_SUBNET_COUNT
+
+
 def compute_proposer_index(
     effective_balances: Sequence[int],
     indices: Sequence[int],

@@ -174,8 +174,12 @@ def make_attestation(
     source: Checkpoint,
     secret_keys: Sequence[bytes],
     spec: ChainSpec | None = None,
+    only_position: int | None = None,
 ) -> Attestation:
-    """Aggregate attestation signed by the full committee of ``slot``."""
+    """Aggregate attestation signed by the full committee of ``slot`` —
+    or, with ``only_position``, the unaggregated single-validator vote the
+    ``beacon_attestation_{subnet}`` topics carry (exactly one aggregation
+    bit set, the p2p-spec REJECT condition for those topics)."""
     spec = spec or get_chain_spec()
     committee = accessors.get_beacon_committee(state, slot, committee_index, spec)
     data = AttestationData(
@@ -189,9 +193,15 @@ def make_attestation(
         state, constants.DOMAIN_BEACON_ATTESTER, target.epoch, spec
     )
     signing_root = misc.compute_signing_root(data, domain)
-    sigs = [bls.sign(secret_keys[i], signing_root) for i in committee]
+    positions = (
+        range(len(committee)) if only_position is None else [only_position]
+    )
+    sigs = [bls.sign(secret_keys[committee[p]], signing_root) for p in positions]
+    bits = [False] * len(committee)
+    for p in positions:
+        bits[p] = True
     return Attestation(
-        aggregation_bits=[True] * len(committee),
+        aggregation_bits=bits,
         data=data,
         signature=bls.aggregate(sigs),
     )

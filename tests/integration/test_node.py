@@ -64,12 +64,30 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
 
     async def main():
         with use_chain_spec(spec):
+            # the subnet the upcoming attestation (slot CHAIN_LEN, committee
+            # 0) actually maps to — publishing anywhere else is a p2p-spec
+            # REJECT now that subnet validation is on
+            from lambda_ethereum_consensus_tpu.state_transition import (
+                accessors as acc,
+                misc as stm,
+            )
+
+            att_subnet = stm.compute_subnet_for_attestation(
+                acc.get_committee_count_per_slot(
+                    genesis, stm.compute_epoch_at_slot(CHAIN_LEN, spec), spec
+                ),
+                CHAIN_LEN,
+                0,
+                spec,
+            )
+            subnets = (0, 1, att_subnet)
             node_a = BeaconNode(
                 NodeConfig(
                     db_path=str(tmp_path / "a.wal"),
                     genesis_state=genesis,
                     enable_range_sync=False,
                     wire=wire,
+                    attnet_subnets=subnets,
                 ),
                 spec,
             )
@@ -91,7 +109,10 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
                 )
 
                 rec = ENR.from_text(node_a.port.enr)
-                assert rec.kv.get(b"attnets") == b"\x03" + b"\x00" * 7
+                expected_attnets = bytearray(8)
+                for i in set(subnets):
+                    expected_attnets[i // 8] |= 1 << (i % 8)
+                assert rec.kv.get(b"attnets") == bytes(expected_attnets)
                 assert rec.kv.get(b"syncnets") == b"\x00"
                 bootnode = node_a.port.enr  # discovery, not an address
             else:
@@ -103,6 +124,7 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
                     bootnodes=[bootnode],
                     enable_range_sync=True,
                     wire=wire,
+                    attnet_subnets=subnets,
                 ),
                 spec,
             )
@@ -134,7 +156,7 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
                 await asyncio.sleep(0.25)
             assert get_head(node_b.store, spec) == root6, "gossip block not applied"
 
-            # ---- attestation subnet: beacon_attestation_0 end to end ----
+            # ---- attestation subnet: beacon_attestation_{i} end to end ----
             # (VERDICT r3 missing #6) an unaggregated committee vote rides
             # the subnet topic into B's fork choice via the batched verify
             from lambda_ethereum_consensus_tpu.state_transition import (
@@ -164,10 +186,14 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
                 ),
                 SKS,
                 spec,
+                only_position=0,  # subnets carry single-validator votes
             )
             before = len(node_b.store.latest_messages)
             await publish_ssz(
-                node_a.port, topic_name(digest, "beacon_attestation_0"), vote, spec
+                node_a.port,
+                topic_name(digest, f"beacon_attestation_{att_subnet}"),
+                vote,
+                spec,
             )
             for _ in range(200):
                 if len(node_b.store.latest_messages) > before:

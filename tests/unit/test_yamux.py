@@ -208,3 +208,140 @@ def test_identify_response_parses():
     protos = {f.decode() for f in fields[3]}
     assert "/eth2/test/1" in protos and host_mod.IDENTIFY_PROTOCOL in protos
     assert fields[6][0].decode().startswith("lambda-ethereum-consensus-tpu")
+
+
+def test_yamux_accept_ack_sent_on_inbound_syn():
+    """Accepting a SYN must answer an immediate WindowUpdate+ACK — go-yamux
+    only frees its accept-backlog slot on ACK and kills the session when
+    StreamOpenTimeout fires on an un-ACKed stream (ADVICE r4 high).  The
+    stream here is one-directional (we never respond), so the ACK cannot
+    ride any other frame."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        got = asyncio.Event()
+
+        async def handler(stream):
+            await stream.read_all()
+            got.set()
+
+        mb = Yamux(cb, on_stream=handler, initiator=False)
+        tb = asyncio.ensure_future(mb.run())
+
+        # raw opener side: SYN + data + FIN, then read B's frames directly
+        ca.write(encode_frame(TYPE_WINDOW, FLAG_SYN, 1, 0))
+        ca.write(encode_frame(TYPE_DATA, 0, 1, 3, b"abc"))
+        ca.write(encode_frame(TYPE_DATA, FLAG_FIN, 1, 0))
+        head = await asyncio.wait_for(ca.readexactly(12), 5)
+        version, typ, flags, stream_id, length = yamux._HEADER.unpack(head)
+        ca.close()
+        await asyncio.gather(tb, return_exceptions=True)
+        return typ, flags, stream_id, length
+
+    typ, flags, stream_id, length = asyncio.run(asyncio.wait_for(scenario(), 30))
+    assert typ == TYPE_WINDOW and stream_id == 1
+    assert flags & FLAG_ACK
+    assert length == 0
+
+
+def test_yamux_window_overrun_kills_session():
+    """Data beyond the granted receive window is a protocol violation:
+    the session tears down instead of buffering unbounded bytes."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        mb = Yamux(cb, on_stream=lambda s: asyncio.sleep(0), initiator=False)
+        tb = asyncio.ensure_future(mb.run())
+
+        ca.write(encode_frame(TYPE_WINDOW, FLAG_SYN, 1, 0))
+        # claim a frame bigger than the 256 KiB initial window (but under
+        # MAX_FRAME_DATA so the length check alone doesn't catch it)
+        over = yamux.INITIAL_WINDOW + 1
+        ca.write(encode_frame(TYPE_DATA, 0, 1, over, b"x" * over))
+        await asyncio.wait_for(tb, 5)  # read loop must exit
+        return mb._closed
+
+    assert asyncio.run(asyncio.wait_for(scenario(), 30)) is True
+
+
+def test_yamux_buffer_cap_defers_grants():
+    """A stream nobody reads stops receiving window grants once its
+    buffer passes MAX_STREAM_BUFFER; a reader draining it releases the
+    deferred grant (ADVICE r4: authenticated-peer memory DoS)."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        streams = {}
+
+        async def handler(stream):
+            streams["s"] = stream  # accept but do NOT read
+
+        mb = Yamux(cb, on_stream=handler, initiator=False)
+        tb = asyncio.ensure_future(mb.run())
+
+        small_cap = 1024
+        orig_cap = yamux.MAX_STREAM_BUFFER
+        yamux.MAX_STREAM_BUFFER = small_cap
+        try:
+            ca.write(encode_frame(TYPE_WINDOW, FLAG_SYN, 1, 0))
+            head = await asyncio.wait_for(ca.readexactly(12), 5)  # accept-ACK
+            # fill past the cap in two frames; stay inside the window
+            ca.write(encode_frame(TYPE_DATA, 0, 1, small_cap, b"a" * small_cap))
+            ca.write(encode_frame(TYPE_DATA, 0, 1, 512, b"b" * 512))
+            await asyncio.sleep(0.1)
+            s = streams["s"]
+            # first frame was granted back (buffer was at the cap, not
+            # over); the second pushed the buffer over -> grant deferred
+            head = await asyncio.wait_for(ca.readexactly(12), 5)
+            _, typ1, _, _, granted1 = yamux._HEADER.unpack(head)
+            assert typ1 == TYPE_WINDOW and granted1 == small_cap
+            assert s._deferred_grant == 512
+            # a reader drains the buffer -> deferred grant flushes
+            data = await s.readexactly(small_cap + 512)
+            assert data == b"a" * small_cap + b"b" * 512
+            await asyncio.sleep(0.1)
+            head = await asyncio.wait_for(ca.readexactly(12), 5)
+            _, typ2, _, _, granted2 = yamux._HEADER.unpack(head)
+            assert typ2 == TYPE_WINDOW and granted2 == 512
+            assert s._deferred_grant == 0
+        finally:
+            yamux.MAX_STREAM_BUFFER = orig_cap
+            ca.close()
+            await asyncio.gather(tb, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(scenario(), 30))
+
+
+def test_yamux_large_readexactly_survives_buffer_cap():
+    """A single readexactly() larger than MAX_STREAM_BUFFER must keep
+    granting window while it drains — buffering the whole read first
+    would deadlock against the grant deferral (gossipsub RPCs can be
+    10 MiB against a 4 MiB cap)."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        got = {}
+
+        async def handler(stream):
+            got["data"] = await stream.readexactly(600 * 1024)
+
+        mb = Yamux(cb, on_stream=handler, initiator=False)
+        tb = asyncio.ensure_future(mb.run())
+        ma = Yamux(ca, initiator=True)
+        ta = asyncio.ensure_future(ma.run())
+
+        small_cap = 64 * 1024  # << the 600 KiB read
+        orig_cap = yamux.MAX_STREAM_BUFFER
+        yamux.MAX_STREAM_BUFFER = small_cap
+        try:
+            s = await ma.open_stream()
+            s.write(b"z" * (600 * 1024))  # > initial window AND > cap
+            await asyncio.wait_for(s.drain(), 10)
+            await asyncio.wait_for(asyncio.sleep(0.2), 5)
+            assert got["data"] == b"z" * (600 * 1024)
+        finally:
+            yamux.MAX_STREAM_BUFFER = orig_cap
+            ca.close()
+            await asyncio.gather(ta, tb, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(scenario(), 30))
