@@ -222,6 +222,7 @@ def update_latest_messages(
             store.latest_messages[i] = LatestMessage(
                 epoch=int(target.epoch), root=beacon_block_root
             )
+            store.note_vote(i, int(target.epoch))
             updated = True
             if cache is not None and target_state is not None:
                 cache.on_vote(
@@ -279,21 +280,42 @@ def on_attestation_batch(
 
     The TPU-shaped replacement for per-message verification (SURVEY.md §2.3:
     "collect N gossip messages -> one batched verify"): structural validation
-    runs per item (through the same helper the per-item path uses), aggregate
-    pubkeys are summed from cached, already-subgroup-checked points, and all
-    signatures are checked in one random-linear-combination pairing product —
-    with bisection blame attribution when the batch fails, so one bad item
-    costs O(log N) sub-batches, not 2N pairings.  Returns one ``None``
+    runs per item, and all signatures are checked in one random-linear-
+    combination pairing product with bisection blame attribution (one bad
+    item costs O(log N) sub-batches, not 2N pairings).  Returns one ``None``
     (accepted) or ``ForkChoiceError`` (rejected) per input.
+
+    Two bodies behind one contract (VERDICT r4 next #1 — the node path must
+    run the machinery the headline measures):
+
+    - **cached device drain** (default whenever the chained device pipeline
+      is enabled for the batch size): aggregate pubkeys come from the
+      epoch-scoped ``DeviceCommitteeCache`` as ``full_sum[committee] -
+      sum(missing members)`` computed ON DEVICE, participation is reduced
+      with numpy bit ops, and accepted votes land through the vectorized
+      latest-message/head-cache batch path;
+    - **host path**: the per-item ``affine_add`` walk over cached pubkey
+      points, for small batches and non-device hosts.
     """
+    from ..crypto.bls.batch import _chain_enabled
+
+    spec = spec or get_chain_spec()
+    results: list[ForkChoiceError | None] = [None] * len(attestations)
+    if attestations and _chain_enabled(len(attestations)):
+        _attestation_batch_cached(store, attestations, is_from_block, spec, results)
+        return results
+    return _attestation_batch_host(store, attestations, is_from_block, spec, results)
+
+
+def _attestation_batch_host(
+    store, attestations, is_from_block, spec, results
+) -> list[ForkChoiceError | None]:
     from ..crypto.bls import BlsError
     from ..crypto.bls.api import _pubkey_point
     from ..crypto.bls.batch import batch_verify_each_points
     from ..crypto.bls.curve import DeserializationError, g1, g2_from_bytes
     from ..state_transition.predicates import indexed_attestation_signature_inputs
 
-    spec = spec or get_chain_spec()
-    results: list[ForkChoiceError | None] = [None] * len(attestations)
     prepared = []  # (index, attestation, indexed, point entry)
     for i, attestation in enumerate(attestations):
         try:
@@ -330,6 +352,137 @@ def on_attestation_batch(
                     "invalid attestation signature", reject=True
                 )
     return results
+
+
+def _attestation_batch_cached(
+    store, attestations, is_from_block, spec, results
+) -> None:
+    """The epoch-cache device drain (module doc: fork_choice/attestation).
+
+    Per item: fork-choice validation + numpy participation split + signing
+    root; then ONE ``batch_verify_each_cached`` chain per target context
+    (aggregate pubkeys never touch the host).  Entries whose missing-member
+    count exceeds the cache's correction capacity fall back to the host
+    aggregate path within the same call.  Accepted votes apply through the
+    vectorized batch updater.
+    """
+    import numpy as np
+
+    from ..crypto.bls import BlsError
+    from ..crypto.bls.api import _pubkey_point
+    from ..crypto.bls.batch import batch_verify_each_cached, batch_verify_each_points
+    from ..crypto.bls.curve import DeserializationError, g1, g2_from_bytes
+    from .attestation import get_attestation_context
+
+    by_ctx: dict[int, list] = {}  # id(ctx) -> [(i, att, attesting, entry)]
+    ctxs: dict[int, object] = {}
+    host_entries = []  # (i, att, attesting, point-entry) — over-capacity
+    for i, attestation in enumerate(attestations):
+        try:
+            validate_on_attestation(store, attestation, is_from_block, spec)
+            store_target_checkpoint_state(store, attestation.data.target, spec)
+            target_state = store.checkpoint_states[
+                checkpoint_key(attestation.data.target)
+            ]
+            ctx = get_attestation_context(
+                store, attestation.data.target, target_state, spec
+            )
+            cid, attesting, missing = ctx.participation(attestation)
+            if len(attesting) == 0:
+                raise ForkChoiceError("attestation has no participants", reject=True)
+            sig_pt = g2_from_bytes(bytes(attestation.signature))
+            if sig_pt is None:
+                raise ForkChoiceError("infinity signature", reject=True)
+            signing_root = ctx.signing_root(attestation.data)
+            cache = ctx.device_cache()
+            if len(missing) <= cache.mmax:
+                entry = (cid, missing.tolist(), signing_root, sig_pt)
+                by_ctx.setdefault(id(ctx), []).append((i, attestation, attesting, entry))
+                ctxs[id(ctx)] = ctx
+            else:
+                # sparse aggregate: summing the participants beats
+                # correcting the full sum — host path, same batch check
+                agg_pk = None
+                for v in attesting:
+                    pt = _pubkey_point(bytes(target_state.validators[v].pubkey))
+                    if pt is None:
+                        raise ForkChoiceError("identity pubkey in committee")
+                    agg_pk = pt if agg_pk is None else g1.affine_add(agg_pk, pt)
+                host_entries.append(
+                    (i, attestation, ctx, attesting, (agg_pk, signing_root, sig_pt))
+                )
+        except ForkChoiceError as e:
+            results[i] = e
+        except (BlsError, DeserializationError) as e:
+            results[i] = ForkChoiceError(str(e), reject=True)
+        except SpecError as e:
+            results[i] = ForkChoiceError(str(e))
+
+    # accepted votes bucketed per (ctx, target epoch+root, head root)
+    accepted: dict[tuple, list] = {}
+
+    for ctx_id, group in by_ctx.items():
+        ctx = ctxs[ctx_id]
+        flags = batch_verify_each_cached(
+            ctx.device_cache(),
+            [entry for _, _, _, entry in group],
+            message_points=ctx.message_points,
+        )
+        for (i, attestation, attesting, _), ok in zip(group, flags):
+            if ok:
+                key = (ctx_id, bytes(attestation.data.beacon_block_root))
+                accepted.setdefault(key, (ctx, attestation, []))[2].append(attesting)
+            else:
+                results[i] = ForkChoiceError(
+                    "invalid attestation signature", reject=True
+                )
+    if host_entries:
+        flags = batch_verify_each_points([e[4] for e in host_entries])
+        for (i, attestation, ctx, attesting, _), ok in zip(host_entries, flags):
+            if ok:
+                key = (id(ctx), bytes(attestation.data.beacon_block_root))
+                accepted.setdefault(key, (ctx, attestation, []))[2].append(attesting)
+            else:
+                results[i] = ForkChoiceError(
+                    "invalid attestation signature", reject=True
+                )
+
+    for (_, head_root), (ctx, attestation, arrays) in accepted.items():
+        update_latest_messages_batch(
+            store, ctx, attestation, np.concatenate(arrays)
+        )
+
+
+def update_latest_messages_batch(store, ctx, attestation, attesting) -> None:
+    """Vectorized LMD vote application: one numpy filter decides which
+    validators actually move (latest epoch strictly older), one shared
+    ``LatestMessage`` feeds the dict, and the head cache takes the whole
+    move as a batch (``HeadCache.on_votes_batch``).  Semantics match
+    :func:`update_latest_messages` exactly — same strict-epoch rule, same
+    equivocation filter, weights from the target state's effective
+    balances."""
+    import numpy as np
+
+    target = attestation.data.target
+    target_epoch = int(target.epoch)
+    beacon_block_root = bytes(attestation.data.beacon_block_root)
+    uniq = np.unique(np.asarray(attesting, np.int64))
+    if store.equivocating_indices:
+        uniq = uniq[
+            ~np.isin(uniq, np.fromiter(store.equivocating_indices, np.int64))
+        ]
+    epochs = store.vote_epoch_array(ctx.n_validators)
+    moved = uniq[epochs[uniq] < target_epoch]
+    if not len(moved):
+        return
+    epochs[moved] = target_epoch
+    lm = LatestMessage(epoch=target_epoch, root=beacon_block_root)
+    store.latest_messages.update(dict.fromkeys(moved.tolist(), lm))
+    if store.head_cache is not None:
+        store.head_cache.on_votes_batch(
+            moved, ctx.eff_balance[moved], beacon_block_root
+        )
+    store.bump()
 
 
 # -------------------------------------------------------- attester slashing

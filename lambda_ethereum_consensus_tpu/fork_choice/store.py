@@ -64,9 +64,42 @@ class Store:
     # slot because viability filtering depends on the clock.
     mutations: int = 0
     head_memo: tuple | None = None
+    # epoch-scoped attestation-verification contexts (committee tables +
+    # device committee caches), keyed like checkpoint_states — see
+    # fork_choice/attestation.py
+    attestation_contexts: dict = field(default_factory=dict)
+    # columnar mirror of latest_messages' epochs (int64, -1 = no vote):
+    # the batched drain filters "who actually moves" with one array
+    # compare instead of per-validator dict lookups
+    _vote_epochs = None
 
     def bump(self) -> None:
         self.mutations += 1
+
+    def vote_epoch_array(self, n: int):
+        """Grown-to-``n`` per-validator latest-vote-epoch array, built
+        from ``latest_messages`` on first use and kept in sync by both
+        vote-update paths (:func:`.handlers.update_latest_messages` /
+        the batched drain)."""
+        import numpy as np
+
+        if self._vote_epochs is None or len(self._vote_epochs) < n:
+            # (re)build from the authoritative dict: growing without a
+            # backfill would resurrect -1 for validators whose votes were
+            # recorded while their index was beyond the array
+            arr = np.full(n, -1, np.int64)
+            for i, lm in self.latest_messages.items():
+                if i < n:
+                    arr[i] = lm.epoch
+            self._vote_epochs = arr
+        return self._vote_epochs
+
+    def note_vote(self, index: int, epoch: int) -> None:
+        """Keep the columnar epoch mirror in sync on per-item updates."""
+        if self._vote_epochs is not None:
+            if index >= len(self._vote_epochs):
+                self.vote_epoch_array(index + 1)
+            self._vote_epochs[index] = epoch
 
     # ---------------------------------------------------------- time helpers
     def current_slot(self, spec: ChainSpec | None = None) -> int:

@@ -152,8 +152,34 @@ class HeadCache:
 
     def __init__(self, anchor_root: bytes):
         self.tree = ForkTree(anchor_root)
-        # validator index -> (vote root, recorded weight)
-        self._votes: dict[int, tuple[bytes, int]] = {}
+        # columnar vote records (validator index -> root id + counted
+        # weight): the batched drain updates hundreds of thousands of
+        # votes per epoch, so per-validator dict traffic is replaced by
+        # array writes + one bincount per distinct previous root
+        import numpy as np
+
+        self._np = np
+        self._vote_root_id = np.full(0, -1, np.int32)
+        self._vote_weight = np.zeros(0, np.int64)
+        self._roots: list[bytes] = []
+        self._root_ids: dict[bytes, int] = {}
+
+    def _ensure(self, n: int) -> None:
+        if len(self._vote_root_id) < n:
+            np = self._np
+            grown = max(n, 2 * len(self._vote_root_id), 1024)
+            rid = np.full(grown, -1, np.int32)
+            rid[: len(self._vote_root_id)] = self._vote_root_id
+            w = np.zeros(grown, np.int64)
+            w[: len(self._vote_weight)] = self._vote_weight
+            self._vote_root_id, self._vote_weight = rid, w
+
+    def _rid(self, root: bytes) -> int:
+        rid = self._root_ids.get(root)
+        if rid is None:
+            rid = self._root_ids[root] = len(self._roots)
+            self._roots.append(root)
+        return rid
 
     def head(self) -> bytes:
         return self.tree.head()
@@ -162,25 +188,71 @@ class HeadCache:
         if parent_root in self.tree:
             self.tree.add_block(root, parent_root)
 
+    def _retract(self, index: int) -> None:
+        rid = int(self._vote_root_id[index])
+        if rid >= 0 and self._roots[rid] in self.tree:
+            self.tree.add_weight(self._roots[rid], -int(self._vote_weight[index]))
+        self._vote_root_id[index] = -1
+        self._vote_weight[index] = 0
+
     def on_vote(self, index: int, root: bytes, weight: int) -> None:
-        prev = self._votes.get(index)
-        if prev is not None and prev[0] in self.tree:
-            self.tree.add_weight(prev[0], -prev[1])
+        self._ensure(index + 1)
+        self._retract(index)
         if root not in self.tree:
-            self._votes.pop(index, None)
             return
         self.tree.add_weight(root, weight)
-        self._votes[index] = (root, weight)
+        self._vote_root_id[index] = self._rid(root)
+        self._vote_weight[index] = weight
+
+    def on_votes_batch(self, indices, weights, root: bytes) -> None:
+        """All of one drain's vote moves TO one root in O(distinct
+        previous roots) tree walks: per-root subtraction sums via
+        bincount, one addition for the new root, array writes for the
+        records.  ``indices``/``weights`` are equal-length numpy arrays
+        (the caller has already filtered to validators whose vote
+        actually moves)."""
+        np = self._np
+        indices = np.asarray(indices, np.int64)
+        if not len(indices):
+            return
+        weights = np.asarray(weights, np.int64)
+        self._ensure(int(indices.max()) + 1)
+        prev_ids = self._vote_root_id[indices]
+        moved = prev_ids >= 0
+        if moved.any():
+            acc = np.zeros(len(self._roots), np.int64)
+            np.add.at(acc, prev_ids[moved], self._vote_weight[indices[moved]])
+            for rid in np.nonzero(acc)[0]:
+                prev_root = self._roots[rid]
+                if prev_root in self.tree:
+                    self.tree.add_weight(prev_root, -int(acc[rid]))
+        if root not in self.tree:
+            self._vote_root_id[indices] = -1
+            self._vote_weight[indices] = 0
+            return
+        self.tree.add_weight(root, int(weights.sum()))
+        self._vote_root_id[indices] = self._rid(root)
+        self._vote_weight[indices] = weights
 
     def on_equivocation(self, index: int) -> None:
-        prev = self._votes.pop(index, None)
-        if prev is not None and prev[0] in self.tree:
-            self.tree.add_weight(prev[0], -prev[1])
+        if index < len(self._vote_root_id):
+            self._retract(index)
 
     def prune(self, new_root: bytes) -> None:
         if new_root not in self.tree or new_root == self.tree.root:
             return
         self.tree.prune(new_root)
-        self._votes = {
-            i: v for i, v in self._votes.items() if v[0] in self.tree
-        }
+        np = self._np
+        # compact the root table too — finalization is the only moment a
+        # root can die, and without compaction the table (and the per-
+        # drain bincount over it) grows for the node's lifetime
+        remap = np.full(len(self._roots) + 1, -1, np.int32)  # [-1] stays -1
+        kept: list[bytes] = []
+        for rid, r in enumerate(self._roots):
+            if r in self.tree:
+                remap[rid] = len(kept)
+                kept.append(r)
+        self._roots = kept
+        self._root_ids = {r: i for i, r in enumerate(kept)}
+        self._vote_root_id = remap[self._vote_root_id]
+        self._vote_weight[self._vote_root_id < 0] = 0

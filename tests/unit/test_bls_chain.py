@@ -200,3 +200,64 @@ def test_device_committee_cache_matches_host_sums():
     expect1 = host_sum(committees[1])
     assert not inf[1] and (axi[1], ayi[1]) == expect1
     assert bool(inf[2])
+
+
+@pytest.mark.device
+def test_chain_verify_cached_matches_host(hs):
+    """The node-path drain: aggregate pubkeys from the epoch committee
+    cache (full sum minus missing members, all on device) + RLC tail —
+    valid, invalid-signature and ragged-committee entries vs host math."""
+    n_reg = 16
+    sks = [3 + 5 * i for i in range(n_reg)]
+    reg = [C.g1.multiply_raw(C.G1_GENERATOR, sk) for sk in sks]
+    rx, ry = BB._g1_planes(reg)
+    # ragged: committee 0 has 8 members, committee 1 only 5 (spec floor
+    # division leaves uneven rows); the padded slots must stay out of sums
+    committees = np.array(
+        [[0, 1, 2, 3, 4, 5, 6, 7], [8, 9, 10, 11, 12, 0, 0, 0]], np.int32
+    )
+    lengths = [8, 5]
+    cache = BB.DeviceCommitteeCache(
+        (rx, ry), committees, interpret=True, chunk=2, lengths=lengths, mmax=4
+    )
+    assert cache.mmax == 4
+
+    def sk_sum(comm, missing):
+        return sum(sks[i] for i in committees[comm][: lengths[comm]]) - sum(
+            sks[i] for i in missing
+        )
+
+    # entry 0: committee 0, missing {1, 4}, valid sig for message 0
+    # entry 1: committee 1 (ragged), full participation, valid, message 1
+    # entry 2: committee 0, missing {7}, INVALID sig (wrong scalar)
+    def sig_for(comm, missing, g, corrupt=False):
+        s = sk_sum(comm, missing)
+        return C.g2.multiply_raw(hs[g], s + (1 if corrupt else 0))
+
+    coeff = lambda: secrets.randbits(32) | 1
+    check_valid = (
+        [
+            (0, [1, 4], sig_for(0, [1, 4], 0), coeff()),
+            (1, [], sig_for(1, [], 1), coeff()),
+        ],
+        hs[:2],
+        [0, 1],
+    )
+    check_invalid = (
+        [(0, [7], sig_for(0, [7], 0, corrupt=True), coeff())],
+        hs[:1],
+        [0],
+    )
+    res = BB.chain_verify_cached(
+        cache, [check_valid, check_invalid], interpret=True, coeff_bits=32
+    )
+    assert res == [True, False]
+
+    # over-capacity corrections must be refused loudly, not truncated
+    with pytest.raises(ValueError):
+        BB.chain_verify_cached(
+            cache,
+            [([(0, [1, 2, 3, 4, 5], sig_for(0, [1], 0), coeff())], hs[:1], [0])],
+            interpret=True,
+            coeff_bits=32,
+        )

@@ -300,3 +300,81 @@ def test_get_head_memo_invalidates_on_mutation(chain):
         on_tick(store, store.genesis_time + spec.SECONDS_PER_SLOT, spec)
         root1 = on_block(store, signed1, spec=spec)
         assert get_head(store, spec) == root1
+
+
+def test_on_attestation_batch_cached_matches_host(chain, monkeypatch):
+    """The epoch-cache device drain (VERDICT r4 next #1: the node path
+    must run the machinery the bench measures) against the host path:
+    same verdicts, same weights, same latest messages — across full
+    participation, a missing-member correction, a forged signature, a
+    sparse aggregate (over the correction capacity -> host fallback
+    inside the cached drain) and a same-validator duplicate."""
+    import numpy as np
+
+    from lambda_ethereum_consensus_tpu.fork_choice import on_attestation_batch
+
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+
+        def make_att(store, root1, anchor_root, committee_index, participate,
+                     good=True):
+            committee = accessors.get_beacon_committee(
+                store.block_states[root1], 1, committee_index, spec
+            )
+            data = AttestationData(
+                slot=1,
+                index=committee_index,
+                beacon_block_root=root1,
+                source=store.justified_checkpoint,
+                target=Checkpoint(epoch=0, root=anchor_root),
+            )
+            domain = accessors.get_domain(
+                store.block_states[root1], constants.DOMAIN_BEACON_ATTESTER, 0, spec
+            )
+            signing_root = misc.compute_signing_root(data, domain)
+            bits = [p < participate for p in range(len(committee))]
+            signers = [v for p, v in enumerate(committee) if bits[p]]
+            if not good:
+                signers = [0] * len(signers)
+            sigs = [bls.sign(SKS[i], signing_root) for i in signers]
+            return Attestation(
+                aggregation_bits=bits, data=data, signature=bls.aggregate(sigs)
+            )
+
+        def scenario():
+            store, anchor_root = make_store(genesis, anchor_block, spec)
+            on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+            signed1, _ = build_block(genesis, spec, 1)
+            root1 = on_block(store, signed1, spec=spec)
+            k = len(
+                accessors.get_beacon_committee(store.block_states[root1], 1, 0, spec)
+            )
+            atts = [
+                make_att(store, root1, anchor_root, 0, k),          # full
+                make_att(store, root1, anchor_root, 0, k - 1),      # 1 missing
+                make_att(store, root1, anchor_root, 1, k, good=False),  # forged
+                make_att(store, root1, anchor_root, 1, 1),          # sparse
+                make_att(store, root1, anchor_root, 0, k),          # duplicate
+            ]
+            results = on_attestation_batch(store, atts, spec=spec)
+            head = get_head(store, spec)
+            assert store.head_cache.head() == head
+            return (
+                [r is None for r in results],
+                get_weight(store, root1, spec),
+                dict(store.latest_messages),
+                head,
+                store,
+            )
+
+        host = scenario()
+        assert not host[4].attestation_contexts  # host run stayed host
+        monkeypatch.setenv("BLS_DEVICE_CHAIN", "1")
+        monkeypatch.setenv("BLS_DEVICE_CHAIN_MIN", "1")
+        cached = scenario()
+        assert host[0] == cached[0] == [True, True, False, True, True]
+        assert host[1:4] == cached[1:4]
+        # the cached run actually exercised the device committee cache
+        # (sanity against silently routing everything to the fallback)
+        ctxs = list(cached[4].attestation_contexts.values())
+        assert ctxs and ctxs[0]._device_cache is not None
