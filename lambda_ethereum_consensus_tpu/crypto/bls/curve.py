@@ -314,6 +314,43 @@ def g1_from_bytes(data: bytes, subgroup_check: bool = True) -> AffinePoint:
     return pt
 
 
+def g1_from_bytes_batch(blobs, subgroup_check: bool = True) -> list:
+    """Batch :func:`g1_from_bytes` — C++ thread-pool decompression with the
+    endomorphism subgroup check when the native library is present (the
+    role blst's deserialization plays for the reference), Python per-point
+    fallback otherwise.  Per item: affine point | ``None`` (canonical
+    infinity) | ``False`` (invalid encoding/point/subgroup) — batch
+    callers need per-item verdicts, not a first-failure exception."""
+    from . import native
+
+    out = native.g1_decompress_batch(blobs, subgroup_check)
+    if out is not None:
+        return out
+    res = []
+    for b in blobs:
+        try:
+            res.append(g1_from_bytes(bytes(b), subgroup_check))
+        except DeserializationError:
+            res.append(False)
+    return res
+
+
+def g2_from_bytes_batch(blobs, subgroup_check: bool = True) -> list:
+    """Batch :func:`g2_from_bytes`; same conventions as the G1 batch."""
+    from . import native
+
+    out = native.g2_decompress_batch(blobs, subgroup_check)
+    if out is not None:
+        return out
+    res = []
+    for b in blobs:
+        try:
+            res.append(g2_from_bytes(bytes(b), subgroup_check))
+        except DeserializationError:
+            res.append(False)
+    return res
+
+
 def g2_from_bytes(data: bytes, subgroup_check: bool = True) -> AffinePoint:
     """Decompress a G2 point (twist coordinates).
 

@@ -78,6 +78,22 @@ def _load():
             ctypes.c_size_t,                      # n
             ctypes.c_char_p,                      # out: n bools
         ]
+        for name, insz, outsz in (
+            ("bls381_g1_decompress_batch", 48, 96),
+            ("bls381_g2_decompress_batch", 96, 192),
+        ):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.c_char_p,                  # in: n * insz compressed
+                ctypes.c_size_t,                  # n
+                ctypes.c_char_p,                  # out: n * outsz affine
+                ctypes.c_char_p,                  # ok flags (1/0/2)
+                ctypes.c_int,                     # subgroup_check
+                ctypes.c_int,                     # nthreads (0 = auto)
+            ]
+        lib.bls381_decompress_fast_paths.restype = ctypes.c_int
+        lib.bls381_decompress_fast_paths.argtypes = []
     except AttributeError:
         pass
     lib.bls381_init()
@@ -203,6 +219,57 @@ def final_exp_is_one(fq12s) -> list[bool] | None:
     out = ctypes.create_string_buffer(n)
     _LIB.bls381_final_exp_is_one(bytes(buf), n, out)
     return [b == 1 for b in out.raw]
+
+
+def decompress_available() -> bool:
+    return _LIB is not None and hasattr(_LIB, "bls381_g2_decompress_batch")
+
+
+def _decompress_batch(fn, insz: int, outsz: int, blobs, subgroup_check, from_buf):
+    n = len(blobs)
+    if n == 0:
+        return []
+    # per-item contract everywhere: a wrong-length blob is that ITEM's
+    # invalidity (False), matching the Python fallback — one bad item
+    # must not throw away the whole batch
+    raw = [bytes(b) for b in blobs]
+    keep = [i for i, b in enumerate(raw) if len(b) == insz]
+    res: list = [False] * n
+    if not keep:
+        return res
+    buf = b"".join(raw[i] for i in keep)
+    m = len(keep)
+    out = ctypes.create_string_buffer(outsz * m)
+    ok = ctypes.create_string_buffer(m)
+    fn(buf, m, out, ok, 1 if subgroup_check else 0, 0)
+    for j, i in enumerate(keep):
+        flag = ok.raw[j]
+        if flag == 1:
+            res[i] = from_buf(out.raw[j * outsz : (j + 1) * outsz])
+        elif flag == 2:
+            res[i] = None  # canonical infinity (g*_from_bytes semantics)
+    return res
+
+
+def g2_decompress_batch(blobs, subgroup_check: bool = True):
+    """Batch G2 decompression with the endomorphism subgroup check
+    (validated against mul-by-r at init).  Per item: affine ``((x0,x1),
+    (y0,y1))`` | ``None`` (infinity encoding) | ``False`` (invalid).
+    Returns None when the native library lacks the entry point."""
+    if not decompress_available():
+        return None
+    return _decompress_batch(
+        _LIB.bls381_g2_decompress_batch, 96, 192, blobs, subgroup_check, _g2_from
+    )
+
+
+def g1_decompress_batch(blobs, subgroup_check: bool = True):
+    """Batch G1 decompression (pubkeys); same conventions as G2."""
+    if not decompress_available():
+        return None
+    return _decompress_batch(
+        _LIB.bls381_g1_decompress_batch, 48, 96, blobs, subgroup_check, _g1_from
+    )
 
 
 def rlc_verify(entries, h_points, group_ids, coeff_bits: int = 128) -> bool:

@@ -49,13 +49,33 @@ __all__ = [
 _REGISTRY_PLANES: dict[bytes, dict] = {}
 
 
+def _registry_points(pubkeys: list[bytes]) -> list:
+    """Decompress registry pubkeys: dedupe call-locally (synthetic
+    registries cycle a few keys; each real index is decompressed exactly
+    once because the planes cache grows monotonically), then one native
+    thread-pool batch for the unique keys — the Python fallback walks
+    ``_pubkey_point``'s bounded LRU instead."""
+    from ..crypto.bls import native
+    from ..crypto.bls.api import _pubkey_point
+
+    unique = list(dict.fromkeys(pubkeys))
+    batch = native.g1_decompress_batch(unique)
+    if batch is None:
+        batch = [_pubkey_point(pk) for pk in unique]
+    points: dict[bytes, tuple] = {}
+    for pk, pt in zip(unique, batch):
+        if pt is None or pt is False:
+            raise SpecError("registry pubkey is invalid or the identity")
+        points[pk] = pt
+    return [points[pk] for pk in pubkeys]
+
+
 def registry_planes(state, spec: ChainSpec | None = None):
     """``(rx, ry)`` numpy planes for ``state``'s full validator registry.
 
-    Decompression goes through the per-pubkey LRU (``_pubkey_point``);
-    only indices beyond the cached count are packed on a call.
+    Only indices beyond the cached count are decompressed and packed on
+    a call (a validator's pubkey never changes once registered).
     """
-    from ..crypto.bls.api import _pubkey_point
     from ..ops.bls_batch import _g1_planes
 
     key = bytes(state.genesis_validators_root)
@@ -64,12 +84,12 @@ def registry_planes(state, spec: ChainSpec | None = None):
     if entry is None:
         entry = _REGISTRY_PLANES[key] = {"count": 0, "rx": None, "ry": None}
     if entry["count"] < n:
-        pts = []
-        for i in range(entry["count"], n):
-            pt = _pubkey_point(bytes(state.validators[i].pubkey))
-            if pt is None:
-                raise SpecError(f"registry validator {i} has identity pubkey")
-            pts.append(pt)
+        pts = _registry_points(
+            [
+                bytes(state.validators[i].pubkey)
+                for i in range(entry["count"], n)
+            ]
+        )
         tx, ty = _g1_planes(pts)
         if entry["rx"] is None:
             entry["rx"], entry["ry"] = tx, ty
@@ -89,12 +109,10 @@ class EpochAttestationContext:
         self.state = target_state
         ws = BeaconStateMut(target_state)
         active = np.asarray(ws.active_indices(self.epoch), np.int64)
-        self.committees_per_slot = max(
-            1,
-            min(
-                spec.MAX_COMMITTEES_PER_SLOT,
-                len(active) // spec.SLOTS_PER_EPOCH // spec.TARGET_COMMITTEE_SIZE,
-            ),
+        # the ONE spec formula (accessors.get_committee_count_per_slot);
+        # passing the mutable view keeps its active-set scan vectorized
+        self.committees_per_slot = accessors.get_committee_count_per_slot(
+            ws, self.epoch, spec
         )
         self.count = self.committees_per_slot * spec.SLOTS_PER_EPOCH
         self.start_slot = misc.compute_start_slot_at_epoch(self.epoch, spec)

@@ -371,12 +371,10 @@ def _attestation_batch_cached(
     from ..crypto.bls import BlsError
     from ..crypto.bls.api import _pubkey_point
     from ..crypto.bls.batch import batch_verify_each_cached, batch_verify_each_points
-    from ..crypto.bls.curve import DeserializationError, g1, g2_from_bytes
+    from ..crypto.bls.curve import DeserializationError, g1, g2_from_bytes_batch
     from .attestation import get_attestation_context
 
-    by_ctx: dict[int, list] = {}  # id(ctx) -> [(i, att, attesting, entry)]
-    ctxs: dict[int, object] = {}
-    host_entries = []  # (i, att, attesting, point-entry) — over-capacity
+    pending = []  # (i, att, ctx, cid, attesting, missing, sroot, target_state)
     for i, attestation in enumerate(attestations):
         try:
             validate_on_attestation(store, attestation, is_from_block, spec)
@@ -390,10 +388,33 @@ def _attestation_batch_cached(
             cid, attesting, missing = ctx.participation(attestation)
             if len(attesting) == 0:
                 raise ForkChoiceError("attestation has no participants", reject=True)
-            sig_pt = g2_from_bytes(bytes(attestation.signature))
+            signing_root = ctx.signing_root(attestation.data)
+            pending.append(
+                (i, attestation, ctx, cid, attesting, missing, signing_root,
+                 target_state)
+            )
+        except ForkChoiceError as e:
+            results[i] = e
+        except (BlsError, DeserializationError) as e:
+            results[i] = ForkChoiceError(str(e), reject=True)
+        except SpecError as e:
+            results[i] = ForkChoiceError(str(e))
+
+    # one thread-pooled decompression pass (C++ when available) — AFTER
+    # validation, so junk that fork choice rejects anyway never costs the
+    # ~10 ms/sig Python fallback (an event-loop DoS at gossip batch sizes)
+    sig_points = g2_from_bytes_batch([bytes(p[1].signature) for p in pending])
+
+    by_ctx: dict[int, list] = {}  # id(ctx) -> [(i, att, attesting, entry)]
+    ctxs: dict[int, object] = {}
+    host_entries = []  # (i, att, attesting, point-entry) — over-capacity
+    for (i, attestation, ctx, cid, attesting, missing, signing_root,
+         target_state), sig_pt in zip(pending, sig_points):
+        try:
+            if sig_pt is False:
+                raise ForkChoiceError("undecodable signature", reject=True)
             if sig_pt is None:
                 raise ForkChoiceError("infinity signature", reject=True)
-            signing_root = ctx.signing_root(attestation.data)
             cache = ctx.device_cache()
             if len(missing) <= cache.mmax:
                 entry = (cid, missing.tolist(), signing_root, sig_pt)
@@ -415,23 +436,26 @@ def _attestation_batch_cached(
             results[i] = e
         except (BlsError, DeserializationError) as e:
             results[i] = ForkChoiceError(str(e), reject=True)
-        except SpecError as e:
-            results[i] = ForkChoiceError(str(e))
 
-    # accepted votes bucketed per (ctx, target epoch+root, head root)
-    accepted: dict[tuple, list] = {}
+    accepted = []  # (batch index, ctx, attestation, attesting array)
 
     for ctx_id, group in by_ctx.items():
         ctx = ctxs[ctx_id]
-        flags = batch_verify_each_cached(
-            ctx.device_cache(),
-            [entry for _, _, _, entry in group],
-            message_points=ctx.message_points,
-        )
+        try:
+            flags = batch_verify_each_cached(
+                ctx.device_cache(),
+                [entry for _, _, _, entry in group],
+                message_points=ctx.message_points,
+            )
+        except SpecError as e:
+            # e.g. an invalid registry pubkey surfacing from the device
+            # cache build: fail THIS context's items, not the whole batch
+            for i, _, _, _ in group:
+                results[i] = ForkChoiceError(str(e))
+            continue
         for (i, attestation, attesting, _), ok in zip(group, flags):
             if ok:
-                key = (ctx_id, bytes(attestation.data.beacon_block_root))
-                accepted.setdefault(key, (ctx, attestation, []))[2].append(attesting)
+                accepted.append((i, ctx, attestation, attesting))
             else:
                 results[i] = ForkChoiceError(
                     "invalid attestation signature", reject=True
@@ -440,49 +464,64 @@ def _attestation_batch_cached(
         flags = batch_verify_each_points([e[4] for e in host_entries])
         for (i, attestation, ctx, attesting, _), ok in zip(host_entries, flags):
             if ok:
-                key = (id(ctx), bytes(attestation.data.beacon_block_root))
-                accepted.setdefault(key, (ctx, attestation, []))[2].append(attesting)
+                accepted.append((i, ctx, attestation, attesting))
             else:
                 results[i] = ForkChoiceError(
                     "invalid attestation signature", reject=True
                 )
 
-    for (_, head_root), (ctx, attestation, arrays) in accepted.items():
-        update_latest_messages_batch(
-            store, ctx, attestation, np.concatenate(arrays)
-        )
+    update_latest_messages_batch(store, accepted)
 
 
-def update_latest_messages_batch(store, ctx, attestation, attesting) -> None:
-    """Vectorized LMD vote application: one numpy filter decides which
-    validators actually move (latest epoch strictly older), one shared
-    ``LatestMessage`` feeds the dict, and the head cache takes the whole
-    move as a batch (``HeadCache.on_votes_batch``).  Semantics match
-    :func:`update_latest_messages` exactly — same strict-epoch rule, same
-    equivocation filter, weights from the target state's effective
-    balances."""
+def update_latest_messages_batch(store, accepted) -> None:
+    """Vectorized LMD vote application for a drain's accepted
+    attestations — ``accepted`` is ``[(batch_index, ctx, attestation,
+    attesting_array)]``.  Semantics match per-item
+    :func:`update_latest_messages` EXACTLY, including within-batch
+    ordering: a claim pass in batch-index order decides which attestation
+    a validator's same-epoch vote came from (first valid wins; a strictly
+    newer epoch later in the batch still overrides), then per-(epoch,
+    root) buckets apply epoch-ascending through one numpy filter, one
+    shared ``LatestMessage``, and ``HeadCache.on_votes_batch``."""
     import numpy as np
 
-    target = attestation.data.target
-    target_epoch = int(target.epoch)
-    beacon_block_root = bytes(attestation.data.beacon_block_root)
-    uniq = np.unique(np.asarray(attesting, np.int64))
-    if store.equivocating_indices:
-        uniq = uniq[
-            ~np.isin(uniq, np.fromiter(store.equivocating_indices, np.int64))
-        ]
-    epochs = store.vote_epoch_array(ctx.n_validators)
-    moved = uniq[epochs[uniq] < target_epoch]
-    if not len(moved):
+    if not accepted:
         return
-    epochs[moved] = target_epoch
-    lm = LatestMessage(epoch=target_epoch, root=beacon_block_root)
-    store.latest_messages.update(dict.fromkeys(moved.tolist(), lm))
-    if store.head_cache is not None:
-        store.head_cache.on_votes_batch(
-            moved, ctx.eff_balance[moved], beacon_block_root
-        )
-    store.bump()
+    n = max(ctx.n_validators for _, ctx, _, _ in accepted)
+    claim_epoch = np.full(n, -1, np.int64)  # within-batch claims only
+    buckets: dict[tuple[int, bytes], list] = {}
+    bucket_ctx: dict[tuple[int, bytes], object] = {}
+    for _, ctx, attestation, attesting in sorted(accepted, key=lambda t: t[0]):
+        epoch = int(attestation.data.target.epoch)
+        root = bytes(attestation.data.beacon_block_root)
+        attesting = np.asarray(attesting, np.int64)
+        newly = attesting[claim_epoch[attesting] < epoch]
+        if not len(newly):
+            continue
+        claim_epoch[newly] = epoch
+        buckets.setdefault((epoch, root), []).append(newly)
+        bucket_ctx[(epoch, root)] = ctx
+
+    updated = False
+    for (epoch, root) in sorted(buckets, key=lambda k: k[0]):
+        ctx = bucket_ctx[(epoch, root)]
+        uniq = np.unique(np.concatenate(buckets[(epoch, root)]))
+        if store.equivocating_indices:
+            uniq = uniq[
+                ~np.isin(uniq, np.fromiter(store.equivocating_indices, np.int64))
+            ]
+        epochs = store.vote_epoch_array(ctx.n_validators)
+        moved = uniq[epochs[uniq] < epoch]
+        if not len(moved):
+            continue
+        epochs[moved] = epoch
+        lm = LatestMessage(epoch=epoch, root=root)
+        store.latest_messages.update(dict.fromkeys(moved.tolist(), lm))
+        if store.head_cache is not None:
+            store.head_cache.on_votes_batch(moved, ctx.eff_balance[moved], root)
+        updated = True
+    if updated:
+        store.bump()
 
 
 # -------------------------------------------------------- attester slashing

@@ -243,10 +243,15 @@ class BeaconNode:
         )
         await sub.start()
         self._subs.append(sub)
+        # attestation channels take deep batches: the device drain's fixed
+        # dispatch cost amortizes across thousands of signatures, and one
+        # mainnet slot already carries ~1k aggregates
+        ATT_BATCH, ATT_QUEUE = 8192, 16384
         agg_topic = topic_name(digest, "beacon_aggregate_and_proof")
         agg = TopicSubscription(
             self.port, agg_topic, self._on_aggregate_batch,
             ssz_type=SignedAggregateAndProof, spec=self.spec,
+            max_batch=ATT_BATCH, max_queue=ATT_QUEUE,
         )
         await agg.start()
         self._subs.append(agg)
@@ -262,6 +267,7 @@ class BeaconNode:
                 self.port, sub_topic,
                 functools.partial(self._on_attestation_batch, i),
                 ssz_type=Attestation, spec=self.spec,
+                max_batch=ATT_BATCH, max_queue=ATT_QUEUE,
             )
             await att_sub.start()
             self._subs.append(att_sub)

@@ -212,3 +212,78 @@ class TestNativeRlcVerify:
         entries[2] = (pk, b"rlc-other", sig)
         packed, h_points, gids = _pack_check(entries, DST_POP, {})
         assert native.rlc_verify(packed, h_points, gids) is False
+
+
+@pytest.mark.skipif(
+    not native.decompress_available(), reason="decompress entry points absent"
+)
+class TestDecompressBatch:
+    """Native point decompression vs the Python decoders — including the
+    endomorphism subgroup checks, which init() self-validates against the
+    multiply-by-r oracle (a wrong eigenvalue constant falls back to
+    mul-by-r rather than admitting non-members)."""
+
+    def test_fast_paths_validated(self):
+        # 2 = G2 psi-check live, 1 = G1 phi-check live
+        assert native._LIB.bls381_decompress_fast_paths() == 3
+
+    def test_g2_roundtrip_and_negatives(self):
+        pts = [C.g2.multiply_raw(C.G2_GENERATOR, 5 + 7 * i) for i in range(8)]
+        blobs = [C.g2_to_bytes(p) for p in pts]
+        corrupt = bytearray(blobs[0])
+        corrupt[7] ^= 0xFF
+        infinity = bytes([0xC0]) + b"\x00" * 95
+        inf_with_sign = bytes([0xE0]) + b"\x00" * 95
+        cases = blobs + [bytes(corrupt), infinity, inf_with_sign]
+        out = native.g2_decompress_batch(cases)
+        for got, want in zip(out[:8], pts):
+            assert got == want
+        for blob, got in zip(cases, out):
+            try:
+                want = C.g2_from_bytes(blob)
+            except C.DeserializationError:
+                want = False
+            assert got == want  # exact decoder parity, incl. the negatives
+
+    def test_g2_non_subgroup_rejected(self):
+        # a curve point OFF the subgroup: x from a fixed non-member search
+        # (mirrors the decoder's own subgroup rejection)
+        rng = random.Random(99)
+        for _ in range(50):
+            x = (rng.randrange(C.P), rng.randrange(C.P))
+            y2 = F.fq2_add(F.fq2_mul(F.fq2_sq(x), x), (4, 4))
+            y = F.fq2_sqrt(y2)
+            if y is None:
+                continue
+            from lambda_ethereum_consensus_tpu.crypto.bls.curve import (
+                _fq2_is_larger,
+            )
+
+            raw = bytearray(x[1].to_bytes(48, "big") + x[0].to_bytes(48, "big"))
+            raw[0] |= 0x80 | (0x20 if _fq2_is_larger(y) else 0)
+            (got,) = native.g2_decompress_batch([bytes(raw)])
+            try:
+                C.g2_from_bytes(bytes(raw))
+                want = True
+            except C.DeserializationError:
+                want = False
+            assert (got is not False) == want
+            if not want:
+                return  # found and agreed on a non-member
+        pytest.skip("no twist point found in 50 draws (improbable)")
+
+    def test_g1_roundtrip_and_subgroup(self):
+        pts = [C.g1.multiply_raw(C.G1_GENERATOR, 11 + i) for i in range(8)]
+        blobs = [C.g1_to_bytes(p) for p in pts]
+        out = native.g1_decompress_batch(blobs + [bytes([0xC0]) + b"\x00" * 47])
+        assert out[:8] == pts and out[8] is None
+        # batch API parity through the curve-level wrapper
+        from lambda_ethereum_consensus_tpu.crypto.bls.curve import (
+            g1_from_bytes_batch,
+            g2_from_bytes_batch,
+        )
+
+        assert g1_from_bytes_batch(blobs) == pts
+        assert g2_from_bytes_batch([C.g2_to_bytes(C.G2_GENERATOR)]) == [
+            C.G2_GENERATOR
+        ]
