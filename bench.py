@@ -151,6 +151,7 @@ def _bench_mainnet_root(budget_s: float = 900.0) -> list[dict]:
     renames = {
         "beacon_state_hash_tree_root_warm": "mainnet_state_root_warm_s",
         "beacon_state_root_incremental_slot": "mainnet_state_root_incremental_slot_s",
+        "epoch_boundary_root": "epoch_boundary_root_s",
         "capella_replay_blocks_per_sec": "capella_replay_blocks_per_sec",
     }
     recs = []
@@ -176,6 +177,45 @@ def _bench_mainnet_root(budget_s: float = 900.0) -> list[dict]:
     # all-absent means the subprocess never got going; let the caller's
     # single-fallback path report that
     return [] if not got else recs
+
+
+def _bench_script(name: str, metrics: tuple[str, ...], budget_s: float, argv_extra=()) -> list[dict]:
+    """Subprocess-guarded runner for the round-5 bench scripts (ingest,
+    boot): same honest-absence contract as the BLS/mainnet guards."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache"))
+    argv = [sys.executable, os.path.join(here, "scripts", name), *argv_extra]
+    fail_note = None
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=budget_s, env=env, cwd=here
+        )
+        stdout = out.stdout or ""
+        if out.returncode != 0:
+            tail = (out.stderr or "").strip().splitlines()[-3:]
+            fail_note = "crashed: " + " | ".join(tail)
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout or ""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        fail_note = f"exceeded its {budget_s:.0f}s budget"
+    recs = []
+    for line in stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and rec.get("metric") in metrics:
+            recs.append(rec)
+    got = {r["metric"] for r in recs}
+    for m in metrics:
+        if m not in got:
+            recs.append({
+                "metric": m, "value": None,
+                "note": f"{name}: {fail_note or 'produced no such line'}",
+            })
+    return recs
 
 
 def main() -> None:
@@ -205,6 +245,20 @@ def main() -> None:
                 "note": "mainnet bench produced no warm-root line within budget",
             }]
         for rec in mainnet_recs:
+            print(json.dumps(rec), flush=True)
+
+    if not os.environ.get("BENCH_NO_INGEST"):
+        # node-path throughput (VERDICT r4 next #1) + boot timeline (#6)
+        for rec in _bench_script(
+            "bench_ingest.py",
+            ("node_ingest_aggregate_verifications_per_sec",),
+            float(os.environ.get("BENCH_INGEST_BUDGET_S", "5400")),
+        ):
+            print(json.dumps(rec), flush=True)
+        for rec in _bench_script(
+            "bench_boot.py", ("node_first_verify_s",),
+            float(os.environ.get("BENCH_BOOT_BUDGET_S", "1200")),
+        ):
             print(json.dumps(rec), flush=True)
 
     bls_recs, err = _bench_bls()

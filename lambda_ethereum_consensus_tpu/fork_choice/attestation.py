@@ -36,6 +36,7 @@ from ..state_transition.mutable import BeaconStateMut
 __all__ = [
     "EpochAttestationContext",
     "get_attestation_context",
+    "get_state_attestation_context",
     "registry_planes",
 ]
 
@@ -205,6 +206,37 @@ class EpochAttestationContext:
 
 
 # ------------------------------------------------------------ context cache
+
+_STATE_CTX: dict = {}
+
+
+def get_state_attestation_context(
+    state, epoch: int, spec: ChainSpec | None = None
+) -> EpochAttestationContext:
+    """Context for block-attestation verification inside the state
+    transition (no fork-choice store involved), keyed by what actually
+    determines the epoch's committees: chain + epoch + shuffling seed +
+    registry length.  Within an epoch the active set at that epoch is
+    stable for a given length (exits/activations take effect at later
+    epochs; mid-epoch deposits only append inactive validators), so
+    replaying a segment reuses one context per epoch."""
+    spec = spec or get_chain_spec()
+    seed = accessors.get_seed(
+        state, int(epoch), constants.DOMAIN_BEACON_ATTESTER, spec
+    )
+    key = (
+        bytes(state.genesis_validators_root),
+        int(epoch),
+        seed,
+        len(state.validators),
+    )
+    ctx = _STATE_CTX.get(key)
+    if ctx is None:
+        if len(_STATE_CTX) > 6:
+            _STATE_CTX.clear()
+        ctx = _STATE_CTX[key] = EpochAttestationContext(state, int(epoch), spec)
+    return ctx
+
 
 def get_attestation_context(
     store, target, target_state, spec: ChainSpec | None = None

@@ -12,8 +12,11 @@ message, gating sidecar forwarding.
 from __future__ import annotations
 
 import asyncio
+import logging
 from dataclasses import dataclass
 from typing import Awaitable, Callable
+
+log = logging.getLogger("gossip")
 
 from ..compression.snappy import decompress as snappy_decompress
 from ..config import ChainSpec, get_chain_spec
@@ -105,8 +108,11 @@ class TopicSubscription:
             except asyncio.CancelledError:
                 raise
             except Exception:
-                # a failed batch (port hiccup, handler bug) must not kill the
-                # topic — messages in it are simply never validated/forwarded
+                # a failed batch (port hiccup, handler bug) must not kill
+                # the topic — messages in it are simply never validated/
+                # forwarded — but it must be VISIBLE: a silently swallowed
+                # handler bug looks like a hung pipeline from outside
+                log.exception("gossip batch failed on %s", self.topic)
                 continue
 
     async def _process_batch(self, raw_batch) -> None:

@@ -36,6 +36,7 @@ from ..proto import gossipsub_pb2 as pb
 from . import varint
 from .host import Libp2pError, Libp2pHost
 from .identity import PeerId
+from .mplex import MplexError
 
 MESHSUB_PROTOCOL = "/meshsub/1.1.0"
 MAX_RPC = 10 * (1 << 20)  # the reference's 10 MB message cap
@@ -97,7 +98,11 @@ async def _read_rpc(stream) -> pb.RPC:
         raise Libp2pError(str(e)) from None
     if length > MAX_RPC:
         raise Libp2pError(f"oversized rpc ({length})")
-    return pb.RPC.FromString(await stream.readexactly(length))
+    raw = await stream.readexactly(length)
+    try:
+        return pb.RPC.FromString(raw)
+    except Exception as e:  # protobuf DecodeError etc: peer spoke garbage
+        raise Libp2pError(f"undecodable rpc: {e}") from None
 
 
 class _PeerState:
@@ -217,7 +222,12 @@ class Gossipsub:
             while True:
                 rpc = await _read_rpc(stream)
                 await self._handle_rpc(state, rpc)
-        except (asyncio.IncompleteReadError, Libp2pError, ConnectionError):
+        except (
+            asyncio.IncompleteReadError,
+            Libp2pError,
+            ConnectionError,
+            MplexError,  # stream reset mid-RPC (yamux errors subclass it)
+        ):
             pass
         finally:
             self._drop_peer(peer_id)

@@ -60,6 +60,10 @@ class NodeConfig:
     # advertised as ENR attnets; ref: gossipsub.ex:16-34 scaffolds the
     # 64-subnet set, discovery.go:48-77 writes the bitfield)
     attnet_subnets: tuple[int, ...] = (0, 1)
+    # warm the device drain programs for these shapes on a background
+    # thread at startup (node/warmup.py) — overlaps the ~tens of seconds
+    # of first-dispatch program loading with anchor load + sidecar boot
+    warm_drain_shapes: object | None = None
 
 
 class BeaconNode:
@@ -151,6 +155,14 @@ class BeaconNode:
             self._prev_hash_backend = get_hash_backend()
             self.device_backend = install_device_backend()
             log.info("device paths ON: SSZ hashing + BLS routed to the TPU")
+            if self.config.warm_drain_shapes is not None:
+                from .warmup import start_warmer
+
+                self.warmer_stats: dict = {}
+                self._warmer = start_warmer(
+                    self.config.warm_drain_shapes, self.warmer_stats
+                )
+                log.info("drain-program warmer started")
 
     async def _select_anchor(self) -> tuple[BeaconState, BeaconBlock, bytes | None]:
         """DB resume | checkpoint sync | provided genesis

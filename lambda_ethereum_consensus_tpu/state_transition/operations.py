@@ -175,8 +175,21 @@ def process_attester_slashing(
 # ---------------------------------------------------------- attestations
 
 def process_attestation(
-    state: BeaconStateMut, attestation, spec: ChainSpec | None = None
+    state: BeaconStateMut, attestation, spec: ChainSpec | None = None,
+    defer_signatures: list | None = None,
 ) -> None:
+    """One block attestation: structural checks + participation/reward
+    accounting + signature check.
+
+    ``defer_signatures`` (a list) switches the signature check to
+    COLLECTION: the ``(attestation, indexed)`` pair is appended and
+    verified later by :func:`_verify_deferred_attestations` as one RLC
+    batch — the reference pays blst per attestation
+    (state_transition/predicates.ex:109-136); a TPU block wants ONE
+    drain for all ~64-128 of them.  Spec-equivalent because a failed
+    signature anywhere makes the whole block invalid and the transition's
+    working state is discarded wholesale.
+    """
     spec = spec or get_chain_spec()
     data = attestation.data
     current_epoch = accessors.get_current_epoch(state, spec)
@@ -210,10 +223,20 @@ def process_attestation(
         raise OperationError(str(e)) from None
 
     indexed = accessors.get_indexed_attestation(state, attestation, spec)
-    expect(
-        predicates.is_valid_indexed_attestation(state, indexed, spec),
-        "invalid attestation signature",
-    )
+    if defer_signatures is not None:
+        # structural validity of the index set still checks NOW (sorted,
+        # unique, in-range — OperationError on failure); only the pairing
+        # work defers.  The inputs ride along so verification never
+        # recomputes the pubkey extraction / signing root.
+        pubkeys, signing_root = predicates.indexed_attestation_signature_inputs(
+            state, indexed, spec
+        )
+        defer_signatures.append((attestation, indexed, pubkeys, signing_root))
+    else:
+        expect(
+            predicates.is_valid_indexed_attestation(state, indexed, spec),
+            "invalid attestation signature",
+        )
 
     which = "current" if data.target.epoch == current_epoch else "previous"
     participation = getattr(state, f"{which}_epoch_participation")
@@ -239,6 +262,74 @@ def process_attestation(
     increase_balance(
         state, accessors.get_beacon_proposer_index(state, spec), proposer_reward
     )
+
+
+def _verify_deferred_attestations(state, deferred, spec) -> bool:
+    """All of a block's attestation signatures as ONE batched check.
+
+    Signatures decompress in one native thread-pool pass; on device-
+    enabled hosts with enough total committee membership the aggregate
+    pubkeys come from the epoch committee cache (full sum minus missing,
+    on device — the same machinery the gossip drain runs), otherwise a
+    single host RLC check replaces the per-attestation pairings.
+    """
+    import os
+
+    from ..crypto.bls.api import _pubkey_point
+    from ..crypto.bls.batch import batch_verify_each_cached, verify_points
+    from ..crypto.bls.curve import g1, g2_from_bytes_batch
+    from ..utils.env import device_default, env_flag
+
+    sigs = g2_from_bytes_batch([bytes(ind.signature) for _, ind, _, _ in deferred])
+    if any(s is False or s is None for s in sigs):
+        return False
+
+    total_members = sum(len(ind.attesting_indices) for _, ind, _, _ in deferred)
+    min_members = int(os.environ.get("BLS_BLOCK_BATCH_MIN_MEMBERS", "4096"))
+    use_cached = total_members >= min_members and (
+        env_flag("BLS_DEVICE_CHAIN") or device_default()
+    )
+    if use_cached:
+        from ..fork_choice.attestation import get_state_attestation_context
+
+        frozen = state.freeze()
+        by_ctx: dict[int, tuple] = {}
+        host_entries = []
+        for (att, ind, _pubkeys, signing_root), sig in zip(deferred, sigs):
+            ctx = get_state_attestation_context(
+                frozen, int(att.data.target.epoch), spec
+            )
+            cid, attesting, missing = ctx.participation(att)
+            if len(missing) <= ctx.device_cache().mmax:
+                by_ctx.setdefault(id(ctx), (ctx, []))[1].append(
+                    (cid, missing.tolist(), signing_root, sig)
+                )
+            else:
+                agg = None
+                for v in attesting:
+                    pt = _pubkey_point(bytes(frozen.validators[v].pubkey))
+                    if pt is None:
+                        return False
+                    agg = pt if agg is None else g1.affine_add(agg, pt)
+                host_entries.append((agg, signing_root, sig))
+        for ctx, entries in by_ctx.values():
+            flags = batch_verify_each_cached(
+                ctx.device_cache(), entries, message_points=ctx.message_points
+            )
+            if not all(flags):
+                return False
+        return not host_entries or verify_points(host_entries)
+
+    entries = []
+    for (att, ind, pubkeys, signing_root), sig in zip(deferred, sigs):
+        agg = None
+        for pk in pubkeys:
+            pt = _pubkey_point(pk)
+            if pt is None:
+                return False
+            agg = pt if agg is None else g1.affine_add(agg, pt)
+        entries.append((agg, signing_root, sig))
+    return verify_points(entries)
 
 
 # --------------------------------------------------------------- deposits
@@ -571,8 +662,14 @@ def process_operations(
         process_proposer_slashing(state, op, spec)
     for op in body.attester_slashings:
         process_attester_slashing(state, op, spec)
+    deferred: list = []
     for op in body.attestations:
-        process_attestation(state, op, spec)
+        process_attestation(state, op, spec, defer_signatures=deferred)
+    if deferred:
+        expect(
+            _verify_deferred_attestations(state, deferred, spec),
+            "invalid attestation signature",
+        )
     for op in body.deposits:
         process_deposit(state, op, spec)
     for op in body.voluntary_exits:

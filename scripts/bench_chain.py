@@ -224,15 +224,22 @@ def run(
     def hash_msgs(msgs):
         return hash_to_g2_many(msgs, DST_POP)
 
-    def dispatch(comm_ids, miss_idx, miss_inf, h_points, sigs, live_checks=None):
+    def dispatch(comm_ids, miss_idx, miss_inf, h_points, sigs, live_checks=None,
+                 fence=None):
         """Enqueue one drain's full device chain; returns the ok array
         (not yet pulled).  live_checks optionally marks whole checks dead
-        (the on-chip 'empty drain' semantics)."""
+        (the on-chip 'empty drain' semantics).  ``fence(name, thunk)``
+        optionally wraps each device stage — the stage-breakdown mode
+        passes a blocking timer so the SAME program chain is measured,
+        not a parallel copy of it."""
+        run = fence if fence is not None else (lambda name, thunk: thunk())
         pad = b - a_total
         cid = np.concatenate([comm_ids, np.zeros(pad, np.int32)])
         mi = np.concatenate([miss_idx, np.zeros((pad, mmax), np.int32)])
         mf = np.concatenate([miss_inf, np.ones((pad, mmax), bool)])
-        agg_x, agg_y, _agg_inf = cache.aggregate(cid, mi, mf)  # (32, b)
+        agg_x, agg_y, _agg_inf = run(
+            "agg_corrected", lambda: cache.aggregate(cid, mi, mf)
+        )  # (32, b)
 
         coeffs = [secrets.randbits(_COEFF_BITS) | 1 for _ in range(a_total)]
         sgx, sgy = BB._g2_planes(sigs + [C.G2_GENERATOR] * pad)
@@ -240,9 +247,18 @@ def run(
         live = np.zeros(b, bool)
         live[:a_total] = True
 
-        jac1 = ops["ladder_g1"](agg_x, agg_y, jnp.asarray(kbits), jnp.asarray(live))
-        jac2 = ops["ladder_g2"](
-            jnp.asarray(sgx), jnp.asarray(sgy), jnp.asarray(kbits), jnp.asarray(live)
+        jac1 = run(
+            "ladder_g1",
+            lambda: ops["ladder_g1"](
+                agg_x, agg_y, jnp.asarray(kbits), jnp.asarray(live)
+            ),
+        )
+        jac2 = run(
+            "ladder_g2",
+            lambda: ops["ladder_g2"](
+                jnp.asarray(sgx), jnp.asarray(sgy), jnp.asarray(kbits),
+                jnp.asarray(live),
+            ),
         )
 
         dead = a_total  # a padded lane; its live flag is False -> inf
@@ -266,17 +282,20 @@ def run(
                 for g in range(m1)
             ]
         )
-        px, py, qx, qy, mask = ops["prep"](
-            jac1,
-            jac2,
-            jnp.asarray(idx_g1),
-            jnp.asarray(idx_sig),
-            jnp.asarray(hx.reshape(32, 2, inst, m1)),
-            jnp.asarray(hy.reshape(32, 2, inst, m1)),
-            jnp.asarray(static_live),
+        px, py, qx, qy, mask = run(
+            "prep_gather_reduce_norm",
+            lambda: ops["prep"](
+                jac1,
+                jac2,
+                jnp.asarray(idx_g1),
+                jnp.asarray(idx_sig),
+                jnp.asarray(hx.reshape(32, 2, inst, m1)),
+                jnp.asarray(hy.reshape(32, 2, inst, m1)),
+                jnp.asarray(static_live),
+            ),
         )
-        f = ops["miller"](px, py, qx, qy)
-        return ops["check_tail"](f, mask)
+        f = run("miller", lambda: ops["miller"](px, py, qx, qy))
+        return run("final_exp_tail", lambda: ops["check_tail"](f, mask))
 
     # ---- warm-up drain (compiles or AOT-loads everything; not timed) ---
     note("building warm-up drain")
@@ -327,6 +346,30 @@ def run(
         "backend": "tpu" if not interpret else "interpret",
     }
     assert smoke["invalid_detected"], "on-chip smoke: corrupted sig not rejected"
+
+    # ---- optional stage breakdown (VERDICT r4 next #2: name the wall) --
+    # one drain with a block_until_ready fence after every stage; the
+    # fences serialize the pipeline, so this is measured OUTSIDE the
+    # throughput loop and only when asked for
+    stage_ms: dict[str, float] = {}
+    if os.environ.get("BENCH_STAGES"):
+        import jax as _jax
+
+        d = make_drain(99)
+        h_stage = hash_msgs(d[3])
+
+        def fence(name, thunk):
+            t = time.perf_counter()
+            out = thunk()
+            _jax.block_until_ready(out)
+            stage_ms[name] = round((time.perf_counter() - t) * 1e3, 1)
+            return out
+
+        t_all = time.perf_counter()
+        ok_stage = dispatch(d[0], d[1], d[2], h_stage, d[4], fence=fence)
+        stage_ms["total_fenced"] = round((time.perf_counter() - t_all) * 1e3, 1)
+        assert all(np.asarray(ok_stage))
+        note(f"stage breakdown (fenced): {stage_ms}")
 
     # ---- steady state: device drain i overlaps host hashing of i+1 -----
     note("building steady-state drains")
@@ -382,6 +425,7 @@ def run(
             {"warmup_error": warm_stats["error"]} if "error" in warm_stats else {}
         ),
         "setup_hash_ms": round(hash_time * 1e3, 1),
+        **({"stage_ms": stage_ms} if stage_ms else {}),
         "aot": aot_stats(),
         "backend": jax.default_backend(),
         "vs_baseline": round(rate / 50000.0, 4),
