@@ -27,7 +27,8 @@ def device_default() -> bool:
     A node started on TPU hardware dispatches its hot paths to the chip
     with no configuration — the TPU is the engine, not a sidecar.
 
-    Memoized, and CPU-pinned processes (``JAX_PLATFORMS`` without tpu)
+    Memoized, and CPU-pinned processes (``JAX_PLATFORMS`` naming neither
+    a tpu nor the axon tunnel plugin, whose backend reports "tpu")
     short-circuit without ever importing jax — a pure-host node must not
     pay XLA backend init inside its verification path.
     """
@@ -36,7 +37,10 @@ def device_default() -> bool:
         return False
     if _DEVICE_DEFAULT is None:
         platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-        if platforms and "tpu" not in platforms:
+        # "axon" is the tunneled-TPU plugin: its backend REPORTS "tpu",
+        # so it must not short-circuit to the host path (that silently
+        # routed every node on tunneled hardware to Python crypto)
+        if platforms and "tpu" not in platforms and "axon" not in platforms:
             _DEVICE_DEFAULT = False
         else:
             import jax

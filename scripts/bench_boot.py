@@ -20,10 +20,18 @@ the first drain needs).  Usage: python scripts/bench_boot.py [--tiny]
 from __future__ import annotations
 
 import asyncio
+import faulthandler
 import json
 import os
+import signal
 import sys
 import time
+
+faulthandler.register(signal.SIGUSR2, all_threads=True)
+
+
+def note(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -86,7 +94,13 @@ def main() -> None:
         base_pts = [C.g1.multiply_raw(C.G1_GENERATOR, sk) for sk in base_sks]
         pubkeys = [C.g1_to_bytes(base_pts[i % 64]) for i in range(n_vals)]
         reg_sks = np.array([base_sks[i % 64] for i in range(n_vals)], np.int64)
-        genesis = build_genesis_state(pubkeys, spec=spec)
+        note("genesis building")
+        # recent genesis: the store's first on_tick walks slot by slot
+        # (spec-literal), so an epoch-0-era genesis_time would iterate
+        # millions of slots inside node.start()
+        gt = int(time.time()) - (slots + 1) * spec.SECONDS_PER_SLOT
+        genesis = build_genesis_state(pubkeys, genesis_time=gt, spec=spec)
+        note("genesis built")
 
         node = BeaconNode(
             NodeConfig(
@@ -100,11 +114,16 @@ def main() -> None:
         )
 
         async def run():
+            note("starting node")
             await node.start()
+            note("node started")
             node_up_s = time.perf_counter() - T0
             # clock into epoch 1 so epoch-0 attestations are timely
             from lambda_ethereum_consensus_tpu.fork_choice import get_head, on_tick
 
+            # clock anchored to GENESIS (epoch 1, slot 1): wall time would
+            # drift past the timeliness window on a cold-compile boot and
+            # quietly reject every epoch-0 aggregate
             on_tick(
                 node.store,
                 node.store.genesis_time + (slots + 1) * spec.SECONDS_PER_SLOT,
@@ -143,10 +162,13 @@ def main() -> None:
                         )
                     )
                 )
+            note("first drain dispatching")
             verdicts = node._attestation_drain(
                 batch, lambda m: m.value, "aggregate_and_proof"
             )
+            note("first drain done")
             ok = sum(1 for v in verdicts if v == 0)
+            assert ok == len(batch), f"only {ok}/{len(batch)} verified"
             first_verify_s = time.perf_counter() - T0
             await node.stop()
             return node_up_s, first_verify_s, ok

@@ -32,10 +32,16 @@ Usage: python scripts/bench_ingest.py [n_committees] [aggs] [committee]
 from __future__ import annotations
 
 import asyncio
+import faulthandler
 import json
 import os
+import signal
 import sys
 import time
+
+# SIGUSR2 -> all-thread stack dump on stderr (diagnosing a silent stall
+# must not require killing a run that took an hour of compiles to warm)
+faulthandler.register(signal.SIGUSR2, all_threads=True)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
