@@ -15,10 +15,14 @@ Prints one JSON line per measurement.
 
 from __future__ import annotations
 
+import faulthandler
 import json
 import os
+import signal
 import sys
 import time
+
+faulthandler.register(signal.SIGUSR2, all_threads=True)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
