@@ -11,13 +11,24 @@ Prints one JSON line per metric; the LAST line is the headline:
    star (scenario 3: attestations x 2048-validator committees through
    the chained device verify; scripts/bench_chain.py).
 
-The BLS bench runs in a guarded subprocess: compiles and measurement
-happen in ONE process, and every compiled program is AOT-serialized to
+EVERY stage runs in a guarded subprocess under one shared contract
+(round-5 advisor: an unguarded in-process device dispatch on a dead TPU
+tunnel hung the whole run at rc=124 with zero evidence):
+
+- a per-stage wall-clock budget (env-overridable), trimmed so the stage
+  SUM fits one bench run's ~2 h budget: SSZ 600 + mainnet 1500 + ingest
+  1800 + boot 600 + registry-planes 300 + BLS 2x1200 = 7200 s worst case;
+- honest absence — a stage that times out/crashes still emits its metric
+  lines with ``value: null`` and a note, so "broke" is distinguishable
+  from "skipped";
+- a crash tail — the last stderr lines land in the note.
+
+The BLS stage additionally retries: compiles and measurement happen in
+ONE process, and every compiled program is AOT-serialized to
 ``.aot_cache`` (ops/aot.py) as it lands — so a timed-out cold attempt
 still makes progress, the retry resumes from the saved executables, and
 any later run (this driver, the next round) starts warm in seconds.  On
-total failure the metric records honest absence and the SSZ line stays
-the headline.
+total failure the SSZ line stays the headline.
 """
 
 from __future__ import annotations
@@ -111,7 +122,7 @@ def _bench_bls() -> tuple[list[dict], str | None]:
     its compiled programs to .aot_cache, so the retry resumes from them
     instead of starting over (the round-2 failure mode was one attempt
     with no resume)."""
-    budget = float(os.environ.get("BENCH_BLS_BUDGET_S", "1500"))
+    budget = float(os.environ.get("BENCH_BLS_BUDGET_S", "1200"))
     attempts = int(os.environ.get("BENCH_BLS_ATTEMPTS", "2"))
     notes = []
     recs: list[dict] = []
@@ -125,63 +136,44 @@ def _bench_bls() -> tuple[list[dict], str | None]:
     return recs, "; ".join(notes) or "disabled (BENCH_BLS_ATTEMPTS=0)"
 
 
-def _bench_mainnet_root(budget_s: float = 2400.0) -> list[dict]:
+def _bench_mainnet_root(budget_s: float | None = None) -> list[dict]:
     """Full + incremental 1M-validator BeaconState roots through the SSZ
     engine + device hash backend (VERDICT r2 #6: the product path, not
-    the raw kernel; r3 next #2: the incremental per-slot root).
-    Subprocess-guarded like the BLS bench; empty list on failure."""
-    here = os.path.dirname(os.path.abspath(__file__))
-    argv = [
-        sys.executable,
-        os.path.join(here, "scripts", "bench_mainnet.py"),
-        "1000000",
-        "--device",
-    ]
-    try:
-        out = subprocess.run(
-            argv, capture_output=True, text=True, timeout=budget_s, cwd=here
-        )
-        stdout = out.stdout or ""
-    except subprocess.TimeoutExpired as e:
-        # the root lines print BEFORE the epoch/head tail stages —
-        # a timeout (or a later-stage failure) must not discard them
-        stdout = e.stdout or ""
-        if isinstance(stdout, bytes):
-            stdout = stdout.decode(errors="replace")
+    the raw kernel; r3 next #2: the incremental per-slot root).  Routed
+    through the shared stage guard (budget / honest absence / crash
+    tail) — this was the last stage that swallowed its crash tail."""
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_MAINNET_BUDGET_S", "1500"))
     renames = {
         "beacon_state_hash_tree_root_warm": "mainnet_state_root_warm_s",
         "beacon_state_root_incremental_slot": "mainnet_state_root_incremental_slot_s",
         "epoch_boundary_root": "epoch_boundary_root_s",
         "capella_replay_blocks_per_sec": "capella_replay_blocks_per_sec",
     }
-    recs = []
-    for line in stdout.splitlines():
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        new_name = renames.get(rec.get("metric"))
-        if new_name:
-            rec["metric"] = new_name
+    units = {m: "s" for m in renames}
+    units["capella_replay_blocks_per_sec"] = "blocks/s"
+    recs = _bench_script(
+        "bench_mainnet.py", tuple(renames), budget_s,
+        argv_extra=("1000000", "--device"), units=units,
+    )
+    for rec in recs:
+        rec["metric"] = renames.get(rec["metric"], rec["metric"])
+        if rec.get("value") is not None:
             rec["vs_baseline"] = rec.pop("slot_budget_frac", None)
-            recs.append(rec)
-    # per-metric honest absence: a timeout after the first line must not
-    # silently drop the second metric
-    got = {r["metric"] for r in recs}
-    for name in renames.values():
-        if name not in got:
-            recs.append({
-                "metric": name, "value": None, "unit": "s",
-                "note": "mainnet bench produced no such line within budget",
-            })
-    # all-absent means the subprocess never got going; let the caller's
-    # single-fallback path report that
-    return [] if not got else recs
+    return recs
 
 
-def _bench_script(name: str, metrics: tuple[str, ...], budget_s: float, argv_extra=()) -> list[dict]:
-    """Subprocess-guarded runner for the round-5 bench scripts (ingest,
-    boot): same honest-absence contract as the BLS/mainnet guards."""
+def _bench_script(
+    name: str,
+    metrics: tuple[str, ...],
+    budget_s: float,
+    argv_extra=(),
+    units: dict | None = None,
+) -> list[dict]:
+    """The shared stage guard: run a bench script in a subprocess under a
+    wall-clock budget, keep only its metric lines, and emit per-metric
+    honest-absence records (with the metric's ``unit`` from ``units`` and
+    the crash tail in the note) for anything it failed to produce."""
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache"))
@@ -211,10 +203,13 @@ def _bench_script(name: str, metrics: tuple[str, ...], budget_s: float, argv_ext
     got = {r["metric"] for r in recs}
     for m in metrics:
         if m not in got:
-            recs.append({
+            rec = {
                 "metric": m, "value": None,
                 "note": f"{name}: {fail_note or 'produced no such line'}",
-            })
+            }
+            if units and m in units:
+                rec["unit"] = units[m]
+            recs.append(rec)
     return recs
 
 
@@ -223,7 +218,7 @@ def _ssz_line_guarded(budget_s: float | None = None) -> dict:
     must produce an honest-absence record, not hang the whole bench run
     at its first in-process dispatch."""
     if budget_s is None:
-        budget_s = float(os.environ.get("BENCH_SSZ_BUDGET_S", "900"))
+        budget_s = float(os.environ.get("BENCH_SSZ_BUDGET_S", "600"))
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache"))
@@ -274,17 +269,7 @@ def main() -> None:
     ssz_line = _ssz_line_guarded()
 
     if not os.environ.get("BENCH_NO_MAINNET"):
-        mainnet_recs = _bench_mainnet_root()
-        if not mainnet_recs:
-            # honest absence, like the BLS guard: "broke" must be
-            # distinguishable from "skipped"
-            mainnet_recs = [{
-                "metric": "mainnet_state_root_warm_s",
-                "value": None,
-                "unit": "s",
-                "note": "mainnet bench produced no warm-root line within budget",
-            }]
-        for rec in mainnet_recs:
+        for rec in _bench_mainnet_root():
             print(json.dumps(rec), flush=True)
 
     if not os.environ.get("BENCH_NO_INGEST"):
@@ -292,12 +277,27 @@ def main() -> None:
         for rec in _bench_script(
             "bench_ingest.py",
             ("node_ingest_aggregate_verifications_per_sec",),
-            float(os.environ.get("BENCH_INGEST_BUDGET_S", "5400")),
+            float(os.environ.get("BENCH_INGEST_BUDGET_S", "1800")),
+            units={"node_ingest_aggregate_verifications_per_sec":
+                   "aggregate verifications/s"},
         ):
             print(json.dumps(rec), flush=True)
         for rec in _bench_script(
             "bench_boot.py", ("node_first_verify_s",),
-            float(os.environ.get("BENCH_BOOT_BUDGET_S", "1200")),
+            float(os.environ.get("BENCH_BOOT_BUDGET_S", "600")),
+            units={"node_first_verify_s": "s"},
+        ):
+            print(json.dumps(rec), flush=True)
+
+    if not os.environ.get("BENCH_NO_PLANES"):
+        # registry-plane sharing: device bytes resident must be flat in
+        # the live-context count, rebuilds must skip the registry upload
+        for rec in _bench_script(
+            "bench_registry_planes.py",
+            ("registry_planes_resident_bytes", "registry_context_rebuild_s"),
+            float(os.environ.get("BENCH_PLANES_BUDGET_S", "300")),
+            units={"registry_planes_resident_bytes": "bytes",
+                   "registry_context_rebuild_s": "s"},
         ):
             print(json.dumps(rec), flush=True)
 

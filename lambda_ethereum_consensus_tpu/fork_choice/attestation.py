@@ -38,6 +38,7 @@ __all__ = [
     "get_attestation_context",
     "get_state_attestation_context",
     "registry_planes",
+    "device_plane_store",
 ]
 
 
@@ -99,6 +100,26 @@ def registry_planes(state, spec: ChainSpec | None = None):
             entry["ry"] = np.concatenate([entry["ry"], ty], axis=1)
         entry["count"] = n
     return entry["rx"][:, :n], entry["ry"][:, :n]
+
+
+def device_plane_store(state, spec: ChainSpec | None = None, interpret=None):
+    """The chain's shared device registry-plane store, grown to cover
+    ``state``'s registry.
+
+    Host planes grow monotonically per chain (above); this routes them
+    into the per-chain :class:`~..ops.bls_batch.RegistryPlaneStore`, so
+    every ``DeviceCommitteeCache`` the chain builds references ONE device
+    buffer — device memory for registry data is O(registry), not
+    O(live contexts x registry).
+    """
+    from ..ops.bls_batch import get_plane_store
+
+    rx, ry = registry_planes(state, spec)
+    store = get_plane_store(
+        bytes(state.genesis_validators_root), interpret=interpret
+    )
+    store.update(rx, ry)
+    return store
 
 
 class EpochAttestationContext:
@@ -191,13 +212,15 @@ class EpochAttestationContext:
 
     def device_cache(self):
         """Lazy epoch committee cache on device (built once per context —
-        i.e. once per (epoch, target) — and reused by every drain)."""
+        i.e. once per (epoch, target) — and reused by every drain).  The
+        registry planes come from the chain's SHARED plane store: every
+        live context's cache references the same device buffer."""
         if self._device_cache is None:
             from ..ops.bls_batch import DeviceCommitteeCache
 
-            rx, ry = registry_planes(self.state, self.spec)
+            store = device_plane_store(self.state, self.spec)
             self._device_cache = DeviceCommitteeCache(
-                (rx, ry),
+                store,
                 self.committees,
                 lengths=self.lengths,
                 chunk=min(256, max(1, self.count)),
@@ -208,6 +231,33 @@ class EpochAttestationContext:
 # ------------------------------------------------------------ context cache
 
 _STATE_CTX: dict = {}
+_STATE_CTX_CAP = 7
+_STORE_CTX_CAP = 8  # a node tracks current+previous epoch targets
+
+
+def _evict_oldest_epoch(cache: dict, cap: int, epoch_of, keep=None) -> None:
+    """Oldest-epoch LRU eviction down to ``cap`` entries.
+
+    The victim is the entry with the SMALLEST epoch; recency (dict
+    insertion order — getters refresh hits by re-inserting) breaks ties.
+    The old wholesale ``.clear()`` threw away the hot current-epoch
+    committee tables and device caches whenever an epoch boundary pushed
+    the map one past its cap, forcing a full rebuild mid-drain; evicting
+    the stalest epoch keeps the contexts gossip still references.
+
+    ``keep`` exempts one key from the victim pick.  The replay getter
+    passes its just-inserted key: a backfill segment older than every
+    cached epoch would otherwise insert-and-self-evict on EVERY block,
+    rebuilding the committee shuffle per call.  The gossip getter does
+    NOT — there a stale-epoch straggler is the right victim, and the hot
+    current-epoch contexts must all survive.
+    """
+    while len(cache) > cap:
+        victim = min(
+            (item for item in enumerate(cache) if item[1] != keep),
+            key=lambda item: (epoch_of(item[1]), item[0]),
+        )[1]
+        del cache[victim]
 
 
 def get_state_attestation_context(
@@ -230,11 +280,12 @@ def get_state_attestation_context(
         seed,
         len(state.validators),
     )
-    ctx = _STATE_CTX.get(key)
-    if ctx is None:
-        if len(_STATE_CTX) > 6:
-            _STATE_CTX.clear()
-        ctx = _STATE_CTX[key] = EpochAttestationContext(state, int(epoch), spec)
+    ctx = _STATE_CTX.pop(key, None)
+    if ctx is not None:
+        _STATE_CTX[key] = ctx  # refresh recency
+        return ctx
+    ctx = _STATE_CTX[key] = EpochAttestationContext(state, int(epoch), spec)
+    _evict_oldest_epoch(_STATE_CTX, _STATE_CTX_CAP, lambda k: k[1], keep=key)
     return ctx
 
 
@@ -242,17 +293,21 @@ def get_attestation_context(
     store, target, target_state, spec: ChainSpec | None = None
 ) -> EpochAttestationContext:
     """Context for a target checkpoint, cached on the store (keyed like
-    ``checkpoint_states``) and pruned with it on finalization."""
+    ``checkpoint_states``).  Overflow evicts the oldest-epoch context
+    (LRU within an epoch) instead of clearing, and finalization prunes
+    the map alongside ``checkpoint_states``
+    (:meth:`..store.Store.prune_checkpoint_caches`)."""
     spec = spec or get_chain_spec()
     key = (int(target.epoch), bytes(target.root))
     caches = getattr(store, "attestation_contexts", None)
     if caches is None:
         caches = store.attestation_contexts = {}
-    ctx = caches.get(key)
-    if ctx is None:
-        if len(caches) > 8:  # a node tracks current+previous epoch targets
-            caches.clear()
-        ctx = caches[key] = EpochAttestationContext(
-            target_state, int(target.epoch), spec
-        )
+    ctx = caches.pop(key, None)
+    if ctx is not None:
+        caches[key] = ctx  # refresh recency
+        return ctx
+    ctx = caches[key] = EpochAttestationContext(
+        target_state, int(target.epoch), spec
+    )
+    _evict_oldest_epoch(caches, _STORE_CTX_CAP, lambda k: k[0])
     return ctx

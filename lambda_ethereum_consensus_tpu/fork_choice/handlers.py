@@ -7,6 +7,8 @@ state instead — ref: handlers.ex:80-88 — with the real path parked at
 
 from __future__ import annotations
 
+import logging
+
 from ..config import ChainSpec, constants, get_chain_spec
 from ..state_transition import accessors, misc
 from ..state_transition.core import state_transition
@@ -19,6 +21,8 @@ from ..state_transition.predicates import (
 )
 from ..types.beacon import Attestation, AttesterSlashing, Checkpoint, SignedBeaconBlock
 from .store import ForkChoiceError, LatestMessage, Store, checkpoint_key
+
+log = logging.getLogger("fork_choice")
 
 
 def expect(cond: bool, reason: str) -> None:
@@ -64,6 +68,10 @@ def update_checkpoints(
         store.bump()
         if store.head_cache is not None:
             store.head_cache.prune(bytes(finalized.root))
+        # checkpoint states + attestation contexts below the finalized
+        # epoch can never be referenced again — free the states, committee
+        # tables and device caches they pin
+        store.prune_checkpoint_caches(int(finalized.epoch))
 
 
 def update_unrealized_checkpoints(
@@ -408,6 +416,7 @@ def _attestation_batch_cached(
     by_ctx: dict[int, list] = {}  # id(ctx) -> [(i, att, attesting, entry)]
     ctxs: dict[int, object] = {}
     host_entries = []  # (i, att, attesting, point-entry) — over-capacity
+    logged_unexpected = False  # one traceback per drain, not one per item
     for (i, attestation, ctx, cid, attesting, missing, signing_root,
          target_state), sig_pt in zip(pending, sig_points):
         try:
@@ -436,6 +445,20 @@ def _attestation_batch_cached(
             results[i] = e
         except (BlsError, DeserializationError) as e:
             results[i] = ForkChoiceError(str(e), reject=True)
+        except (SpecError, ValueError) as e:
+            # ctx.device_cache() can raise here (invalid registry pubkey,
+            # inconsistent cache shapes) — one bad item must not drop the
+            # whole gossip batch, repeatedly, for every future drain
+            results[i] = ForkChoiceError(str(e))
+        except Exception as e:  # unexpected: contain to the item, but a
+            # systemic failure (dead device tunnel) must stay diagnosable
+            # — log the first traceback per drain, not 8k copies
+            if not logged_unexpected:
+                logged_unexpected = True
+                log.exception("unexpected error in cached attestation drain")
+            results[i] = ForkChoiceError(
+                f"attestation drain internal error: {type(e).__name__}: {e}"
+            )
 
     accepted = []  # (batch index, ctx, attestation, attesting array)
 
@@ -447,11 +470,20 @@ def _attestation_batch_cached(
                 [entry for _, _, _, entry in group],
                 message_points=ctx.message_points,
             )
-        except SpecError as e:
+        except (SpecError, ValueError) as e:
             # e.g. an invalid registry pubkey surfacing from the device
             # cache build: fail THIS context's items, not the whole batch
             for i, _, _, _ in group:
                 results[i] = ForkChoiceError(str(e))
+            continue
+        except Exception as e:  # unexpected device failure: same blast radius
+            if not logged_unexpected:
+                logged_unexpected = True
+                log.exception("unexpected error in cached attestation drain")
+            for i, _, _, _ in group:
+                results[i] = ForkChoiceError(
+                    f"attestation drain internal error: {type(e).__name__}: {e}"
+                )
             continue
         for (i, attestation, attesting, _), ok in zip(group, flags):
             if ok:

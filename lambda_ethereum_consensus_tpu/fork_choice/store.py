@@ -65,8 +65,9 @@ class Store:
     mutations: int = 0
     head_memo: tuple | None = None
     # epoch-scoped attestation-verification contexts (committee tables +
-    # device committee caches), keyed like checkpoint_states — see
-    # fork_choice/attestation.py
+    # device committee caches), keyed like checkpoint_states, pruned with
+    # it on finalization (prune_checkpoint_caches) and LRU-evicted by
+    # oldest epoch on cap overflow — see fork_choice/attestation.py
     attestation_contexts: dict = field(default_factory=dict)
     # columnar mirror of latest_messages' epochs (int64, -1 = no vote):
     # the batched drain filters "who actually moves" with one array
@@ -93,6 +94,21 @@ class Store:
                     arr[i] = lm.epoch
             self._vote_epochs = arr
         return self._vote_epochs
+
+    def prune_checkpoint_caches(self, finalized_epoch: int) -> None:
+        """Drop checkpoint states and attestation contexts whose target
+        epoch precedes finalization.
+
+        Gossip attestations only carry current/previous-epoch targets and
+        both are >= the finalized epoch, so these keys can never be read
+        again — but each held a full BeaconState plus (for contexts) an
+        epoch committee table and a device committee cache, which is what
+        made the maps the store's largest steady-state growth.  Called on
+        every finalized-checkpoint advance (handlers.update_checkpoints).
+        """
+        for cache in (self.checkpoint_states, self.attestation_contexts):
+            for key in [k for k in cache if k[0] < finalized_epoch]:
+                del cache[key]
 
     def note_vote(self, index: int, epoch: int) -> None:
         """Keep the columnar epoch mirror in sync on per-item updates."""

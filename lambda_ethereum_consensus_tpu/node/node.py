@@ -84,10 +84,17 @@ class BeaconNode:
         self._stopping = False
         self.device_backend = None
         self._prev_hash_backend = None
-        # subnet gossip validation state: committees-per-slot memo and the
-        # one-vote-per-validator-per-epoch IGNORE cache (epoch -> cells)
-        self._cps_memo: dict[tuple[int, bytes], tuple[int, bool]] = {}
-        self._cps_fallback_memo: dict[tuple[int, bytes], int] = {}
+        # subnet gossip validation state: committees-per-slot + shuffling
+        # seed memo and the one-vote-per-validator-per-epoch IGNORE cache
+        # (epoch -> cells)
+        self._cps_memo: dict[tuple[int, bytes], tuple[int, bool, bytes]] = {}
+        self._cps_fallback_memo: dict[tuple[int, bytes], tuple[int, bytes]] = {}
+        # per-target vote-cell discriminator, (value, is_seed): sticky
+        # once seed-derived so recorded cell keys never change; a
+        # provisional target-root stand-in (no state yet) upgrades to the
+        # seed — safe because cells are only recorded for ACCEPTed votes,
+        # which require the target block (hence a seed source) to be known
+        self._vote_cell_disc: dict[tuple[int, bytes], tuple[bytes, bool]] = {}
         self._seen_subnet_votes: dict[int, set] = {}
 
     # ------------------------------------------------------------- startup
@@ -344,8 +351,11 @@ class BeaconNode:
             batch, lambda msg: msg.value.message.aggregate, "aggregate_and_proof"
         )
 
-    def _committees_per_slot_at(self, target) -> tuple[int, bool] | None:
-        """``(committees_per_slot, authoritative)`` for the target epoch.
+    def _committees_per_slot_at(
+        self, target
+    ) -> tuple[int, bool, bytes] | None:
+        """``(committees_per_slot, authoritative, shuffling_seed)`` for the
+        target epoch.
 
         ``authoritative`` is True only when the materialized checkpoint
         state answered — approximations (target block's post-state, the
@@ -353,7 +363,9 @@ class BeaconNode:
         and a REJECT issued from one would penalize honest peers, so the
         caller must downgrade mismatches to IGNORE for those.  A
         non-authoritative memo entry upgrades itself once the checkpoint
-        state materializes."""
+        state materializes.  The attester shuffling seed rides along (from
+        the same resolved state) as the one-vote-cell discriminator."""
+        from ..config import constants
         from ..fork_choice.store import checkpoint_key
         from ..state_transition import accessors
 
@@ -373,22 +385,28 @@ class BeaconNode:
             jroot = bytes(self.store.justified_checkpoint.root)
             fhit = self._cps_fallback_memo.get((epoch, jroot))
             if fhit is not None:
-                return fhit, False
+                return fhit[0], False, fhit[1]
             jstate = self.store.block_states.get(jroot)
             if jstate is None:
                 return None
             cps = accessors.get_committee_count_per_slot(jstate, epoch, self.spec)
+            seed = accessors.get_seed(
+                jstate, epoch, constants.DOMAIN_BEACON_ATTESTER, self.spec
+            )
             if len(self._cps_fallback_memo) > 64:
                 self._cps_fallback_memo.clear()
-            self._cps_fallback_memo[(epoch, jroot)] = cps
-            return cps, False
+            self._cps_fallback_memo[(epoch, jroot)] = (cps, seed)
+            return cps, False, seed
         cps = accessors.get_committee_count_per_slot(
             state, int(target.epoch), self.spec
         )
+        seed = accessors.get_seed(
+            state, int(target.epoch), constants.DOMAIN_BEACON_ATTESTER, self.spec
+        )
         if len(self._cps_memo) > 64:
             self._cps_memo.clear()
-        self._cps_memo[key] = (cps, authoritative)
-        return cps, authoritative
+        self._cps_memo[key] = (cps, authoritative, seed)
+        return cps, authoritative, seed
 
     async def _on_attestation_batch(self, subnet: int, batch) -> list[int]:
         """Subnet gossip validation (p2p spec beacon_attestation_{i}; ADVICE
@@ -398,8 +416,19 @@ class BeaconNode:
         - REJECT unless exactly one aggregation bit is set
         - REJECT when the committee maps to a different subnet
         - IGNORE duplicate (validator, epoch) votes — keyed by the
-          (epoch, slot, index, bit) cell, which pins one validator per
-          epoch under the fixed epoch shuffling
+          (epoch, slot, index, bit, shuffling-seed) cell.  The cell only
+          pins one validator per epoch UNDER ONE SHUFFLING: the seed
+          discriminates competing forks whose different shufflings put a
+          DIFFERENT validator in the same (slot, index, bit) cell (an
+          honest first-seen vote on the other fork is not IGNOREd), while
+          forks that share the shuffling (divergence after the seed's
+          randao mix) still collide — the same validator's second vote at
+          one epoch stays IGNOREd, as the p2p spec requires.  The
+          discriminator is sticky once seed-derived (recorded cell keys
+          must never reflow); a provisional target-root stand-in (no
+          state can answer yet) upgrades to the seed, which is safe
+          because only ACCEPTed votes record cells and acceptance
+          requires the target block — hence a seed source — to be known
         """
         from ..state_transition.misc import compute_subnet_for_attestation
 
@@ -413,8 +442,9 @@ class BeaconNode:
                 verdicts[pos] = VERDICT_REJECT
                 continue
             cps_auth = self._committees_per_slot_at(att.data.target)
+            seed = None
             if cps_auth is not None:
-                cps, authoritative = cps_auth
+                cps, authoritative, seed = cps_auth
                 if int(att.data.index) >= cps or compute_subnet_for_attestation(
                     cps, int(att.data.slot), int(att.data.index), self.spec
                 ) != subnet:
@@ -426,7 +456,22 @@ class BeaconNode:
                     )
                     continue
             epoch = int(att.data.target.epoch)
-            key = (int(att.data.slot), int(att.data.index), bits.indices()[0])
+            tkey = (epoch, bytes(att.data.target.root))
+            hit = self._vote_cell_disc.get(tkey)
+            if hit is not None and hit[1]:
+                disc = hit[0]  # seed-derived: sticky, keys never reflow
+            elif seed is not None:
+                # first seed-based resolution (or an upgrade from the
+                # provisional stand-in — no cells were recorded under it:
+                # ACCEPT requires the target block, hence a seed source)
+                disc = seed
+                self._vote_cell_disc[tkey] = (seed, True)
+            else:
+                # no state to derive the seed from yet: the target root is
+                # the coarser stand-in (never merges distinct shufflings)
+                disc = bytes(att.data.target.root)
+                self._vote_cell_disc[tkey] = (disc, False)
+            key = (int(att.data.slot), int(att.data.index), bits.indices()[0], disc)
             if (
                 key in self._seen_subnet_votes.get(epoch, ())
                 or (epoch, key) in batch_keys
@@ -453,6 +498,10 @@ class BeaconNode:
                 e for e in self._seen_subnet_votes if e < current_epoch - 1
             ]:
                 del self._seen_subnet_votes[epoch]
+            for tkey in [
+                k for k in self._vote_cell_disc if k[0] < current_epoch - 1
+            ]:
+                del self._vote_cell_disc[tkey]
         return verdicts
 
     def _on_applied(self, root: bytes, signed: SignedBeaconBlock) -> None:

@@ -49,6 +49,8 @@ __all__ = [
     "chain_verify_cached",
     "aggregate_g1_chain",
     "DeviceCommitteeCache",
+    "RegistryPlaneStore",
+    "get_plane_store",
 ]
 
 
@@ -592,6 +594,131 @@ def aggregate_g1_chain(points_planes, interpret: bool | None = None):
     return ops["aggregate_g1"](bx, by, inf)
 
 
+class RegistryPlaneStore:
+    """Per-chain shared device-resident registry pubkey planes.
+
+    Every :class:`DeviceCommitteeCache` used to upload its own copy of the
+    full registry planes (256 B/validator: 2 coords x 32 int32 limb
+    planes), so the up-to-14 live epoch contexts pinned
+    O(contexts x registry) duplicated immutable device memory — multiple
+    GB at mainnet scale.  A validator's pubkey
+    never changes once registered, so one chain needs exactly ONE device
+    copy: this store owns it, every cache on the chain references the same
+    buffer, and device memory for registry data is O(registry).
+
+    Growth policy: capacity is padded to power-of-two column counts, so
+
+    - a deposit that grows the registry within capacity writes only the new
+      columns into the existing allocation (``dynamic_update_slice`` — the
+      resident prefix never re-crosses the host/device link), and
+    - a growth past capacity concatenates the on-device prefix with the new
+      columns plus fresh zero padding (again only the delta is uploaded),
+      doubling capacity so uploads amortize and the jitted gather programs
+      keyed on the (32, capacity) operand shape stay warm across deposits.
+
+    Invalidation: incoming host planes are compared against the retained
+    host reference over the OVERLAPPING prefix (memcmp-fast numpy, O(n) at
+    cache-build frequency — once per epoch context, never per drain).  An
+    older state's shorter-but-consistent view of the same append-only
+    registry — the common case when a previous-epoch target context builds
+    after a deposit grew the registry — is served from the existing buffer
+    as-is; only a genuine prefix mutation (synthetic/test registries) drops
+    the buffer and bumps ``version``.  Caches built against a dropped
+    buffer keep their (still internally consistent) reference until
+    evicted.
+    """
+
+    def __init__(self, interpret: bool | None = None, min_capacity: int = 1024):
+        if interpret is None:
+            interpret = not _use_planes()
+        self._interpret = interpret
+        self._min_cap = max(1, int(min_capacity))
+        self.count = 0  # live registry columns
+        self.capacity = 0  # allocated columns (power of two)
+        self.rx = None  # jnp (32, capacity) — THE shared buffer
+        self.ry = None
+        self.version = 0  # bumped on prefix invalidation
+        self.uploaded_cols = 0  # telemetry: host->device columns shipped
+        # host-side reference of what was uploaded (a live view the
+        # per-chain planes cache holds anyway — no copy)
+        self._host_rx = None
+        self._host_ry = None
+
+    @property
+    def resident_bytes(self) -> int:
+        """Device bytes pinned by the shared planes (both coordinates) —
+        independent of how many caches reference them."""
+        if self.rx is None:
+            return 0
+        return int(self.rx.nbytes) + int(self.ry.nbytes)
+
+    def update(self, rx, ry):
+        """Grow the device planes to cover the host planes ``(rx, ry)``
+        (numpy, (32, n)); returns ``(rx_dev, ry_dev)`` — the full-capacity
+        shared buffers.  Only columns beyond the cached count are uploaded;
+        a shorter consistent view is served from the existing buffer, and
+        a mutated prefix invalidates (version bump + full re-upload)."""
+        import jax.numpy as jnp
+
+        rx = np.asarray(rx)
+        ry = np.asarray(ry)
+        n = rx.shape[1]
+        k = min(n, self.count)
+        if k and not (
+            np.array_equal(rx[:, :k], self._host_rx[:, :k])
+            and np.array_equal(ry[:, :k], self._host_ry[:, :k])
+        ):
+            # the shared buffer is poisoned for every holder: drop it and
+            # let live caches keep their old (consistent) reference
+            self.rx = self.ry = None
+            self.count = self.capacity = 0
+            self._host_rx = self._host_ry = None
+            self.version += 1
+        if n <= self.count:
+            # an older (or identical) consistent view of the registry:
+            # the resident buffer already covers it
+            return self.rx, self.ry
+        new_x = jnp.asarray(np.ascontiguousarray(rx[:, self.count : n]))
+        new_y = jnp.asarray(np.ascontiguousarray(ry[:, self.count : n]))
+        if n <= self.capacity:
+            from jax import lax
+
+            self.rx = lax.dynamic_update_slice(self.rx, new_x, (0, self.count))
+            self.ry = lax.dynamic_update_slice(self.ry, new_y, (0, self.count))
+        else:
+            cap = _pow2(max(n, self._min_cap))
+            zx = jnp.zeros((32, cap - n), new_x.dtype)
+            prefix_x = [self.rx[:, : self.count]] if self.count else []
+            prefix_y = [self.ry[:, : self.count]] if self.count else []
+            self.rx = jnp.concatenate(prefix_x + [new_x, zx], axis=1)
+            self.ry = jnp.concatenate(prefix_y + [new_y, zx], axis=1)
+            self.capacity = cap
+        self.uploaded_cols += n - self.count
+        self.count = n
+        self._host_rx, self._host_ry = rx, ry
+        return self.rx, self.ry
+
+
+# one store per (chain, backend mode): genesis_validators_root is the
+# chain identity the host-side planes cache already keys on
+_PLANE_STORES: dict = {}
+
+
+def get_plane_store(
+    chain_key: bytes, interpret: bool | None = None
+) -> RegistryPlaneStore:
+    """The per-chain shared :class:`RegistryPlaneStore` (created on first
+    use).  ``interpret`` selects the backend mode exactly like the caches
+    that will reference the planes."""
+    if interpret is None:
+        interpret = not _use_planes()
+    key = (bytes(chain_key), bool(interpret))
+    store = _PLANE_STORES.get(key)
+    if store is None:
+        store = _PLANE_STORES[key] = RegistryPlaneStore(interpret=interpret)
+    return store
+
+
 class DeviceCommitteeCache:
     """Epoch-scoped device-resident committee aggregate pubkeys.
 
@@ -608,6 +735,14 @@ class DeviceCommitteeCache:
     High-participation aggregates (the gossip norm) make the correction
     gather ~20x smaller than the full gather.  All shapes are padded to a
     small bucket set so the jitted programs cache across epochs.
+
+    ``registry_planes`` is either a :class:`RegistryPlaneStore` — the
+    production path: this cache holds a reference into the chain's ONE
+    shared device buffer, so N live caches pin O(registry), not
+    O(N x registry) — or a raw ``(rx, ry)`` plane tuple, which uploads a
+    private copy (bench scripts and synthetic-registry tests).  Committee
+    indices only ever address live columns, so the store's zero-padded
+    capacity tail is never gathered.
     """
 
     def __init__(
@@ -621,13 +756,32 @@ class DeviceCommitteeCache:
     ):
         import jax.numpy as jnp
 
-        if interpret is None:
-            interpret = not _use_planes()
+        if isinstance(registry_planes, RegistryPlaneStore):
+            store = registry_planes
+            if interpret is None:
+                interpret = store._interpret
+            elif interpret != store._interpret:
+                raise ValueError(
+                    f"interpret={interpret} conflicts with the plane "
+                    f"store's interpret={store._interpret}"
+                )
+            if store.rx is None:
+                raise ValueError("plane store is empty; update() it first")
+            self.plane_store = store
+            self._plane_version = store.version
+            # the SHARED buffers — no copy, no per-cache upload
+            self.rx = store.rx
+            self.ry = store.ry
+        else:
+            if interpret is None:
+                interpret = not _use_planes()
+            self.plane_store = None
+            self._plane_version = None
+            rx, ry = registry_planes
+            self.rx = jnp.asarray(rx)
+            self.ry = jnp.asarray(ry)
         self._interpret = interpret
         self._ops = _get_chain_ops(interpret)
-        rx, ry = registry_planes
-        self.rx = jnp.asarray(rx)
-        self.ry = jnp.asarray(ry)
         committees = np.asarray(committees, np.int32)
         n_comm, k = committees.shape
         kp = _pow2(k)
@@ -666,6 +820,24 @@ class DeviceCommitteeCache:
         self.sum_x = jnp.concatenate(sums_x, axis=1)[:, :n_comm]
         self.sum_y = jnp.concatenate(sums_y, axis=1)[:, :n_comm]
 
+    def _refresh_planes(self) -> None:
+        """Adopt the shared store's CURRENT buffer when registry growth
+        rebound it: append-only growth keeps this cache's prefix
+        byte-identical, so switching is free — and dropping the pre-growth
+        reference is what lets that allocation actually be released
+        (otherwise every deposit-era cache pins its own full-registry
+        snapshot again).  After an invalidation (``version`` bump) the
+        snapshot we were built against stays: it is the buffer our
+        committee sums are consistent with."""
+        s = self.plane_store
+        if (
+            s is not None
+            and s.rx is not None
+            and s.version == self._plane_version
+            and s.rx is not self.rx
+        ):
+            self.rx, self.ry = s.rx, s.ry
+
     def aggregate(self, comm_ids, miss_idx, miss_inf):
         """Affine aggregate pubkey planes for one drain's entries.
 
@@ -679,6 +851,7 @@ class DeviceCommitteeCache:
         """
         import jax.numpy as jnp
 
+        self._refresh_planes()
         return self._ops["agg_corrected"](
             self.rx,
             self.ry,

@@ -1,0 +1,149 @@
+"""Registry-plane sharing micro-bench (device memory + rebuild latency).
+
+Measures the two costs the shared :class:`RegistryPlaneStore` exists to
+kill (ISSUE 1 tentpole):
+
+1. **Resident registry bytes** — before, every ``DeviceCommitteeCache``
+   uploaded a private copy of the (32, N) rx/ry planes, so k live epoch
+   contexts pinned ``k x plane_bytes`` of immutable duplicated device
+   memory; now they all reference ONE per-chain buffer and the resident
+   figure is independent of the live-context count (asserted here by
+   buffer identity, not just arithmetic).
+2. **Context (re)build latency** — building a cache against the warm
+   shared store skips the host->device registry upload entirely; the
+   incremental-append path uploads only the new columns when deposits
+   grow the registry.
+
+Emits one JSON line per metric (bench.py's guarded-subprocess contract):
+
+    registry_planes_resident_bytes   shared-store bytes, with the k-context
+                                     private-copy figure alongside
+    registry_context_rebuild_s       cache build on the warm shared store,
+                                     with the cold/private build and the
+                                     append-vs-reupload figures alongside
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.ops import bls_batch as BB  # noqa: E402
+
+
+def _planes(n: int, salt: int = 0):
+    """Synthetic affine int pairs -> (32, n) limb planes.  The bench
+    measures transfer/build costs, which don't depend on the points being
+    on-curve (the cache formulas never validate)."""
+    pts = [(3 + 5 * i + salt, 7 + 11 * i + salt) for i in range(n)]
+    return BB._g1_planes(pts)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--registry", type=int, default=2048)
+    ap.add_argument("--committees", type=int, default=32)
+    ap.add_argument("--members", type=int, default=32)
+    ap.add_argument("--contexts", type=int, default=4)
+    ap.add_argument("--grow", type=int, default=256)
+    args = ap.parse_args()
+    if args.committees * args.members > args.registry:
+        ap.error(
+            f"--registry must be >= committees*members "
+            f"({args.committees}*{args.members}={args.committees * args.members} "
+            f"> {args.registry}): committees partition the registry"
+        )
+
+    import jax
+
+    interpret = not BB._use_planes()
+    n = args.registry
+    rx, ry = _planes(n)
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(n).astype(np.int32)
+
+    def committees_for(salt: int) -> np.ndarray:
+        # a disjoint slice of the one permutation per "epoch", like the
+        # spec's shuffling: each context sees a different committee table
+        flat = np.roll(perm, salt * args.members)[: args.committees * args.members]
+        return flat.reshape(args.committees, args.members)
+
+    # --- cold upload into the shared store
+    store = BB.RegistryPlaneStore(interpret=interpret)
+    t0 = time.perf_counter()
+    store.update(rx, ry)
+    jax.block_until_ready((store.rx, store.ry))
+    upload_s = time.perf_counter() - t0
+
+    # --- k contexts on the shared store: every build must reference the
+    # SAME buffer (the tentpole's contract), so resident bytes stay flat
+    builds = []
+    caches = []
+    for k in range(args.contexts):
+        t0 = time.perf_counter()
+        cache = BB.DeviceCommitteeCache(
+            store, committees_for(k), chunk=min(256, args.committees)
+        )
+        jax.block_until_ready((cache.sum_x, cache.sum_y))
+        builds.append(time.perf_counter() - t0)
+        caches.append(cache)
+    assert all(c.rx is store.rx and c.ry is store.ry for c in caches), (
+        "shared-plane contract violated: a cache holds a private buffer"
+    )
+    shared_bytes = store.resident_bytes
+
+    # --- the before picture: one private-copy cache, scaled by k
+    t0 = time.perf_counter()
+    private = BB.DeviceCommitteeCache(
+        (rx, ry), committees_for(0), interpret=interpret,
+        chunk=min(256, args.committees),
+    )
+    jax.block_until_ready((private.sum_x, private.sum_y))
+    private_build_s = time.perf_counter() - t0
+    per_copy = int(private.rx.nbytes) + int(private.ry.nbytes)
+
+    # --- deposit growth: append-only upload vs shipping the registry again
+    gx, gy = _planes(n + args.grow)
+    uploaded_before = store.uploaded_cols
+    t0 = time.perf_counter()
+    store.update(gx, gy)
+    jax.block_until_ready((store.rx, store.ry))
+    append_s = time.perf_counter() - t0
+    appended = store.uploaded_cols - uploaded_before
+
+    print(json.dumps({
+        "metric": "registry_planes_resident_bytes",
+        "value": shared_bytes,
+        "unit": "bytes",
+        "contexts": args.contexts,
+        "registry": n,
+        "per_cache_copy_bytes": per_copy,
+        "private_copies_bytes": per_copy * args.contexts,
+        "capacity_cols": store.capacity,
+        "backend": jax.default_backend(),
+    }), flush=True)
+    print(json.dumps({
+        "metric": "registry_context_rebuild_s",
+        "value": round(float(np.median(builds[1:] or builds)), 4),
+        "unit": "s",
+        "first_build_s": round(builds[0], 4),
+        "cold_private_build_s": round(private_build_s, 4),
+        "registry_upload_s": round(upload_s, 4),
+        "append_s": round(append_s, 4),
+        "appended_cols": appended,
+        "append_was_incremental": appended == args.grow,
+        "committees": args.committees,
+        "members": args.members,
+        "backend": jax.default_backend(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,311 @@
+"""Shared device registry planes + epoch-LRU context eviction (ISSUE 1).
+
+The tentpole's three contracts, each pinned by a unit test:
+
+- one chain = ONE device buffer for the registry planes, shared by every
+  ``DeviceCommitteeCache`` (buffer identity, not arithmetic);
+- registry growth appends only the new columns (no re-upload of the
+  resident prefix), while a prefix mutation invalidates loudly;
+- context-cache overflow evicts the oldest epoch (the current-epoch
+  context survives), and finalization prunes ``attestation_contexts``
+  alongside ``checkpoint_states``.
+"""
+
+import secrets
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
+from lambda_ethereum_consensus_tpu.fork_choice import attestation as ATT
+from lambda_ethereum_consensus_tpu.ops import bls_batch as BB
+from lambda_ethereum_consensus_tpu.ops.bls_g1 import _ints_batch
+
+
+def _planes(n, salt=0):
+    pts = [
+        C.g1.multiply_raw(C.G1_GENERATOR, 3 + 5 * i + salt) for i in range(n)
+    ]
+    return pts, BB._g1_planes(pts)
+
+
+# ------------------------------------------------------------- plane store
+
+
+def test_shared_plane_identity_across_caches():
+    """Two committee caches on one store reference the SAME device buffer
+    (the O(contexts x registry) -> O(registry) memory contract), and the
+    sums computed through the capacity-padded shared buffer still match
+    host affine math."""
+    pts, (rx, ry) = _planes(16)
+    store = BB.RegistryPlaneStore(interpret=True, min_capacity=8)
+    store.update(rx, ry)
+
+    comm_a = np.array([[0, 1, 2, 3], [4, 5, 6, 7]], np.int32)
+    comm_b = np.array([[8, 9, 10, 11], [12, 13, 14, 15]], np.int32)
+    cache_a = BB.DeviceCommitteeCache(store, comm_a, chunk=2)
+    cache_b = BB.DeviceCommitteeCache(store, comm_b, chunk=2)
+
+    assert cache_a.rx is store.rx and cache_a.ry is store.ry
+    assert cache_b.rx is store.rx and cache_b.ry is store.ry
+    assert cache_a.rx is cache_b.rx  # the acceptance-criteria identity
+
+    def host_sum(idxs):
+        acc = None
+        for i in idxs:
+            acc = pts[i] if acc is None else C.g1.affine_add(acc, pts[i])
+        return acc
+
+    for cache, comm in ((cache_a, comm_a), (cache_b, comm_b)):
+        sx = _ints_batch(np.asarray(cache.sum_x).T.astype(np.int32))
+        sy = _ints_batch(np.asarray(cache.sum_y).T.astype(np.int32))
+        for ci in range(2):
+            assert (sx[ci], sy[ci]) == host_sum(comm[ci])
+
+
+def test_store_incremental_append_and_growth():
+    """Registry growth uploads only the delta columns: within capacity via
+    in-place update, past capacity via pow2 pad-and-grow — never the
+    resident prefix, and never a version bump."""
+    _, (rx, ry) = _planes(20)
+    store = BB.RegistryPlaneStore(interpret=True, min_capacity=8)
+
+    store.update(rx[:, :12], ry[:, :12])
+    assert (store.count, store.capacity) == (12, 16)
+    assert store.uploaded_cols == 12 and store.version == 0
+
+    # within capacity: only the 2 new columns cross the host/device link
+    store.update(rx[:, :14], ry[:, :14])
+    assert (store.count, store.capacity) == (14, 16)
+    assert store.uploaded_cols == 14 and store.version == 0
+
+    # past capacity: pow2 growth, still only the 6 new columns uploaded
+    store.update(rx, ry)
+    assert (store.count, store.capacity) == (20, 32)
+    assert store.uploaded_cols == 20 and store.version == 0
+    np.testing.assert_array_equal(np.asarray(store.rx)[:, :20], rx)
+    np.testing.assert_array_equal(np.asarray(store.ry)[:, :20], ry)
+    assert store.resident_bytes == store.rx.nbytes + store.ry.nbytes
+
+    # idempotent re-update: nothing to ship
+    store.update(rx, ry)
+    assert store.uploaded_cols == 20 and store.version == 0
+
+
+def test_store_serves_older_state_views_without_invalidation():
+    """A previous-epoch context's state sees FEWER validators than the
+    newest upload.  Its consistent shorter view must be served from the
+    resident buffer as-is — treating it as a prefix change would drop the
+    shared buffer and re-upload the registry on every stale-context
+    build, resurrecting the O(copies x registry) duplication."""
+    _, (rx, ry) = _planes(16)
+    store = BB.RegistryPlaneStore(interpret=True, min_capacity=8)
+    store.update(rx, ry)
+    buffer = store.rx
+
+    out_rx, out_ry = store.update(rx[:, :12], ry[:, :12])
+    assert out_rx is buffer and out_ry is store.ry
+    assert store.version == 0 and store.uploaded_cols == 16
+    assert store.count == 16  # the newer, longer upload stays authoritative
+
+
+def test_store_prefix_mutation_invalidates():
+    """A MUTATED prefix poisons the shared buffer: the store drops it,
+    bumps ``version`` and re-uploads in full — it must never silently
+    serve planes that disagree with the host registry.  A consistent
+    shorter view, by contrast, is not a mutation (tested above)."""
+    _, (rx, ry) = _planes(10)
+    store = BB.RegistryPlaneStore(interpret=True, min_capacity=8)
+    store.update(rx, ry)
+    old_buffer = store.rx
+    assert store.version == 0 and store.uploaded_cols == 10
+
+    mutated = rx.copy()
+    mutated[0, 0] ^= 1
+    store.update(mutated, ry)
+    assert store.version == 1
+    assert store.uploaded_cols == 20  # full re-upload
+    assert store.rx is not old_buffer
+    np.testing.assert_array_equal(np.asarray(store.rx)[:, :10], mutated)
+
+    # a shorter view that disagrees with the retained prefix is a
+    # mutation too, even though it is smaller on both axes
+    shrunk = mutated[:, :6].copy()
+    shrunk[1, 1] ^= 1
+    store.update(shrunk, ry[:, :6])
+    assert store.version == 2 and store.count == 6
+
+
+def test_cache_adopts_post_growth_buffer():
+    """After a deposit grows the registry, a pre-growth cache switches to
+    the store's current buffer on its next aggregate (append-only growth
+    keeps its prefix byte-identical) — otherwise every deposit-era cache
+    would pin its own full-registry snapshot again.  After an
+    INVALIDATION it must keep the snapshot its sums are consistent with."""
+    _, (rx, ry) = _planes(16)
+    store = BB.RegistryPlaneStore(interpret=True, min_capacity=8)
+    store.update(rx[:, :12], ry[:, :12])
+    cache = BB.DeviceCommitteeCache(store, np.array([[0, 1]], np.int32))
+    old_buffer = cache.rx
+
+    store.update(rx[:, :14], ry[:, :14])  # within-capacity growth rebinds
+    assert store.rx is not old_buffer
+    cache._refresh_planes()
+    assert cache.rx is store.rx and cache.ry is store.ry
+
+    mutated = rx[:, :14].copy()
+    mutated[0, 0] ^= 1
+    store.update(mutated, ry[:, :14])  # invalidation: version bump
+    snapshot = cache.rx
+    cache._refresh_planes()
+    assert cache.rx is snapshot  # keeps its consistent pre-bump buffer
+
+
+def test_get_plane_store_keyed_per_chain():
+    key_a, key_b = secrets.token_bytes(32), secrets.token_bytes(32)
+    store_a = BB.get_plane_store(key_a, interpret=True)
+    assert BB.get_plane_store(key_a, interpret=True) is store_a
+    assert BB.get_plane_store(key_b, interpret=True) is not store_a
+
+
+def test_interpret_mismatch_rejected():
+    _, (rx, ry) = _planes(4)
+    store = BB.RegistryPlaneStore(interpret=True, min_capacity=4)
+    store.update(rx, ry)
+    with pytest.raises(ValueError):
+        BB.DeviceCommitteeCache(
+            store, np.array([[0, 1]], np.int32), interpret=False
+        )
+    with pytest.raises(ValueError):
+        BB.DeviceCommitteeCache(
+            BB.RegistryPlaneStore(interpret=True),  # never update()d
+            np.array([[0, 1]], np.int32),
+        )
+
+
+def test_device_plane_store_shared_through_attestation_wiring(monkeypatch):
+    """Two epoch contexts of one chain route through ONE plane store (the
+    production path ``EpochAttestationContext.device_cache`` takes)."""
+    _, (rx, ry) = _planes(8)
+    monkeypatch.setattr(ATT, "registry_planes", lambda state, spec=None: (rx, ry))
+    chain = secrets.token_bytes(32)
+    state = SimpleNamespace(genesis_validators_root=chain)
+
+    store_1 = ATT.device_plane_store(state, spec=None, interpret=True)
+    store_2 = ATT.device_plane_store(state, spec=None, interpret=True)
+    assert store_1 is store_2
+    cache_1 = BB.DeviceCommitteeCache(store_1, np.array([[0, 1]], np.int32))
+    cache_2 = BB.DeviceCommitteeCache(store_2, np.array([[2, 3]], np.int32))
+    assert cache_1.rx is cache_2.rx
+
+
+# ------------------------------------------------- epoch-LRU context cache
+
+
+class _StubCtx:
+    def __init__(self, target_state, epoch, spec):
+        self.epoch = int(epoch)
+
+
+def _target(epoch, tag):
+    return SimpleNamespace(epoch=epoch, root=bytes([tag]) * 32)
+
+
+def test_store_ctx_overflow_keeps_current_epoch(monkeypatch):
+    """Cap overflow evicts by OLDEST EPOCH, not wholesale: the hot
+    current-epoch contexts (committee tables + device caches gossip is
+    actively using) survive; a stale-epoch insert evicts itself."""
+    monkeypatch.setattr(ATT, "EpochAttestationContext", _StubCtx)
+    store = SimpleNamespace()
+    spec = object()
+
+    current = [_target(5, i) for i in range(ATT._STORE_CTX_CAP)]
+    for t in current:
+        ATT.get_attestation_context(store, t, None, spec)
+    assert len(store.attestation_contexts) == ATT._STORE_CTX_CAP
+
+    # a previous-epoch straggler overflows the cap: IT is the oldest epoch
+    old_ctx = ATT.get_attestation_context(store, _target(4, 99), None, spec)
+    assert old_ctx.epoch == 4  # still returned and usable
+    assert len(store.attestation_contexts) == ATT._STORE_CTX_CAP
+    for t in current:  # every current-epoch context survived
+        assert (5, bytes(t.root)) in store.attestation_contexts
+    assert (4, bytes(b"\x63" * 32)) not in store.attestation_contexts
+
+
+def test_store_ctx_lru_tiebreak_within_epoch(monkeypatch):
+    """Within one epoch the least-recently-USED context is the victim —
+    a cache hit refreshes recency."""
+    monkeypatch.setattr(ATT, "EpochAttestationContext", _StubCtx)
+    store = SimpleNamespace()
+    spec = object()
+
+    targets = [_target(5, i) for i in range(ATT._STORE_CTX_CAP)]
+    for t in targets:
+        ATT.get_attestation_context(store, t, None, spec)
+    # touch the first-inserted: it must NOT be the victim anymore
+    ATT.get_attestation_context(store, targets[0], None, spec)
+    ATT.get_attestation_context(store, _target(6, 50), None, spec)
+
+    contexts = store.attestation_contexts
+    assert (5, bytes(targets[0].root)) in contexts
+    assert (5, bytes(targets[1].root)) not in contexts  # now the LRU victim
+    assert (6, bytes(b"\x32" * 32)) in contexts
+
+
+def test_evict_oldest_epoch_state_ctx_key_shape():
+    """The helper handles the state-context key shape ((chain, epoch,
+    seed, length) — epoch at index 1) just as well."""
+    cache = {
+        (b"c", epoch, b"s", 64): f"ctx{epoch}" for epoch in (7, 3, 9, 5)
+    }
+    ATT._evict_oldest_epoch(cache, 2, lambda k: k[1])
+    assert [k[1] for k in cache] == [7, 9]
+
+
+def test_evict_keep_protects_replay_context():
+    """The replay getter's just-inserted key is exempt from the victim
+    pick: a backfill segment older than every cached epoch must reuse its
+    context across the segment's blocks, not insert-and-self-evict per
+    block.  The next-oldest OTHER epoch goes instead."""
+    cache = {(b"c", epoch, b"s", 64): f"ctx{epoch}" for epoch in (9, 8, 7)}
+    new_key = (b"c", 2, b"s", 64)
+    cache[new_key] = "ctx2"
+    ATT._evict_oldest_epoch(cache, 3, lambda k: k[1], keep=new_key)
+    assert new_key in cache  # the replay context survived its own insert
+    assert [k[1] for k in cache] == [9, 8, 2]  # epoch 7 was the victim
+
+
+def test_finalization_prunes_attestation_contexts():
+    """update_checkpoints on a finalized advance drops checkpoint states
+    AND attestation contexts below the new finalized epoch — the pruning
+    the old docstring claimed but nothing performed."""
+    from lambda_ethereum_consensus_tpu.fork_choice.handlers import (
+        update_checkpoints,
+    )
+    from lambda_ethereum_consensus_tpu.fork_choice.store import Store
+    from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint
+
+    def cp(epoch, tag):
+        return Checkpoint(epoch=epoch, root=bytes([tag]) * 32)
+
+    store = Store(
+        time=0,
+        genesis_time=0,
+        justified_checkpoint=cp(0, 1),
+        finalized_checkpoint=cp(0, 1),
+        unrealized_justified_checkpoint=cp(0, 1),
+        unrealized_finalized_checkpoint=cp(0, 1),
+    )
+    for epoch in range(4):
+        store.checkpoint_states[(epoch, bytes([epoch]) * 32)] = f"state{epoch}"
+        store.attestation_contexts[(epoch, bytes([epoch]) * 32)] = f"ctx{epoch}"
+
+    update_checkpoints(store, cp(2, 7), cp(2, 7))
+
+    assert sorted(k[0] for k in store.checkpoint_states) == [2, 3]
+    assert sorted(k[0] for k in store.attestation_contexts) == [2, 3]
+    # no-op advance (same epoch) must not prune anything further
+    update_checkpoints(store, cp(2, 7), cp(2, 7))
+    assert sorted(k[0] for k in store.attestation_contexts) == [2, 3]
