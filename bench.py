@@ -17,7 +17,8 @@ tunnel hung the whole run at rc=124 with zero evidence):
 
 - a per-stage wall-clock budget (env-overridable), trimmed so the stage
   SUM fits one bench run's ~2 h budget: SSZ 600 + mainnet 1500 + ingest
-  1800 + boot 600 + registry-planes 300 + BLS 2x1200 = 7200 s worst case;
+  1620 + boot 600 + registry-planes 300 + telemetry 180 + BLS 2x1200 =
+  7200 s worst case;
 - honest absence — a stage that times out/crashes still emits its metric
   lines with ``value: null`` and a note, so "broke" is distinguishable
   from "skipped";
@@ -277,7 +278,7 @@ def main() -> None:
         for rec in _bench_script(
             "bench_ingest.py",
             ("node_ingest_aggregate_verifications_per_sec",),
-            float(os.environ.get("BENCH_INGEST_BUDGET_S", "1800")),
+            float(os.environ.get("BENCH_INGEST_BUDGET_S", "1620")),
             units={"node_ingest_aggregate_verifications_per_sec":
                    "aggregate verifications/s"},
         ):
@@ -298,6 +299,18 @@ def main() -> None:
             float(os.environ.get("BENCH_PLANES_BUDGET_S", "300")),
             units={"registry_planes_resident_bytes": "bytes",
                    "registry_context_rebuild_s": "s"},
+        ):
+            print(json.dumps(rec), flush=True)
+
+    if not os.environ.get("BENCH_NO_TELEMETRY"):
+        # span/no-op overhead on the synthetic gossip drain (ISSUE 2:
+        # enabled < 3%, TELEMETRY_OFF < 0.5%) — host-only, no device
+        for rec in _bench_script(
+            "bench_telemetry_overhead.py",
+            ("telemetry_span_overhead_pct", "telemetry_noop_overhead_pct"),
+            float(os.environ.get("BENCH_TELEMETRY_BUDGET_S", "180")),
+            units={"telemetry_span_overhead_pct": "%",
+                   "telemetry_noop_overhead_pct": "%"},
         ):
             print(json.dumps(rec), flush=True)
 

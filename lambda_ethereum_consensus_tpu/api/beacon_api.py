@@ -20,6 +20,7 @@ from typing import Callable
 
 from ..config import ChainSpec
 from ..fork_choice import Store, get_head
+from ..telemetry import get_metrics
 
 
 class BeaconApiServer:
@@ -201,9 +202,24 @@ class BeaconApiServer:
         )
 
     def _metrics(self) -> tuple[str, str, bytes]:
-        body = (
-            self.metrics.render_prometheus().encode()
-            if self.metrics is not None
-            else b""
-        )
+        """Prometheus exposition (text format 0.0.4: HELP/TYPE headers +
+        histogram series from the registry renderer).
+
+        Merges the node's own registry (node-identity gauges — peer
+        count, sync slot — kept per node so co-resident nodes don't
+        clobber each other) with the process-wide default registry the
+        hot paths below the node runtime record spans into.  The merge
+        is family-aware: any family already in the node registry is
+        skipped from the default render, so a name recorded into both
+        (e.g. by a bench script using the module-level helpers) can
+        never emit a duplicate TYPE header — which would fail the whole
+        scrape target, not just the colliding family."""
+        default = get_metrics()
+        if self.metrics is None or self.metrics is default:
+            return "200 OK", "text/plain; version=0.0.4", default.render_prometheus().encode()
+        own = self.metrics.render_prometheus().rstrip("\n")
+        rest = default.render_prometheus(
+            skip=self.metrics.family_names()
+        ).rstrip("\n")
+        body = ("\n".join(p for p in (own, rest) if p) + "\n").encode()
         return "200 OK", "text/plain; version=0.0.4", body

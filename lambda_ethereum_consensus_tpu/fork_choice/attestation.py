@@ -32,6 +32,7 @@ from ..config import ChainSpec, constants, get_chain_spec
 from ..state_transition import accessors, misc
 from ..state_transition.errors import SpecError
 from ..state_transition.mutable import BeaconStateMut
+from ..telemetry import get_metrics
 
 __all__ = [
     "EpochAttestationContext",
@@ -39,6 +40,7 @@ __all__ = [
     "get_state_attestation_context",
     "registry_planes",
     "device_plane_store",
+    "state_context_count",
 ]
 
 
@@ -235,7 +237,14 @@ _STATE_CTX_CAP = 7
 _STORE_CTX_CAP = 8  # a node tracks current+previous epoch targets
 
 
-def _evict_oldest_epoch(cache: dict, cap: int, epoch_of, keep=None) -> None:
+def state_context_count() -> int:
+    """Live state-keyed contexts (the node's per-tick cache-size gauge)."""
+    return len(_STATE_CTX)
+
+
+def _evict_oldest_epoch(
+    cache: dict, cap: int, epoch_of, keep=None, kind: str = "store"
+) -> None:
     """Oldest-epoch LRU eviction down to ``cap`` entries.
 
     The victim is the entry with the SMALLEST epoch; recency (dict
@@ -258,6 +267,9 @@ def _evict_oldest_epoch(cache: dict, cap: int, epoch_of, keep=None) -> None:
             key=lambda item: (epoch_of(item[1]), item[0]),
         )[1]
         del cache[victim]
+        # eviction rate is a rebuild-cost signal: a hot-context victim
+        # means the cap is too small for the fork pattern on gossip
+        get_metrics().inc("attestation_context_evictions_count", cache=kind)
 
 
 def get_state_attestation_context(
@@ -285,7 +297,9 @@ def get_state_attestation_context(
         _STATE_CTX[key] = ctx  # refresh recency
         return ctx
     ctx = _STATE_CTX[key] = EpochAttestationContext(state, int(epoch), spec)
-    _evict_oldest_epoch(_STATE_CTX, _STATE_CTX_CAP, lambda k: k[1], keep=key)
+    _evict_oldest_epoch(
+        _STATE_CTX, _STATE_CTX_CAP, lambda k: k[1], keep=key, kind="state"
+    )
     return ctx
 
 

@@ -19,6 +19,7 @@ from ..state_transition.predicates import (
     is_slashable_attestation_data,
     is_valid_indexed_attestation,
 )
+from ..telemetry import get_metrics, span
 from ..types.beacon import Attestation, AttesterSlashing, Checkpoint, SignedBeaconBlock
 from .store import ForkChoiceError, LatestMessage, Store, checkpoint_key
 
@@ -111,10 +112,11 @@ def on_block(
     )
 
     # The real compute: full state transition with validation on.
-    state = state_transition(
-        pre_state, signed_block, validate_result=True,
-        execution_engine=execution_engine, spec=spec,
-    )
+    with span("block_transition"):
+        state = state_transition(
+            pre_state, signed_block, validate_result=True,
+            execution_engine=execution_engine, spec=spec,
+        )
     root = block.hash_tree_root(spec)
     store.add_block(root, block, state)
 
@@ -310,9 +312,15 @@ def on_attestation_batch(
     spec = spec or get_chain_spec()
     results: list[ForkChoiceError | None] = [None] * len(attestations)
     if attestations and _chain_enabled(len(attestations)):
-        _attestation_batch_cached(store, attestations, is_from_block, spec, results)
+        with span("attestation_batch_verify", path="cached"):
+            _attestation_batch_cached(
+                store, attestations, is_from_block, spec, results
+            )
         return results
-    return _attestation_batch_host(store, attestations, is_from_block, spec, results)
+    with span("attestation_batch_verify", path="host"):
+        return _attestation_batch_host(
+            store, attestations, is_from_block, spec, results
+        )
 
 
 def _attestation_batch_host(
@@ -449,6 +457,7 @@ def _attestation_batch_cached(
             # ctx.device_cache() can raise here (invalid registry pubkey,
             # inconsistent cache shapes) — one bad item must not drop the
             # whole gossip batch, repeatedly, for every future drain
+            get_metrics().inc("gossip_batch_error_count", stage="item")
             results[i] = ForkChoiceError(str(e))
         except Exception as e:  # unexpected: contain to the item, but a
             # systemic failure (dead device tunnel) must stay diagnosable
@@ -456,6 +465,7 @@ def _attestation_batch_cached(
             if not logged_unexpected:
                 logged_unexpected = True
                 log.exception("unexpected error in cached attestation drain")
+            get_metrics().inc("gossip_batch_error_count", stage="item")
             results[i] = ForkChoiceError(
                 f"attestation drain internal error: {type(e).__name__}: {e}"
             )
@@ -473,6 +483,9 @@ def _attestation_batch_cached(
         except (SpecError, ValueError) as e:
             # e.g. an invalid registry pubkey surfacing from the device
             # cache build: fail THIS context's items, not the whole batch
+            get_metrics().inc(
+                "gossip_batch_error_count", value=len(group), stage="context"
+            )
             for i, _, _, _ in group:
                 results[i] = ForkChoiceError(str(e))
             continue
@@ -480,6 +493,9 @@ def _attestation_batch_cached(
             if not logged_unexpected:
                 logged_unexpected = True
                 log.exception("unexpected error in cached attestation drain")
+            get_metrics().inc(
+                "gossip_batch_error_count", value=len(group), stage="context"
+            )
             for i, _, _, _ in group:
                 results[i] = ForkChoiceError(
                     f"attestation drain internal error: {type(e).__name__}: {e}"

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from ..config import ChainSpec, constants, get_chain_spec
 from ..state_transition import accessors, misc
+from ..telemetry import span
 from .store import Store, checkpoint_key
 
 
@@ -147,19 +148,22 @@ def get_head(store: Store, spec: ChainSpec | None = None) -> bytes:
     )
     if store.head_memo is not None and store.head_memo[0] == memo_key:
         return store.head_memo[1]
-    blocks = get_filtered_block_tree(store, spec)
-    head = bytes(store.justified_checkpoint.root)
-    # one vote scan per head call; the walk reuses it at every level
-    vote_weights = _vote_weights_by_root(store, spec)
-    while True:
-        children = [
-            root for root in store.children.get(head, []) if root in blocks
-        ]
-        if not children:
-            store.head_memo = (memo_key, head)
-            return head
-        # weight-descending, root as tiebreak (spec: lexicographic max)
-        head = max(
-            children,
-            key=lambda r: (_subtree_weight(store, r, vote_weights, spec), r),
-        )
+    # only the cold walk is spanned: a memo hit must stay O(1) with zero
+    # instrumentation cost (it runs per API request and per tick)
+    with span("fork_choice_head_recompute"):
+        blocks = get_filtered_block_tree(store, spec)
+        head = bytes(store.justified_checkpoint.root)
+        # one vote scan per head call; the walk reuses it at every level
+        vote_weights = _vote_weights_by_root(store, spec)
+        while True:
+            children = [
+                root for root in store.children.get(head, []) if root in blocks
+            ]
+            if not children:
+                store.head_memo = (memo_key, head)
+                return head
+            # weight-descending, root as tiebreak (spec: lexicographic max)
+            head = max(
+                children,
+                key=lambda r: (_subtree_weight(store, r, vote_weights, spec), r),
+            )

@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from ..config import ChainSpec, constants, get_chain_spec
 from ..state_transition import accessors, misc
 from ..state_transition.errors import SpecError
+from ..telemetry import get_metrics
 from ..types.beacon import BeaconBlock, BeaconState, Checkpoint
 from .tree import HeadCache
 
@@ -106,9 +107,13 @@ class Store:
         made the maps the store's largest steady-state growth.  Called on
         every finalized-checkpoint advance (handlers.update_checkpoints).
         """
+        pruned = 0
         for cache in (self.checkpoint_states, self.attestation_contexts):
             for key in [k for k in cache if k[0] < finalized_epoch]:
                 del cache[key]
+                pruned += 1
+        if pruned:
+            get_metrics().inc("checkpoint_cache_pruned_count", value=pruned)
 
     def note_vote(self, index: int, epoch: int) -> None:
         """Keep the columnar epoch mirror in sync on per-item updates."""

@@ -21,10 +21,18 @@ log = logging.getLogger("gossip")
 from ..compression.snappy import decompress as snappy_decompress
 from ..config import ChainSpec, get_chain_spec
 from ..state_transition import misc
+from ..telemetry import get_metrics, span
 from .port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT, Port
 
 MAX_QUEUE = 1024
 MAX_BATCH = 64
+
+
+def _topic_short(topic: str) -> str:
+    """Metric label for a topic: the bare name (``beacon_block``), not the
+    digest-bearing full path — label cardinality must not grow per fork."""
+    parts = topic.split("/")
+    return parts[3] if len(parts) >= 5 else topic
 
 
 def topic_name(fork_digest: bytes, name: str) -> str:
@@ -62,6 +70,7 @@ class TopicSubscription:
         spec: ChainSpec | None = None,
         max_batch: int = MAX_BATCH,
         max_queue: int = MAX_QUEUE,
+        metrics=None,
     ):
         """``max_batch`` bounds one drain's handler batch.  Attestation
         channels raise it by two orders of magnitude: the device RLC
@@ -71,12 +80,19 @@ class TopicSubscription:
         batch size IS the TPU economics)."""
         self.port = port
         self.topic = topic
+        self.topic_label = _topic_short(topic)
+        # the owning node's registry for PER-NODE gauges (queue depth is
+        # a set(), so co-resident nodes would clobber a shared one); span
+        # histograms and error counters stay on the default registry —
+        # observe/inc aggregate correctly across nodes
+        self.metrics = metrics if metrics is not None else get_metrics()
         self.handler = handler
         self.ssz_type = ssz_type
         self.spec = spec or get_chain_spec()
         self.max_batch = max_batch
         self.queue: asyncio.Queue = asyncio.Queue(max_queue)
         self._task: asyncio.Task | None = None
+        self._handler_error_logged = False  # one traceback per outage
 
     async def start(self) -> None:
         await self.port.subscribe(self.topic, self._on_gossip)
@@ -116,31 +132,54 @@ class TopicSubscription:
                 continue
 
     async def _process_batch(self, raw_batch) -> None:
-        messages: list[GossipMessage] = []
-        for msg_id, payload, peer_id in raw_batch:
-            # gossip uses *raw* snappy (ref: gossip_consumer.ex:36 :snappyer)
+        # queue depth at drain start: sustained growth here is the first
+        # sign the verify path cannot keep up with gossip arrival
+        self.metrics.set_gauge(
+            "gossip_queue_depth", self.queue.qsize(), topic=self.topic_label
+        )
+        with span("gossip_drain", topic=self.topic_label):
+            messages: list[GossipMessage] = []
+            for msg_id, payload, peer_id in raw_batch:
+                # gossip uses *raw* snappy (ref: gossip_consumer.ex:36 :snappyer)
+                try:
+                    data = snappy_decompress(payload)
+                    value = (
+                        self.ssz_type.decode(data, self.spec)
+                        if self.ssz_type is not None
+                        else None
+                    )
+                except Exception:
+                    # any decode failure on attacker-controlled bytes -> reject
+                    await self.port.validate_message(msg_id, VERDICT_REJECT)
+                    continue
+                messages.append(GossipMessage(msg_id, data, peer_id, value))
+            if not messages:
+                return
             try:
-                data = snappy_decompress(payload)
-                value = (
-                    self.ssz_type.decode(data, self.spec)
-                    if self.ssz_type is not None
-                    else None
-                )
+                verdicts = list(await self.handler(messages))
+                self._handler_error_logged = False  # outage over: re-arm
             except Exception:
-                # any decode failure on attacker-controlled bytes -> reject
-                await self.port.validate_message(msg_id, VERDICT_REJECT)
-                continue
-            messages.append(GossipMessage(msg_id, data, peer_id, value))
-        if not messages:
-            return
-        try:
-            verdicts = list(await self.handler(messages))
-        except Exception:
-            verdicts = [VERDICT_IGNORE] * len(messages)
-        if len(verdicts) < len(messages):  # short handler output: ignore rest
-            verdicts += [VERDICT_IGNORE] * (len(messages) - len(verdicts))
-        for msg, verdict in zip(messages, verdicts):
-            await self.port.validate_message(msg.msg_id, verdict)
+                # count what a raising handler cost: every item in the
+                # batch is dropped to IGNORE (ADVICE r5: these drops were
+                # invisible — only a dashboard counter makes them a signal)
+                get_metrics().inc(
+                    "gossip_batch_error_count",
+                    value=len(messages),
+                    stage="drain",
+                    topic=self.topic_label,
+                )
+                # one traceback per outage, not per drain: a systemic
+                # failure (dead device tunnel) at gossip cadence would
+                # flood the log and bury its own diagnostic — the counter
+                # above carries the per-drain signal
+                if not self._handler_error_logged:
+                    self._handler_error_logged = True
+                    log.exception("gossip handler failed on %s", self.topic)
+                verdicts = [VERDICT_IGNORE] * len(messages)
+            if len(verdicts) < len(messages):  # short handler output: ignore rest
+                verdicts += [VERDICT_IGNORE] * (len(messages) - len(verdicts))
+            for msg, verdict in zip(messages, verdicts):
+                await self.port.validate_message(msg.msg_id, verdict)
 
 
 async def publish_ssz(port: Port, topic: str, value, spec: ChainSpec | None = None) -> None:

@@ -18,6 +18,7 @@ import struct
 import sys
 from typing import Awaitable, Callable
 
+from ..telemetry import span
 from .proto import port_pb2
 
 VERDICT_ACCEPT = port_pb2.ValidateMessage.ACCEPT
@@ -142,12 +143,25 @@ class Port:
         self._pending[cmd_id] = fut
         raw = cmd.SerializeToString()
         assert self._proc is not None and self._proc.stdin is not None
-        self._proc.stdin.write(struct.pack(">I", len(raw)) + raw)
-        await self._proc.stdin.drain()
-        try:
-            result: port_pb2.Result = await asyncio.wait_for(fut, timeout)
-        finally:
-            self._pending.pop(cmd_id, None)
+        # the span covers write -> matching Result frame: the honest wall
+        # clock a caller waits on one sidecar round-trip, queueing
+        # included.  The slow-op threshold scales with the command's own
+        # timeout: send_request legitimately spends seconds on a remote
+        # peer during range sync, and the default 1 s bar would emit one
+        # WARNING per request for hours — only a round-trip nearing its
+        # deadline is an anomaly worth a log line (the histogram carries
+        # the full latency distribution regardless)
+        with span(
+            "sidecar_roundtrip",
+            slow=timeout * 0.8,
+            command=cmd.WhichOneof("c") or "unknown",
+        ):
+            self._proc.stdin.write(struct.pack(">I", len(raw)) + raw)
+            await self._proc.stdin.drain()
+            try:
+                result: port_pb2.Result = await asyncio.wait_for(fut, timeout)
+            finally:
+                self._pending.pop(cmd_id, None)
         if not result.ok:
             raise PortError(result.error or "sidecar command failed")
         return result

@@ -36,7 +36,7 @@ from ..types.validator import SignedAggregateAndProof
 from .chain import LiveChainView
 from .pending_blocks import PendingBlocks
 from .sync import SyncBlocks
-from .telemetry import Metrics
+from .telemetry import Metrics, telemetry_enabled
 
 log = logging.getLogger("node")
 
@@ -70,7 +70,13 @@ class BeaconNode:
     def __init__(self, config: NodeConfig, spec: ChainSpec | None = None):
         self.config = config
         self.spec = spec or get_chain_spec()
-        self.metrics = Metrics()
+        # per-NODE registry for node-identity gauges (peer count, sync
+        # slot, head slot): co-resident nodes in one process must not
+        # clobber each other's values.  The hot paths below the node
+        # runtime (ssz, fork_choice, network) record spans into the
+        # process-wide default registry instead; /metrics merges both
+        # (api/beacon_api.py — the family sets are disjoint).
+        self.metrics = Metrics(enabled=telemetry_enabled())
         self.kv: KvStore | None = None
         self.blocks_db: BlockStore | None = None
         self.states_db: StateStore | None = None
@@ -258,7 +264,7 @@ class BeaconNode:
         block_topic = topic_name(digest, "beacon_block")
         sub = TopicSubscription(
             self.port, block_topic, self._on_block_batch,
-            ssz_type=SignedBeaconBlock, spec=self.spec,
+            ssz_type=SignedBeaconBlock, spec=self.spec, metrics=self.metrics,
         )
         await sub.start()
         self._subs.append(sub)
@@ -270,7 +276,7 @@ class BeaconNode:
         agg = TopicSubscription(
             self.port, agg_topic, self._on_aggregate_batch,
             ssz_type=SignedAggregateAndProof, spec=self.spec,
-            max_batch=ATT_BATCH, max_queue=ATT_QUEUE,
+            max_batch=ATT_BATCH, max_queue=ATT_QUEUE, metrics=self.metrics,
         )
         await agg.start()
         self._subs.append(agg)
@@ -286,7 +292,7 @@ class BeaconNode:
                 self.port, sub_topic,
                 functools.partial(self._on_attestation_batch, i),
                 ssz_type=Attestation, spec=self.spec,
-                max_batch=ATT_BATCH, max_queue=ATT_QUEUE,
+                max_batch=ATT_BATCH, max_queue=ATT_QUEUE, metrics=self.metrics,
             )
             await att_sub.start()
             self._subs.append(att_sub)
@@ -519,6 +525,7 @@ class BeaconNode:
             await asyncio.sleep(1.0 - (now % 1.0))
             try:
                 on_tick(self.store, int(time.time()), self.spec)
+                self._sample_device_telemetry()
                 if self.store.head_cache is not None:
                     # O(1) cached head for the per-tick gauge — the full
                     # LMD-GHOST get_head stays on the consensus-critical
@@ -534,6 +541,74 @@ class BeaconNode:
                         )
             except Exception:
                 log.exception("tick failed")
+
+    def _sample_device_telemetry(self) -> None:
+        """Per-tick device/cache gauges (ISSUE 2 tentpole): live device
+        arrays/bytes, shared registry-plane residency, attestation-context
+        cache sizes and the AOT/jit retrace counters.  Every source is
+        gated on its module already being imported — a pure-host node must
+        not pay a jax (or crypto-stack) import for a gauge sample.
+
+        PROCESS-wide facts (device memory, plane stores, AOT stats, the
+        process-global state-context cache) go to the default registry —
+        writes from co-resident nodes are then idempotent and never
+        double-count in cross-target sums; only the store-scoped context
+        gauge is truly per node and lands on ``self.metrics``."""
+        import sys
+
+        from .telemetry import get_metrics
+
+        node_m = self.metrics
+        proc_m = get_metrics()
+        if not (node_m.enabled or proc_m.enabled):
+            return
+        if "jax" in sys.modules:
+            try:
+                import jax
+
+                arrays = jax.live_arrays()
+                proc_m.set_gauge("device_live_arrays", float(len(arrays)))
+                proc_m.set_gauge(
+                    "device_live_bytes",
+                    float(sum(getattr(a, "nbytes", 0) for a in arrays)),
+                )
+            except Exception:  # a dead device tunnel must not kill ticks
+                pass
+        bls_batch = sys.modules.get(
+            "lambda_ethereum_consensus_tpu.ops.bls_batch"
+        )
+        if bls_batch is not None:
+            planes = bls_batch.plane_store_stats()
+            proc_m.set_gauge("registry_plane_stores", float(planes["stores"]))
+            proc_m.set_gauge(
+                "registry_plane_resident_bytes", float(planes["resident_bytes"])
+            )
+            proc_m.set_gauge(
+                "registry_plane_uploaded_cols", float(planes["uploaded_cols"])
+            )
+        attestation = sys.modules.get(
+            "lambda_ethereum_consensus_tpu.fork_choice.attestation"
+        )
+        if attestation is not None:
+            # this store's contexts: genuinely per node
+            node_m.set_gauge(
+                "attestation_context_count",
+                float(len(getattr(self.store, "attestation_contexts", ()))),
+                cache="store",
+            )
+            # the state-keyed cache is a process global — its own family
+            # (not a label on the per-node one) so each family lives in
+            # exactly one registry and the /metrics merge stays disjoint
+            proc_m.set_gauge(
+                "state_attestation_context_count",
+                float(attestation.state_context_count()),
+            )
+        from ..ops.aot import aot_stats  # import-light (no jax at import)
+
+        stats = aot_stats()
+        proc_m.set_gauge("bls_aot_retraces", float(stats.get("retraces", 0)))
+        proc_m.set_gauge("bls_aot_compiles", float(stats.get("compiles", 0)))
+        proc_m.set_gauge("bls_aot_loads", float(stats.get("loads", 0)))
 
     async def _range_sync(self) -> None:
         sync = SyncBlocks(self.store, self.pending, self.downloader, self.spec)

@@ -1,51 +1,33 @@
-"""Metrics registry + Prometheus text exposition (ref: lib/.../telemetry.ex).
+"""Metrics registry + Prometheus exposition — compatibility re-export.
 
-Keeps the reference's metric names — ``network_request_count``,
-``peers_connection_count``, ``sync_store_slot`` (ref: telemetry.ex:56-80) —
-served on the Beacon API's ``/metrics`` route instead of a separate
-TelemetryMetricsPrometheus listener.
+The implementation moved to the package-level
+:mod:`lambda_ethereum_consensus_tpu.telemetry` so the layers below the
+node runtime (``ssz``, ``ops``, ``network``, ``fork_choice``) can record
+spans without importing through ``node/__init__`` — which pulls in the
+whole runtime and would turn e.g. ``ssz/core.py -> node.telemetry`` into
+a circular import.  Everything importable here before the move still is.
 """
 
-from __future__ import annotations
+from ..telemetry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    BoundSpan,
+    Metrics,
+    get_metrics,
+    inc,
+    observe,
+    set_gauge,
+    span,
+    telemetry_enabled,
+)
 
-import threading
-from collections import defaultdict
-
-
-class Metrics:
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: dict[tuple[str, tuple], float] = defaultdict(float)
-        self._gauges: dict[tuple[str, tuple], float] = {}
-
-    def inc(self, name: str, value: float = 1, **labels) -> None:
-        with self._lock:
-            self._counters[(name, tuple(sorted(labels.items())))] += value
-
-    def set_gauge(self, name: str, value: float, **labels) -> None:
-        with self._lock:
-            self._gauges[(name, tuple(sorted(labels.items())))] = value
-
-    def get(self, name: str, **labels) -> float:
-        key = (name, tuple(sorted(labels.items())))
-        with self._lock:
-            if key in self._gauges:
-                return self._gauges[key]
-            return self._counters.get(key, 0.0)
-
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format."""
-        lines = []
-        with self._lock:
-            for (name, labels), value in sorted(self._counters.items()):
-                lines.append(f"{name}{_labels(labels)} {value:g}")
-            for (name, labels), value in sorted(self._gauges.items()):
-                lines.append(f"{name}{_labels(labels)} {value:g}")
-        return "\n".join(lines) + "\n"
-
-
-def _labels(labels: tuple) -> str:
-    if not labels:
-        return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
-    return "{" + inner + "}"
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "BoundSpan",
+    "Metrics",
+    "get_metrics",
+    "inc",
+    "observe",
+    "set_gauge",
+    "span",
+    "telemetry_enabled",
+]

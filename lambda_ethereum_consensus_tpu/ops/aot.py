@@ -37,7 +37,10 @@ import threading
 __all__ = ["aot_jit", "aot_dir", "aot_stats"]
 
 _LOCK = threading.Lock()
-_STATS = {"loads": 0, "compiles": 0, "saves": 0, "errors": 0}
+# "retraces": how often a batch-verify entry point had to LOWER (trace) a
+# program for a new argument-shape signature — the per-tick jit-retrace
+# gauge; disk loads deliberately skip tracing and don't count
+_STATS = {"loads": 0, "compiles": 0, "saves": 0, "errors": 0, "retraces": 0}
 
 
 def aot_dir() -> str | None:
@@ -172,6 +175,8 @@ def aot_jit(fn, name: str):
             compiled_by_sig[sig] = fn
             return fn(*args)
         _log(f"{name}: lowered in {_t.perf_counter() - t0:.1f}s")
+        with _LOCK:
+            _STATS["retraces"] += 1
 
         # 2) compile (and best-effort persist).  The axon tunnel's
         # remote_compile endpoint occasionally drops the connection

@@ -51,6 +51,7 @@ __all__ = [
     "DeviceCommitteeCache",
     "RegistryPlaneStore",
     "get_plane_store",
+    "plane_store_stats",
 ]
 
 
@@ -717,6 +718,18 @@ def get_plane_store(
     if store is None:
         store = _PLANE_STORES[key] = RegistryPlaneStore(interpret=interpret)
     return store
+
+
+def plane_store_stats() -> dict:
+    """Aggregate telemetry over every live plane store (the node's
+    per-tick gauges — a public accessor like ``aot_stats`` so callers
+    never couple to this module's internals)."""
+    stores = list(_PLANE_STORES.values())
+    return {
+        "stores": len(stores),
+        "resident_bytes": sum(s.resident_bytes for s in stores),
+        "uploaded_cols": sum(s.uploaded_cols for s in stores),
+    }
 
 
 class DeviceCommitteeCache:

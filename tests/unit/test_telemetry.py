@@ -1,0 +1,268 @@
+"""Observability subsystem: histogram bucket math, exposition format,
+thread safety, span timing/slow-op logging, and the true no-op mode."""
+
+import logging
+import threading
+import time
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.telemetry import (
+    DEFAULT_BUCKETS,
+    Metrics,
+    get_metrics,
+)
+
+
+# ----------------------------------------------------------- bucket math
+
+
+def test_histogram_bucket_math():
+    m = Metrics()
+    m.register_histogram("lat", [0.001, 0.01, 0.1, 1.0])
+    # one per bucket, an exact-boundary hit (le is inclusive), an overflow
+    for v in (0.0005, 0.005, 0.05, 0.5, 0.01, 5.0):
+        m.observe("lat", v)
+    bounds, counts, total, count = m.get_histogram("lat")
+    assert bounds == (0.001, 0.01, 0.1, 1.0)
+    # raw (non-cumulative) per-bucket counts; last slot is +Inf overflow
+    assert counts == [1, 2, 1, 1, 1]
+    assert count == 6
+    assert total == pytest.approx(0.0005 + 0.005 + 0.05 + 0.5 + 0.01 + 5.0)
+
+
+def test_register_after_observe_rejected():
+    m = Metrics()
+    m.observe("h", 0.5)
+    with pytest.raises(ValueError, match="already has observations"):
+        m.register_histogram("h", [0.1, 1.0])
+
+
+def test_default_buckets_are_log_spaced():
+    ratios = {
+        round(b / a, 6) for a, b in zip(DEFAULT_BUCKETS, DEFAULT_BUCKETS[1:])
+    }
+    assert ratios == {2.0}
+    assert DEFAULT_BUCKETS[0] == pytest.approx(1e-4)
+
+
+# ------------------------------------------------------------ exposition
+
+
+def test_exposition_golden():
+    m = Metrics()
+    m.register_histogram("op_seconds", [0.01, 0.1])
+    m.inc("reqs", result="ok")
+    m.set_gauge("depth", 3, topic="beacon_block")
+    m.observe("op_seconds", 0.005, path="cached")
+    m.observe("op_seconds", 0.05, path="cached")
+    m.observe("op_seconds", 7.0, path="cached")
+    text = m.render_prometheus()
+    expected = [
+        "# TYPE reqs counter",
+        'reqs{result="ok"} 1',
+        "# TYPE depth gauge",
+        'depth{topic="beacon_block"} 3',
+        "# TYPE op_seconds histogram",
+        'op_seconds_bucket{path="cached",le="0.01"} 1',
+        'op_seconds_bucket{path="cached",le="0.1"} 2',
+        'op_seconds_bucket{path="cached",le="+Inf"} 3',
+        'op_seconds_sum{path="cached"} 7.055',
+        'op_seconds_count{path="cached"} 3',
+    ]
+    for line in expected:
+        assert line in text, f"missing {line!r} in:\n{text}"
+    # every family carries a HELP line too (scrape format 0.0.4)
+    for name in ("reqs", "depth", "op_seconds"):
+        assert f"# HELP {name} " in text
+    # headers come once per family, before its first sample
+    assert text.count("# TYPE op_seconds histogram") == 1
+    assert text.index("# TYPE reqs counter") < text.index('reqs{result="ok"} 1')
+
+
+def test_large_values_render_full_precision():
+    # %g's 6 significant digits quantized counters past 1e6, stair-
+    # stepping rate()/increase() — values must round-trip exactly
+    m = Metrics()
+    m.inc("big", value=1234567)
+    m.inc("big", value=1)
+    m.set_gauge("bytes_gauge", 268435456.0)
+    m.observe("lat", 123456.789)
+    text = m.render_prometheus()
+    assert "big 1234568" in text
+    assert "bytes_gauge 268435456" in text
+    assert "lat_sum 123456.789" in text
+
+
+def test_render_skip_families_and_merged_route(monkeypatch):
+    # the /metrics merge drops default-registry families the node
+    # registry already carries — one name must never emit two TYPE lines.
+    # A FRESH registry is swapped in as the process default so the test
+    # never pollutes the real singleton other tests share.
+    from lambda_ethereum_consensus_tpu import telemetry as T
+    from lambda_ethereum_consensus_tpu.api.beacon_api import BeaconApiServer
+
+    default = Metrics()
+    monkeypatch.setattr(T, "_DEFAULT", default)
+    node_m = Metrics()
+    node_m.inc("network_gossip_count", value=3, type="beacon_block")
+    node_m.set_gauge("sync_store_slot", 9)
+    default.inc("network_gossip_count", value=100, type="bench")
+    default.observe("gossip_drain_seconds", 0.02, topic="beacon_block")
+    assert "network_gossip_count" not in default.render_prometheus(
+        skip={"network_gossip_count"}
+    )
+    _, _, body = BeaconApiServer(store=None, spec=None, metrics=node_m)._metrics()
+    text = body.decode()
+    assert text.count("# TYPE network_gossip_count counter") == 1
+    # the node registry's samples win for the shared family...
+    assert 'network_gossip_count{type="beacon_block"} 3' in text
+    assert 'network_gossip_count{type="bench"}' not in text
+    # ...and disjoint default-registry families still come through
+    assert "# TYPE gossip_drain_seconds histogram" in text
+    type_lines = [l for l in text.splitlines() if l.startswith("# TYPE ")]
+    assert len(type_lines) == len(set(type_lines))
+
+
+def test_label_value_escaping():
+    m = Metrics()
+    m.inc("evil", why='quote " backslash \\ newline \n end')
+    text = m.render_prometheus()
+    assert 'why="quote \\" backslash \\\\ newline \\n end"' in text
+
+
+# ---------------------------------------------------------- thread safety
+
+
+def test_concurrent_inc_and_observe():
+    m = Metrics()
+    n_threads, per_thread = 8, 2000
+
+    def worker():
+        for i in range(per_thread):
+            m.inc("c")
+            m.observe("h", (i % 10) / 1000.0)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.get("c") == n_threads * per_thread
+    _, counts, _, count = m.get_histogram("h")
+    assert count == n_threads * per_thread
+    assert sum(counts) == count
+
+
+# ------------------------------------------------------------------ spans
+
+
+def test_span_records_latency_histogram():
+    m = Metrics()
+    with m.span("op", slow=10.0, path="cached"):
+        time.sleep(0.005)
+    hist = m.get_histogram("op_seconds", path="cached")
+    assert hist is not None
+    _, _, total, count = hist
+    assert count == 1
+    assert total >= 0.004
+
+
+def test_span_slow_op_threshold(caplog):
+    m = Metrics()
+    with caplog.at_level(logging.WARNING, logger="telemetry"):
+        with m.span("fast_op", slow=10.0):
+            pass
+        with m.span("slow_op_case", slow=0.0, topic="agg"):
+            time.sleep(0.002)
+    slow = [r for r in caplog.records if "slow_op" in r.getMessage()]
+    assert len(slow) == 1
+    msg = slow[0].getMessage()
+    assert "span=slow_op_case" in msg
+    assert "topic=agg" in msg
+
+
+def test_span_records_on_exception(caplog):
+    m = Metrics()
+    with caplog.at_level(logging.WARNING, logger="telemetry"):
+        with pytest.raises(RuntimeError):
+            with m.span("boom", slow=0.0):
+                raise RuntimeError("x")
+    _, _, _, count = m.get_histogram("boom_seconds")
+    assert count == 1  # duration recorded even when the region raises
+    assert any("error=RuntimeError" in r.getMessage() for r in caplog.records)
+
+
+def test_span_default_threshold_from_env(monkeypatch):
+    monkeypatch.setenv("TELEMETRY_SLOW_OP_S", "2.5")
+    assert Metrics().slow_op_s == 2.5
+    monkeypatch.setenv("TELEMETRY_SLOW_OP_S", "not-a-number")
+    assert Metrics().slow_op_s == 1.0  # fail safe, not fail loud
+
+
+# ------------------------------------------------------------ no-op mode
+
+
+def test_noop_mode_creates_zero_keys():
+    m = Metrics(enabled=False)
+    m.inc("c", result="ok")
+    m.set_gauge("g", 1.0)
+    m.observe("h", 0.5)
+    with m.span("op", topic="x"):
+        pass
+    assert m.key_count() == 0
+    assert m.get_histogram("op_seconds", topic="x") is None
+    # exposition carries no samples at all
+    assert m.render_prometheus().strip() == ""
+    # spans in no-op mode are the shared inert singleton — no per-call state
+    assert m.span("a") is m.span("b")
+
+
+def test_set_enabled_runtime_flip():
+    m = Metrics(enabled=False)
+    m.inc("c")
+    assert m.key_count() == 0
+    m.set_enabled(True)
+    m.inc("c")
+    assert m.get("c") == 1
+
+
+def test_default_registry_is_shared():
+    assert get_metrics() is get_metrics()
+
+
+# ---------------------------------------------------- product integration
+
+
+def test_ssz_root_span_lands_in_default_registry():
+    from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+    from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint
+
+    m = get_metrics()
+    was_enabled = m.enabled
+    m.set_enabled(True)
+    try:
+        with use_chain_spec(minimal_spec()) as spec:
+            before = m.get_histogram("ssz_hash_tree_root_seconds", type="Checkpoint")
+            before_count = before[3] if before else 0
+            Checkpoint(epoch=1, root=b"\x11" * 32).hash_tree_root(spec)
+            _, _, _, count = m.get_histogram(
+                "ssz_hash_tree_root_seconds", type="Checkpoint"
+            )
+            assert count == before_count + 1
+    finally:
+        m.set_enabled(was_enabled)
+
+
+def test_metrics_route_serves_exposition_with_headers():
+    from lambda_ethereum_consensus_tpu.api.beacon_api import BeaconApiServer
+
+    m = Metrics()
+    m.observe("op_seconds", 0.01)
+    server = BeaconApiServer(store=None, spec=None, metrics=m)
+    status, ctype, body = server._metrics()
+    assert status == "200 OK"
+    assert ctype == "text/plain; version=0.0.4"
+    text = body.decode()
+    assert "# TYPE op_seconds histogram" in text
+    assert 'op_seconds_bucket{le="+Inf"} 1' in text
