@@ -17,8 +17,8 @@ tunnel hung the whole run at rc=124 with zero evidence):
 
 - a per-stage wall-clock budget (env-overridable), trimmed so the stage
   SUM fits one bench run's ~2 h budget: SSZ 600 + mainnet 1500 + ingest
-  1620 + boot 600 + registry-planes 300 + telemetry 180 + BLS 2x1200 =
-  7200 s worst case;
+  1500 + boot 600 + registry-planes 300 + telemetry 180 + pipeline 120
+  + BLS 2x1200 = 7200 s worst case;
 - honest absence — a stage that times out/crashes still emits its metric
   lines with ``value: null`` and a note, so "broke" is distinguishable
   from "skipped";
@@ -278,7 +278,7 @@ def main() -> None:
         for rec in _bench_script(
             "bench_ingest.py",
             ("node_ingest_aggregate_verifications_per_sec",),
-            float(os.environ.get("BENCH_INGEST_BUDGET_S", "1620")),
+            float(os.environ.get("BENCH_INGEST_BUDGET_S", "1500")),
             units={"node_ingest_aggregate_verifications_per_sec":
                    "aggregate verifications/s"},
         ):
@@ -299,6 +299,24 @@ def main() -> None:
             float(os.environ.get("BENCH_PLANES_BUDGET_S", "300")),
             units={"registry_planes_resident_bytes": "bytes",
                    "registry_context_rebuild_s": "s"},
+        ):
+            print(json.dumps(rec), flush=True)
+
+    if not os.environ.get("BENCH_NO_PIPELINE"):
+        # ingest scheduler regimes (ISSUE 3): bounded high-priority p95 +
+        # lowest-lane-only shedding under overload, deadline coalescing's
+        # batch-size gain under light load, scheduler overhead — host-only
+        for rec in _bench_script(
+            "bench_pipeline.py",
+            ("pipeline_overload_block_p95_ms",
+             "pipeline_overload_shed_lowest_frac",
+             "pipeline_coalesce_batch_gain",
+             "pipeline_sched_overhead_us_per_item"),
+            float(os.environ.get("BENCH_PIPELINE_BUDGET_S", "120")),
+            units={"pipeline_overload_block_p95_ms": "ms",
+                   "pipeline_overload_shed_lowest_frac": "fraction",
+                   "pipeline_coalesce_batch_gain": "x",
+                   "pipeline_sched_overhead_us_per_item": "us/item"},
         ):
             print(json.dumps(rec), flush=True)
 

@@ -56,14 +56,24 @@ _COEFF_BITS = int(os.environ.get("BLS_RLC_BITS", "64"))
 PointEntry = tuple
 
 
+def device_chain_threshold() -> int:
+    """The ``BLS_DEVICE_CHAIN_MIN`` batch floor — the ONE parse of it:
+    both the routing decision below and the ingest scheduler's
+    coalescing hint (fork_choice.attestation_batch_target) read this,
+    so the two can never disagree on what the threshold means.  A
+    malformed value raises (at node startup via the scheduler build,
+    or at the first verify) — silently falling back to a default would
+    make the misconfiguration invisible."""
+    return int(os.environ.get("BLS_DEVICE_CHAIN_MIN", "128"))
+
+
 def _chain_enabled(n: int) -> bool:
     """Route whole RLC checks through the chained device pipeline
     (:mod:`...ops.bls_batch` — ladders, group sums, Miller, final exp all
     on device, one boolean pulled back).  Default ON on TPU hosts
     (opt-out ``BLS_NO_DEVICE``), force-enable anywhere with
     ``BLS_DEVICE_CHAIN=1``."""
-    threshold = int(os.environ.get("BLS_DEVICE_CHAIN_MIN", "128"))
-    if n < threshold:
+    if n < device_chain_threshold():
         return False
     return env_flag("BLS_DEVICE_CHAIN") or device_default()
 

@@ -13,6 +13,7 @@ Layout: :mod:`.store` (the Store object + constructor), :mod:`.handlers`
 """
 
 from .handlers import (
+    attestation_batch_target,
     on_attestation,
     on_attestation_batch,
     on_attester_slashing,
@@ -28,6 +29,7 @@ __all__ = [
     "ForkTree",
     "LatestMessage",
     "Store",
+    "attestation_batch_target",
     "get_forkchoice_store",
     "get_head",
     "get_weight",

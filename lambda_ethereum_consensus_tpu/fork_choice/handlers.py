@@ -157,6 +157,22 @@ def compute_pulled_up_tip(
 
 # ------------------------------------------------------------- attestation
 
+def attestation_batch_target() -> int:
+    """The smallest attestation batch worth a device dispatch — the
+    ingest scheduler's coalescing target for the attestation lanes.
+
+    Reads the SAME parse ``crypto.bls.batch._chain_enabled`` routes on
+    (``device_chain_threshold``), so the hint and the actual device
+    routing can never disagree — and a malformed env value fails node
+    startup loudly instead of silently coalescing to a default.
+    Clamped to >= 1 because a coalesce target of 0 is meaningless for a
+    flush trigger (a 0 threshold means "device for everything" — flush
+    on any depth)."""
+    from ..crypto.bls.batch import device_chain_threshold
+
+    return max(1, device_chain_threshold())
+
+
 def validate_target_epoch_against_current_time(
     store: Store, attestation: Attestation, spec: ChainSpec
 ) -> None:

@@ -26,6 +26,10 @@ from .port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT, Port
 
 MAX_QUEUE = 1024
 MAX_BATCH = 64
+# shutdown bound on port.unsubscribe: a wedged/dead sidecar that still
+# accepts writes would otherwise hold stop() for the full command
+# timeout (30 s) PER TOPIC — 66 topics of it on a subnet-dense node
+UNSUBSCRIBE_TIMEOUT_S = 2.0
 
 
 def _topic_short(topic: str) -> str:
@@ -59,7 +63,11 @@ BatchHandler = Callable[[list[GossipMessage]], Awaitable[list[int]]]
 
 
 class TopicSubscription:
-    """One topic's queue + batch-drain loop."""
+    """One topic's queue + batch-drain loop — or, when an ingest
+    scheduler is given, one *lane producer*: arrivals are submitted to
+    the shared priority scheduler (pipeline/scheduler.py) instead of a
+    private queue, and this object becomes the lane's flush target
+    (``process``/``shed``) for its topic."""
 
     def __init__(
         self,
@@ -71,6 +79,9 @@ class TopicSubscription:
         max_batch: int = MAX_BATCH,
         max_queue: int = MAX_QUEUE,
         metrics=None,
+        scheduler=None,
+        lane: str | None = None,
+        sink: "SharedLaneSink | None" = None,
     ):
         """``max_batch`` bounds one drain's handler batch.  Attestation
         channels raise it by two orders of magnitude: the device RLC
@@ -93,13 +104,32 @@ class TopicSubscription:
         self.queue: asyncio.Queue = asyncio.Queue(max_queue)
         self._task: asyncio.Task | None = None
         self._handler_error_logged = False  # one traceback per outage
+        if scheduler is not None and lane is None:
+            raise ValueError("scheduler mode requires a lane name")
+        if sink is not None and scheduler is None:
+            raise ValueError("a shared sink only makes sense in scheduler mode")
+        self.scheduler = scheduler
+        self.lane = lane
+        self.sink = sink
 
     async def start(self) -> None:
         await self.port.subscribe(self.topic, self._on_gossip)
-        self._task = asyncio.ensure_future(self._drain_loop())
+        if self.scheduler is None:
+            # standalone mode: this topic drains itself.  In scheduler
+            # mode the shared priority loop owns service order instead.
+            self._task = asyncio.ensure_future(self._drain_loop())
 
     async def stop(self) -> None:
-        await self.port.unsubscribe(self.topic)
+        try:
+            # bounded: a wedged sidecar must not hang node shutdown on
+            # one topic's unsubscribe round-trip
+            await asyncio.wait_for(
+                self.port.unsubscribe(self.topic), UNSUBSCRIBE_TIMEOUT_S
+            )
+        except Exception:  # timeout or a dead port: shutdown proceeds
+            log.warning(
+                "unsubscribe(%s) failed or timed out during shutdown", self.topic
+            )
         self.cancel()
 
     def cancel(self) -> None:
@@ -108,11 +138,47 @@ class TopicSubscription:
             self._task.cancel()
 
     async def _on_gossip(self, topic, msg_id, payload, peer_id) -> None:
+        if self.scheduler is not None:
+            # lane producer: admission (and any cross-lane shedding) is
+            # the scheduler's call; this topic just dispatches the
+            # IGNORE verdicts of whatever was evicted to admit us.  With
+            # a shared sink the item carries its subscription so one
+            # flush can span every topic on the lane.
+            if self.sink is not None:
+                source, item = self.sink, (self, msg_id, payload, peer_id)
+            else:
+                source, item = self, (msg_id, payload, peer_id)
+            for src, it, reason in self.scheduler.submit(self.lane, item, source):
+                await src.shed(it, reason)
+            return
         if self.queue.full():
-            # backpressure: drop and ignore rather than grow unboundedly
+            # backpressure: drop and ignore rather than grow unboundedly —
+            # but COUNT it; a silent drop under overload is indistinguishable
+            # from a hung pipeline on the dashboard
+            get_metrics().inc(
+                "gossip_shed_count", topic=self.topic_label, reason="queue_full"
+            )
             await self.port.validate_message(msg_id, VERDICT_IGNORE)
             return
         self.queue.put_nowait((msg_id, payload, peer_id))
+
+    # ------------------------------------------------- scheduler-lane target
+
+    async def process(self, items: list) -> None:
+        """One lane flush for this topic: the scheduler already shaped
+        the batch (coalescing, DRR bound, shape snapping)."""
+        await self._process_batch(items)
+
+    async def shed(self, item, reason: str = "overload") -> None:
+        """An admission-time eviction of one of this topic's queued
+        messages: count it (under the scheduler's OWN reason, so the
+        per-topic and per-lane shed series never disagree on cause) and
+        IGNORE so the sidecar forgets the id."""
+        msg_id = item[0]
+        get_metrics().inc(
+            "gossip_shed_count", topic=self.topic_label, reason=reason
+        )
+        await self.port.validate_message(msg_id, VERDICT_IGNORE)
 
     async def _drain_loop(self) -> None:
         while True:
@@ -132,54 +198,106 @@ class TopicSubscription:
                 continue
 
     async def _process_batch(self, raw_batch) -> None:
-        # queue depth at drain start: sustained growth here is the first
-        # sign the verify path cannot keep up with gossip arrival
-        self.metrics.set_gauge(
-            "gossip_queue_depth", self.queue.qsize(), topic=self.topic_label
-        )
+        if self.scheduler is None:
+            # queue depth at drain start: sustained growth here is the
+            # first sign the verify path cannot keep up with gossip
+            # arrival (scheduler mode reports ingest_lane_depth instead)
+            self.metrics.set_gauge(
+                "gossip_queue_depth", self.queue.qsize(), topic=self.topic_label
+            )
         with span("gossip_drain", topic=self.topic_label):
-            messages: list[GossipMessage] = []
-            for msg_id, payload, peer_id in raw_batch:
-                # gossip uses *raw* snappy (ref: gossip_consumer.ex:36 :snappyer)
-                try:
-                    data = snappy_decompress(payload)
-                    value = (
-                        self.ssz_type.decode(data, self.spec)
-                        if self.ssz_type is not None
-                        else None
-                    )
-                except Exception:
-                    # any decode failure on attacker-controlled bytes -> reject
-                    await self.port.validate_message(msg_id, VERDICT_REJECT)
-                    continue
-                messages.append(GossipMessage(msg_id, data, peer_id, value))
-            if not messages:
-                return
-            try:
-                verdicts = list(await self.handler(messages))
-                self._handler_error_logged = False  # outage over: re-arm
-            except Exception:
-                # count what a raising handler cost: every item in the
-                # batch is dropped to IGNORE (ADVICE r5: these drops were
-                # invisible — only a dashboard counter makes them a signal)
-                get_metrics().inc(
-                    "gossip_batch_error_count",
-                    value=len(messages),
-                    stage="drain",
-                    topic=self.topic_label,
-                )
-                # one traceback per outage, not per drain: a systemic
-                # failure (dead device tunnel) at gossip cadence would
-                # flood the log and bury its own diagnostic — the counter
-                # above carries the per-drain signal
-                if not self._handler_error_logged:
-                    self._handler_error_logged = True
-                    log.exception("gossip handler failed on %s", self.topic)
-                verdicts = [VERDICT_IGNORE] * len(messages)
-            if len(verdicts) < len(messages):  # short handler output: ignore rest
-                verdicts += [VERDICT_IGNORE] * (len(messages) - len(verdicts))
-            for msg, verdict in zip(messages, verdicts):
-                await self.port.validate_message(msg.msg_id, verdict)
+            await _drain_decode_verify(
+                self,
+                [(self, m, p, pe) for m, p, pe in raw_batch],
+                # this topic's handler keeps its one-subscription shape
+                lambda pairs: self.handler([msg for _, msg in pairs]),
+                metric_topic=self.topic_label,
+                log_name=self.topic,
+            )
+
+
+async def _drain_decode_verify(
+    owner, items, handler, metric_topic: str, log_name: str
+) -> None:
+    """The shared drain tail of both flush targets
+    (``TopicSubscription._process_batch`` and ``SharedLaneSink.process``
+    — two call sites, ONE policy): raw-snappy decode with REJECT on any
+    failure of attacker-controlled bytes (ref: gossip_consumer.ex:36
+    :snappyer), one handler call, error containment (every item in a
+    raising batch drops to IGNORE, counted on
+    ``gossip_batch_error_count`` — ADVICE r5: silent drops look like a
+    hung pipeline — with one traceback per outage via ``owner``'s
+    latch, not one per drain), short-verdict padding, and per-message
+    verdict dispatch.
+
+    ``items`` are ``(subscription, msg_id, payload, peer_id)``;
+    ``handler`` receives ``[(subscription, GossipMessage)]`` pairs.
+    """
+    pairs: list[tuple] = []
+    for sub, msg_id, payload, peer_id in items:
+        try:
+            data = snappy_decompress(payload)
+            value = (
+                sub.ssz_type.decode(data, sub.spec)
+                if sub.ssz_type is not None
+                else None
+            )
+        except Exception:
+            await sub.port.validate_message(msg_id, VERDICT_REJECT)
+            continue
+        pairs.append((sub, GossipMessage(msg_id, data, peer_id, value)))
+    if not pairs:
+        return
+    try:
+        verdicts = list(await handler(pairs))
+        owner._handler_error_logged = False  # outage over: re-arm
+    except Exception:
+        get_metrics().inc(
+            "gossip_batch_error_count",
+            value=len(pairs),
+            stage="drain",
+            topic=metric_topic,
+        )
+        if not owner._handler_error_logged:
+            owner._handler_error_logged = True
+            log.exception("gossip handler failed on %s", log_name)
+        verdicts = [VERDICT_IGNORE] * len(pairs)
+    if len(verdicts) < len(pairs):  # short handler output: ignore rest
+        verdicts += [VERDICT_IGNORE] * (len(pairs) - len(verdicts))
+    for (sub, msg), verdict in zip(pairs, verdicts):
+        await sub.port.validate_message(msg.msg_id, verdict)
+
+
+class SharedLaneSink:
+    """One flush target multiplexing MANY topics of one lane.
+
+    Per-source flush grouping would fragment a coalesced lane batch
+    back into per-topic handler calls — 64 subnet topics sharing a lane
+    would turn a 128-item flush into 64 two-item device dispatches,
+    exactly the batch-of-2 economics the scheduler exists to fix.  A
+    sink makes the whole flush ONE handler call: items arrive as
+    ``(subscription, msg_id, payload, peer_id)``, decode runs per item
+    under each subscription's ssz_type/spec, and ``handler`` receives
+    ``[(subscription, GossipMessage)]`` pairs so e.g. the node can
+    resolve each vote's subnet while verifying every signature in one
+    batched RLC check.
+    """
+
+    def __init__(self, handler, label: str):
+        self.handler = handler
+        self.label = label  # gossip_drain span / error-counter topic label
+        self._handler_error_logged = False
+
+    async def shed(self, item, reason: str = "overload") -> None:
+        sub = item[0]
+        await sub.shed(item[1:], reason)
+
+    async def process(self, items: list) -> None:
+        with span("gossip_drain", topic=self.label):
+            await _drain_decode_verify(
+                self, items, self.handler,
+                metric_topic=self.label, log_name=self.label,
+            )
 
 
 async def publish_ssz(port: Port, topic: str, value, spec: ChainSpec | None = None) -> None:

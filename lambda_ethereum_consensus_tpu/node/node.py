@@ -18,6 +18,7 @@ from ..api.beacon_api import BeaconApiServer
 from ..config import ChainSpec, get_chain_spec
 from ..fork_choice import (
     Store,
+    attestation_batch_target,
     get_forkchoice_store,
     get_head,
     on_attestation_batch,
@@ -28,6 +29,7 @@ from ..network.gossip import TopicSubscription, topic_name
 from ..network.peerbook import Peerbook
 from ..network.port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT
 from ..network.reqresp import BlockDownloader, ReqRespServer
+from ..pipeline import IngestScheduler, LaneConfig
 from ..state_transition import misc
 from ..state_transition.errors import SpecError
 from ..store import BlockStore, KvStore, StateStore
@@ -64,6 +66,23 @@ class NodeConfig:
     # thread at startup (node/warmup.py) — overlaps the ~tens of seconds
     # of first-dispatch program loading with anchor load + sidecar boot
     warm_drain_shapes: object | None = None
+    # shared priority ingest scheduler (pipeline/): one drain over all
+    # gossip topics with deficit-weighted lanes, deadline coalescing and
+    # admission-time shedding.  False reverts to the round-4 per-topic
+    # greedy drains (debug escape hatch).
+    ingest_scheduler: bool = True
+    # per-lane flush deadlines: blocks drain near-immediately; the
+    # attestation lanes trade up to this much latency for device-sized
+    # batches under light load (the shed/deadline regimes are measured
+    # by scripts/bench_pipeline.py)
+    ingest_block_deadline_ms: int = 25
+    ingest_attestation_deadline_ms: int = 150
+    # global admission budget, deliberately BELOW the sum of per-lane
+    # caps (1024 + 2x16384 + 1024): the cross-lane shed policy (evict
+    # the lowest-priority backlogged lane) must engage while the block
+    # and aggregate lanes still have headroom — at the sum, a lane's own
+    # full-check always fires first and the policy would be dead code
+    ingest_max_items: int = 24576
 
 
 class BeaconNode:
@@ -87,6 +106,7 @@ class BeaconNode:
         self.api: BeaconApiServer | None = None
         self._tasks: list[asyncio.Task] = []
         self._subs: list[TopicSubscription] = []
+        self.ingest: IngestScheduler | None = None
         self._stopping = False
         self.device_backend = None
         self._prev_hash_backend = None
@@ -260,11 +280,23 @@ class BeaconNode:
         self.reqresp = ReqRespServer(self.port, self.chain, self.spec)
         await self.reqresp.register()
 
+        # the shared ingest scheduler: one priority drain over every
+        # topic (pipeline/) — a sidecar restart rebuilds it so no lane
+        # holds items bound to dead subscriptions
+        if self.ingest is not None:
+            await self.ingest.stop()
+            self.ingest = None
+        sched = None
+        if self.config.ingest_scheduler:
+            self.ingest = sched = self._build_ingest_scheduler()
+            sched.start()
+
         # gossip topics (ref: gossipsub.ex:16-34 — block + aggregate topics)
         block_topic = topic_name(digest, "beacon_block")
         sub = TopicSubscription(
             self.port, block_topic, self._on_block_batch,
             ssz_type=SignedBeaconBlock, spec=self.spec, metrics=self.metrics,
+            scheduler=sched, lane="block" if sched else None,
         )
         await sub.start()
         self._subs.append(sub)
@@ -277,15 +309,25 @@ class BeaconNode:
             self.port, agg_topic, self._on_aggregate_batch,
             ssz_type=SignedAggregateAndProof, spec=self.spec,
             max_batch=ATT_BATCH, max_queue=ATT_QUEUE, metrics=self.metrics,
+            scheduler=sched, lane="aggregate" if sched else None,
         )
         await agg.start()
         self._subs.append(agg)
         # attestation subnets: unaggregated votes, one topic per subnet,
-        # drained through the SAME batched-RLC verify as aggregates
+        # drained through the SAME batched-RLC verify as aggregates —
+        # and, under the scheduler, one SHARED lane: a flood on any
+        # subnet competes with the other subnets, never with blocks
+        from ..network.gossip import SharedLaneSink
         from ..types.beacon import Attestation
 
         import functools
 
+        # one sink for the whole subnet lane: a flush spanning N subnet
+        # topics is ONE batched verify, not N per-topic fragments
+        subnet_sink = (
+            SharedLaneSink(self._on_subnet_sink_batch, label="subnet_lane")
+            if sched else None
+        )
         for i in subnets:
             sub_topic = topic_name(digest, f"beacon_attestation_{i}")
             att_sub = TopicSubscription(
@@ -293,9 +335,52 @@ class BeaconNode:
                 functools.partial(self._on_attestation_batch, i),
                 ssz_type=Attestation, spec=self.spec,
                 max_batch=ATT_BATCH, max_queue=ATT_QUEUE, metrics=self.metrics,
+                scheduler=sched, lane="subnet" if sched else None,
+                sink=subnet_sink,
             )
             await att_sub.start()
             self._subs.append(att_sub)
+
+    def _build_ingest_scheduler(self) -> IngestScheduler:
+        """Lane model (ISSUE 3 tentpole): blocks > aggregates > subnet
+        attestations > other.  Deficit weights keep the attestation
+        lanes from starving each other while strict priority order
+        keeps block import latency bounded under any flood; the
+        attestation lanes coalesce to the device path's minimum
+        worthwhile batch (fork_choice.attestation_batch_target) and
+        snap flush sizes to the AOT-warmed shape buckets."""
+        cfg = self.config
+        att_deadline = cfg.ingest_attestation_deadline_ms / 1000.0
+        att_target = min(attestation_batch_target(), 8192)
+        sched = IngestScheduler(
+            metrics=self.metrics, max_items=self.config.ingest_max_items
+        )
+        sched.add_lane(LaneConfig(
+            name="block", priority=0, weight=64, max_batch=64, max_queue=1024,
+            deadline_s=cfg.ingest_block_deadline_ms / 1000.0, coalesce_target=1,
+            # blocks chain parent-first: a full lane drops the incoming
+            # message (the old queue-full behavior) rather than evicting
+            # a queued ancestor and orphaning its descendants
+            shed_newest=True,
+        ))
+        sched.add_lane(LaneConfig(
+            name="aggregate", priority=1, weight=4096, max_batch=8192,
+            max_queue=16384, deadline_s=att_deadline,
+            coalesce_target=att_target, shape_kind="attestation_entries",
+        ))
+        sched.add_lane(LaneConfig(
+            name="subnet", priority=2, weight=4096, max_batch=8192,
+            max_queue=16384, deadline_s=att_deadline,
+            coalesce_target=att_target, shape_kind="attestation_entries",
+        ))
+        # catch-all for non-core topics (sync committees, slashings, BLS
+        # changes — future subscriptions); empty until one is wired, and
+        # excluded from the budget picture by the explicit max_items
+        sched.add_lane(LaneConfig(
+            name="other", priority=3, weight=64, max_batch=64, max_queue=1024,
+            deadline_s=0.2, coalesce_target=16,
+        ))
+        return sched
 
     # ------------------------------------------------------------- handlers
 
@@ -415,6 +500,22 @@ class BeaconNode:
         return cps, authoritative, seed
 
     async def _on_attestation_batch(self, subnet: int, batch) -> list[int]:
+        """Standalone-mode entry: one subnet topic's own drain."""
+        return self._subnet_attestation_drain([(subnet, msg) for msg in batch])
+
+    async def _on_subnet_sink_batch(self, pairs) -> list[int]:
+        """Scheduler-mode entry: ONE flush spanning every subscribed
+        subnet topic (gossip.SharedLaneSink) — all votes land in a
+        single batched RLC verify instead of per-topic fragments.  Each
+        vote's subnet comes from its topic name (``beacon_attestation_{i}``,
+        the same authority the per-topic handlers bind at wiring time),
+        so a subscription needs no side-channel attribute to join the
+        sink."""
+        return self._subnet_attestation_drain(
+            [(int(sub.topic_label.rsplit("_", 1)[1]), msg) for sub, msg in pairs]
+        )
+
+    def _subnet_attestation_drain(self, tagged) -> list[int]:
         """Subnet gossip validation (p2p spec beacon_attestation_{i}; ADVICE
         r4: without these REJECTs the node re-propagates misrouted messages
         compliant peers penalize) then the shared batched drain:
@@ -438,10 +539,10 @@ class BeaconNode:
         """
         from ..state_transition.misc import compute_subnet_for_attestation
 
-        verdicts: list[int | None] = [None] * len(batch)
+        verdicts: list[int | None] = [None] * len(tagged)
         passed, passed_pos, passed_keys = [], [], []
         batch_keys: set = set()  # dedupe same-validator cells WITHIN the batch
-        for pos, msg in enumerate(batch):
+        for pos, (subnet, msg) in enumerate(tagged):
             att = msg.value
             bits = att.aggregation_bits
             if bits.count() != 1:
@@ -637,11 +738,15 @@ class BeaconNode:
 
             set_hash_backend(self._prev_hash_backend)
             self.device_backend = None
-        for sub in self._subs:
-            try:
-                await sub.stop()
-            except Exception:
-                pass
+        if self._subs:
+            # concurrent: the per-topic 2 s unsubscribe bound must not
+            # multiply by topic count (66 topics of a wedged sidecar
+            # would stall shutdown ~2 minutes if awaited serially)
+            await asyncio.gather(
+                *(sub.stop() for sub in self._subs), return_exceptions=True
+            )
+        if self.ingest is not None:
+            await self.ingest.stop()
         if self.pending is not None:
             self.pending.stop()
         for t in self._tasks:

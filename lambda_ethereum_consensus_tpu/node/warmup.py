@@ -110,6 +110,13 @@ def start_warmer(shapes: DrainShapes, stats: dict | None = None) -> threading.Th
     in ``stats['error']`` (a silent cold start would corrupt the boot
     timeline's meaning)."""
     stats = stats if stats is not None else {}
+    # advertise the warmed batch shape BEFORE the dispatch: the ingest
+    # scheduler starts snapping flush sizes to this bucket immediately,
+    # so the first real drain lands on the program the warmer is loading
+    # rather than tracing a near-miss shape of its own
+    from ..ops.aot import register_shape_bucket
+
+    register_shape_bucket("attestation_entries", shapes.entries)
 
     def run():
         try:

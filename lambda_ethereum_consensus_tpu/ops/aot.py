@@ -34,13 +34,45 @@ import os
 import pickle
 import threading
 
-__all__ = ["aot_jit", "aot_dir", "aot_stats"]
+__all__ = [
+    "aot_jit",
+    "aot_dir",
+    "aot_stats",
+    "register_shape_bucket",
+    "shape_buckets",
+]
 
 _LOCK = threading.Lock()
 # "retraces": how often a batch-verify entry point had to LOWER (trace) a
 # program for a new argument-shape signature — the per-tick jit-retrace
 # gauge; disk loads deliberately skip tracing and don't count
 _STATS = {"loads": 0, "compiles": 0, "saves": 0, "errors": 0, "retraces": 0}
+
+# Warmed batch-shape buckets, by kind (e.g. "attestation_entries"):
+# node/warmup.py advertises the shapes its dummy drain loads, and the
+# ingest scheduler (pipeline/policy.snap_batch) snaps flush sizes onto
+# them — an off-bucket flush would trace+compile a fresh program
+# mid-drain, which on the tunneled TPU costs 10-80 s of dead air.
+_SHAPE_BUCKETS: dict[str, set[int]] = {}
+
+
+def register_shape_bucket(kind: str, size: int) -> None:
+    """Advertise that a device program for batches of ``size`` items of
+    ``kind`` is warmed (or about to be — the warmer registers before its
+    background dispatch so the scheduler shapes batches for the programs
+    that will be resident by the time real traffic arrives)."""
+    size = int(size)
+    if size <= 0:
+        raise ValueError(f"shape bucket must be positive, got {size}")
+    with _LOCK:
+        _SHAPE_BUCKETS.setdefault(kind, set()).add(size)
+
+
+def shape_buckets(kind: str) -> tuple[int, ...]:
+    """Ascending warmed bucket sizes for ``kind`` (empty when nothing
+    was warmed — the scheduler then flushes unsnapped)."""
+    with _LOCK:
+        return tuple(sorted(_SHAPE_BUCKETS.get(kind, ())))
 
 
 def aot_dir() -> str | None:

@@ -73,6 +73,17 @@ _HELP = {
     "gossip_batch_error_count": "gossip items dropped by internal errors",
     "gossip_queue_depth": "queued gossip messages at drain start",
     "gossip_drain_seconds": "one gossip batch: decode + verify + verdicts",
+    "gossip_shed_count": "gossip messages dropped at admission, by topic/reason",
+    "ingest_lane_depth": "queued items per ingest scheduler lane",
+    "ingest_lane_occupancy": "lane depth over lane capacity (0..1)",
+    "ingest_shed_count": "items shed by the ingest scheduler, by lane/reason",
+    "ingest_flush_count": "lane flushes by trigger (full|deadline)",
+    "ingest_flush_error_count": "items lost to a raising lane flush",
+    "ingest_loop_crash_count": "supervised restarts of the ingest drain loop",
+    "ingest_batch_size": "items per handler call out of the scheduler",
+    "ingest_flush_wait_seconds": "oldest-item queue wait at lane flush",
+    "ingest_sched_seconds": "one scheduling round's bookkeeping (no handler time)",
+    "ingest_degraded": "1 while the load-shedding latch is active",
     "attestation_batch_verify_seconds": "one batched attestation signature check",
     "block_transition_seconds": "full state transition of one block",
     "fork_choice_head_recompute_seconds": "uncached LMD-GHOST head walk",

@@ -1,0 +1,328 @@
+"""Ingest-scheduler bench: both regimes of ISSUE 3 on a synthetic feed.
+
+Three measurements, each one JSON metric line (bench.py's guarded
+subprocess contract), all host-only — the scheduler is pure asyncio and
+its value claims are about QUEUEING, not device math:
+
+1. **Overload** (arrival > service): a subnet-attestation flood at
+   ~1.5x the service rate, steady aggregates, a trickle of blocks.  Claims:
+   block and aggregate p95 drain latency stay bounded while the flood
+   backlogs, and 100% of sheds land on the lowest-priority backlogged
+   lane (the subnet lane) — the newest block on the wire is never the
+   thing dropped.
+2. **Light load** (sparse arrivals): the same feed shape the seed's
+   greedy per-topic drain turns into batch-of-1 handler calls.  Claim:
+   deadline coalescing multiplies the mean verify batch size (the
+   quantity arxiv 2302.00418 says dominates BLS verification economics)
+   at a bounded, configured latency cost.
+3. **Scheduler overhead**: bookkeeping seconds per item through a
+   zero-cost source, from the ``ingest_sched_seconds`` histogram the
+   real node records too — must stay inside the telemetry-class budget
+   (tens of microseconds against a ~200 us drain item).
+
+Usage: python scripts/bench_pipeline.py [--overload-s N] [--light-s N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.pipeline import (  # noqa: E402
+    IngestScheduler,
+    LaneConfig,
+)
+from lambda_ethereum_consensus_tpu.telemetry import Metrics, get_metrics  # noqa: E402
+
+SHED_REASONS = ("lane_full", "overload")
+
+
+class SynthSource:
+    """A lane source with a modeled service cost: fixed per-batch
+    dispatch latency plus a per-item cost — the shape of the real
+    batched verify (fixed device round-trip amortized across items)."""
+
+    def __init__(self, name: str, per_batch_s: float, per_item_s: float):
+        self.name = name
+        self.per_batch_s = per_batch_s
+        self.per_item_s = per_item_s
+        self.latencies: list[float] = []
+        self.batch_sizes: list[int] = []
+        self.sheds = 0
+
+    async def process(self, items):
+        now = time.monotonic()
+        self.batch_sizes.append(len(items))
+        self.latencies.extend(now - t for t, _seq in items)
+        cost = self.per_batch_s + self.per_item_s * len(items)
+        if cost > 0:
+            await asyncio.sleep(cost)
+
+    async def shed(self, _item, reason: str = "overload"):
+        self.sheds += 1
+
+
+def _pctile(xs: list[float], q: float) -> float:
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def _mean(xs) -> float:
+    xs = list(xs)
+    return sum(xs) / len(xs) if xs else float("nan")
+
+
+async def _paced(submit_one, rate_hz: float, duration_s: float):
+    """Credit-paced item generation at ``rate_hz``, in 10 ms ticks
+    (sub-ms sleeps would measure the event loop, not the scheduler).
+    ONE pacing implementation for both regimes — the scheduled-vs-seed
+    mean-batch comparison is only apples-to-apples if the feeds are
+    generated identically."""
+    tick = 0.01
+    per_tick = rate_hz * tick
+    t0 = time.monotonic()
+    seq = 0
+    credit = 0.0
+    while (now := time.monotonic()) - t0 < duration_s:
+        credit += per_tick
+        n, credit = int(credit), credit - int(credit)
+        for _ in range(n):
+            await submit_one(seq)
+            seq += 1
+        await asyncio.sleep(max(0.0, tick - (time.monotonic() - now)))
+
+
+async def _feed(sched, lane, source, rate_hz: float, duration_s: float):
+    async def submit_one(seq):
+        for src, item, reason in sched.submit(
+            lane, (time.monotonic(), seq), source
+        ):
+            await src.shed(item, reason)
+
+    await _paced(submit_one, rate_hz, duration_s)
+
+
+def _shed_counts(lanes: list[str]) -> dict[tuple[str, str], float]:
+    m = get_metrics()
+    return {
+        (lane, r): m.get("ingest_shed_count", lane=lane, reason=r)
+        for lane in lanes
+        for r in SHED_REASONS
+    }
+
+
+async def overload_regime(duration_s: float) -> dict:
+    """Subnet flood at ~1.5x service capacity; blocks/aggregates steady.
+
+    ``max_items`` sits below the sum of lane caps (like the node's own
+    wiring) so admission control engages through whichever branch the
+    backlog equilibrium hits first — the flooded lane's own lane_full
+    cap or the global in-flight-inclusive budget (the split between
+    them is bistable run to run and reported informationally; the
+    deterministic branch coverage lives in tests/unit/test_pipeline.py).
+    The invariant under test here: EVERY shed, from either branch,
+    lands on the lowest-priority backlogged lane."""
+    sched = IngestScheduler(metrics=Metrics(enabled=True), max_items=4500)
+    sched.add_lane(LaneConfig(
+        name="block", priority=0, weight=64, max_batch=64, max_queue=1024,
+        deadline_s=0.025, coalesce_target=1,
+        shed_newest=True,  # mirror the node's block-lane wiring exactly
+    ))
+    sched.add_lane(LaneConfig(
+        name="aggregate", priority=1, weight=512, max_batch=512,
+        max_queue=8192, deadline_s=0.1, coalesce_target=64,
+    ))
+    sched.add_lane(LaneConfig(
+        name="subnet", priority=2, weight=512, max_batch=512,
+        max_queue=4096, deadline_s=0.1, coalesce_target=64,
+    ))
+    # service model: 2 ms dispatch + 20 us/item -> ~40k items/s ceiling;
+    # the subnet feed alone offers 60k/s, so the backlog MUST shed
+    blocks = SynthSource("block", per_batch_s=0.002, per_item_s=20e-6)
+    aggs = SynthSource("aggregate", per_batch_s=0.002, per_item_s=20e-6)
+    votes = SynthSource("subnet", per_batch_s=0.002, per_item_s=20e-6)
+    before = _shed_counts(["block", "aggregate", "subnet"])
+    sched.start()
+    try:
+        await asyncio.gather(
+            _feed(sched, "block", blocks, 20, duration_s),
+            _feed(sched, "aggregate", aggs, 4000, duration_s),
+            _feed(sched, "subnet", votes, 60000, duration_s),
+        )
+        await asyncio.sleep(0.3)  # let the tail drain
+    finally:
+        await sched.stop()
+    after = _shed_counts(["block", "aggregate", "subnet"])
+    shed = {k: after[k] - before[k] for k in after}
+    total_shed = sum(shed.values())
+    subnet_shed = sum(v for (lane, _r), v in shed.items() if lane == "subnet")
+    return {
+        "block_p95_ms": _pctile(blocks.latencies, 0.95) * 1e3,
+        "aggregate_p95_ms": _pctile(aggs.latencies, 0.95) * 1e3,
+        "subnet_p95_ms": _pctile(votes.latencies, 0.95) * 1e3,
+        "shed_total": total_shed,
+        "shed_lane_full": sum(
+            v for (_l, r), v in shed.items() if r == "lane_full"
+        ),
+        "shed_overload": sum(
+            v for (_l, r), v in shed.items() if r == "overload"
+        ),
+        "shed_lowest_frac": (subnet_shed / total_shed) if total_shed else None,
+        "degraded": bool(sched.degraded.active(time.monotonic())),
+        "blocks_processed": sum(blocks.batch_sizes),
+        "votes_processed": sum(votes.batch_sizes),
+    }
+
+
+async def light_regime_scheduled(duration_s: float, rate_hz: float) -> dict:
+    sched = IngestScheduler(metrics=Metrics(enabled=True))
+    sched.add_lane(LaneConfig(
+        name="aggregate", priority=1, weight=512, max_batch=512,
+        max_queue=8192, deadline_s=0.1, coalesce_target=128,
+    ))
+    src = SynthSource("aggregate", per_batch_s=0.0005, per_item_s=10e-6)
+    sched.start()
+    try:
+        await _feed(sched, "aggregate", src, rate_hz, duration_s)
+        await asyncio.sleep(0.2)
+    finally:
+        await sched.stop()
+    return {
+        "mean_batch": _mean(src.batch_sizes),
+        "p95_ms": _pctile(src.latencies, 0.95) * 1e3,
+        "batches": len(src.batch_sizes),
+    }
+
+
+async def light_regime_seed(duration_s: float, rate_hz: float) -> dict:
+    """The seed's greedy drain (network/gossip.py:_drain_loop shape): a
+    private queue per topic, one blocking get, then drain-whatever-is-
+    there — under light load that is batch-of-~1 per handler call."""
+    queue: asyncio.Queue = asyncio.Queue(8192)
+    src = SynthSource("seed", per_batch_s=0.0005, per_item_s=10e-6)
+
+    async def drain_loop():
+        while True:
+            batch = [await queue.get()]
+            while len(batch) < 512 and not queue.empty():
+                batch.append(queue.get_nowait())
+            await src.process(batch)
+
+    task = asyncio.ensure_future(drain_loop())
+
+    async def submit_one(seq):
+        if not queue.full():
+            queue.put_nowait((time.monotonic(), seq))
+
+    await _paced(submit_one, rate_hz, duration_s)
+    await asyncio.sleep(0.2)
+    task.cancel()
+    return {
+        "mean_batch": _mean(src.batch_sizes),
+        "p95_ms": _pctile(src.latencies, 0.95) * 1e3,
+        "batches": len(src.batch_sizes),
+    }
+
+
+async def overhead_probe(n_items: int = 20000) -> dict:
+    """Scheduler bookkeeping per item: flood a zero-cost source and read
+    the ``ingest_sched_seconds`` histogram the loop records (handler
+    time excluded by construction), plus submit() wall time."""
+    m = get_metrics()
+    sched = IngestScheduler(metrics=Metrics(enabled=True))
+    sched.add_lane(LaneConfig(
+        name="l", priority=0, weight=4096, max_batch=4096,
+        max_queue=n_items + 1, deadline_s=0.05, coalesce_target=4096,
+    ))
+    src = SynthSource("l", per_batch_s=0.0, per_item_s=0.0)
+    hist_before = m.get_histogram("ingest_sched_seconds")
+    sum_before = hist_before[2] if hist_before else 0.0
+    t0 = time.perf_counter()
+    for i in range(n_items):
+        sched.submit("l", (time.monotonic(), i), src)
+    submit_s = time.perf_counter() - t0
+    sched.start()
+    try:
+        while sum(src.batch_sizes) < n_items:
+            await asyncio.sleep(0.01)
+    finally:
+        await sched.stop()
+    hist_after = m.get_histogram("ingest_sched_seconds")
+    sched_s = (hist_after[2] if hist_after else 0.0) - sum_before
+    return {
+        "submit_us_per_item": submit_s / n_items * 1e6,
+        "sched_us_per_item": sched_s / n_items * 1e6,
+        "total_us_per_item": (submit_s + sched_s) / n_items * 1e6,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--overload-s", type=float, default=3.0)
+    ap.add_argument("--light-s", type=float, default=2.0)
+    ap.add_argument("--light-rate", type=float, default=200.0)
+    args = ap.parse_args()
+
+    get_metrics().set_enabled(True)  # counters feed the shed accounting
+
+    over = asyncio.run(overload_regime(args.overload_s))
+    light = asyncio.run(light_regime_scheduled(args.light_s, args.light_rate))
+    seed = asyncio.run(light_regime_seed(args.light_s, args.light_rate))
+    cost = asyncio.run(overhead_probe())
+
+    print(json.dumps({
+        "metric": "pipeline_overload_block_p95_ms",
+        "value": round(over["block_p95_ms"], 2),
+        "unit": "ms",
+        "bounded": over["block_p95_ms"] < 250.0,
+        "aggregate_p95_ms": round(over["aggregate_p95_ms"], 2),
+        "subnet_p95_ms": round(over["subnet_p95_ms"], 2),
+        "blocks_processed": over["blocks_processed"],
+        "votes_processed": over["votes_processed"],
+        "degraded_latched": over["degraded"],
+    }), flush=True)
+    print(json.dumps({
+        "metric": "pipeline_overload_shed_lowest_frac",
+        "value": round(over["shed_lowest_frac"], 4)
+        if over["shed_lowest_frac"] is not None else None,
+        "unit": "fraction",
+        "shed_total": over["shed_total"],
+        "shed_lane_full": over["shed_lane_full"],
+        "shed_overload": over["shed_overload"],
+        "note": None if over["shed_total"] else "overload produced no sheds",
+    }), flush=True)
+    gain = (
+        light["mean_batch"] / seed["mean_batch"]
+        if seed["mean_batch"] and seed["mean_batch"] == seed["mean_batch"]
+        else None
+    )
+    print(json.dumps({
+        "metric": "pipeline_coalesce_batch_gain",
+        "value": round(gain, 2) if gain else None,
+        "unit": "x",
+        "scheduled_mean_batch": round(light["mean_batch"], 2),
+        "seed_mean_batch": round(seed["mean_batch"], 2),
+        "scheduled_p95_ms": round(light["p95_ms"], 2),
+        "seed_p95_ms": round(seed["p95_ms"], 2),
+    }), flush=True)
+    print(json.dumps({
+        "metric": "pipeline_sched_overhead_us_per_item",
+        "value": round(cost["total_us_per_item"], 3),
+        "unit": "us/item",
+        "budget_us": 25.0,
+        "within_budget": cost["total_us_per_item"] < 25.0,
+        "submit_us_per_item": round(cost["submit_us_per_item"], 3),
+        "sched_us_per_item": round(cost["sched_us_per_item"], 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
