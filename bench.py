@@ -17,8 +17,10 @@ tunnel hung the whole run at rc=124 with zero evidence):
 
 - a per-stage wall-clock budget (env-overridable), trimmed so the stage
   SUM fits one bench run's ~2 h budget: SSZ 600 + mainnet 1500 + ingest
-  1500 + boot 600 + registry-planes 300 + telemetry 180 + pipeline 120
-  + BLS 2x1200 = 7200 s worst case;
+  1500 + boot 600 + registry-planes 300 + telemetry 120 + pipeline 120
+  + trace 60 + BLS 2x1200 = 7200 s worst case (the telemetry stage gave
+  up 60 s to fund the trace-overhead stage — both finish in well under
+  their budgets);
 - honest absence — a stage that times out/crashes still emits its metric
   lines with ``value: null`` and a note, so "broke" is distinguishable
   from "skipped";
@@ -326,9 +328,22 @@ def main() -> None:
         for rec in _bench_script(
             "bench_telemetry_overhead.py",
             ("telemetry_span_overhead_pct", "telemetry_noop_overhead_pct"),
-            float(os.environ.get("BENCH_TELEMETRY_BUDGET_S", "180")),
+            float(os.environ.get("BENCH_TELEMETRY_BUDGET_S", "120")),
             units={"telemetry_span_overhead_pct": "%",
                    "telemetry_noop_overhead_pct": "%"},
+        ):
+            print(json.dumps(rec), flush=True)
+
+    if not os.environ.get("BENCH_NO_TRACE"):
+        # causal-tracing overhead on the same synthetic drain (ISSUE 4:
+        # full per-item trace sequence <= 3%, TELEMETRY_OFF unchanged
+        # from the PR 2 no-op budget, recorder memory bounded)
+        for rec in _bench_script(
+            "bench_trace_overhead.py",
+            ("trace_overhead_pct", "trace_noop_overhead_pct"),
+            float(os.environ.get("BENCH_TRACE_BUDGET_S", "60")),
+            units={"trace_overhead_pct": "%",
+                   "trace_noop_overhead_pct": "%"},
         ):
             print(json.dumps(rec), flush=True)
 

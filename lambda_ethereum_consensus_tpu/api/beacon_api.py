@@ -9,6 +9,17 @@
 Implemented as a dependency-free asyncio HTTP/1.1 server; the reference's
 v1 state-root route is mostly hardcoded TODOs (v1/beacon_controller.ex:7-60)
 — here every route answers from live chain data.
+
+The ``/debug/*`` surface (this client's addition — the flight-recorder
+debug contract from the causal-tracing round):
+
+- ``GET /debug/trace`` — the flight recorder's ring as Chrome/Perfetto
+  trace-event JSON (load it in https://ui.perfetto.dev or
+  ``chrome://tracing``; ``scripts/trace_dump.py`` fetches and saves it);
+- ``GET /debug/lanes`` — live ingest scheduler/lane snapshot (depths,
+  deficits, oldest waits, degraded latch);
+- ``GET /debug/slot`` — current slot-phase summary (slot, offset,
+  sub-interval, store/head slots) from the node's slot clock.
 """
 
 from __future__ import annotations
@@ -16,11 +27,13 @@ from __future__ import annotations
 import asyncio
 import json
 import re
+import time
 from typing import Callable
 
 from ..config import ChainSpec
 from ..fork_choice import Store, get_head
-from ..telemetry import get_metrics
+from ..telemetry import get_metrics, scrape_stats_lines
+from ..tracing import SlotClock, get_recorder
 
 
 class BeaconApiServer:
@@ -32,6 +45,7 @@ class BeaconApiServer:
         node_id: bytes | None = None,
         port: int = 0,
         host: str = "127.0.0.1",
+        node=None,
     ):
         self.store = store
         self.spec = spec
@@ -39,7 +53,17 @@ class BeaconApiServer:
         self.node_id = node_id
         self.host = host
         self.port = port
+        # the owning BeaconNode (optional): /debug/lanes reads its live
+        # ingest scheduler, /debug/slot prefers its slot clock
+        self.node = node
         self._server: asyncio.AbstractServer | None = None
+
+    # routes served from a worker thread (see _handle): every data
+    # source they touch must be thread-safe on its own.  /metrics is
+    # here because Prometheus scrapes it on a cadence and both
+    # registries render under their own locks; /debug/trace because one
+    # export expands the whole lock-protected recorder ring
+    _OFFLOAD = frozenset({"/debug/trace", "/metrics"})
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -63,7 +87,19 @@ class BeaconApiServer:
                 line = await asyncio.wait_for(reader.readline(), 10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            status, content_type, body = self._route(method, path)
+            if path.split("?", 1)[0] in self._OFFLOAD:
+                # CPU-heavy snapshot routes (a full flight-recorder
+                # export expands ~1e5 event dicts + one multi-MB
+                # json.dumps) must not stall the loop that runs gossip
+                # verdicts and ms-scale flush deadlines; the recorder
+                # is lock-protected so a worker thread is safe
+                status, content_type, body = (
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._route, method, path
+                    )
+                )
+            else:
+                status, content_type, body = self._route(method, path)
             head = (
                 f"HTTP/1.1 {status}\r\n"
                 f"Content-Type: {content_type}\r\n"
@@ -103,6 +139,9 @@ class BeaconApiServer:
             (r"/eth/v1/node/health", self._health),
             (r"/eth/v1/node/identity", self._identity),
             (r"/metrics", self._metrics),
+            (r"/debug/trace", self._debug_trace),
+            (r"/debug/lanes", self._debug_lanes),
+            (r"/debug/slot", self._debug_slot),
         ]
 
     @staticmethod
@@ -213,13 +252,69 @@ class BeaconApiServer:
         skipped from the default render, so a name recorded into both
         (e.g. by a bench script using the module-level helpers) can
         never emit a duplicate TYPE header — which would fail the whole
-        scrape target, not just the colliding family."""
+        scrape target, not just the colliding family.  Both renders run
+        with ``self_scrape=False`` and ONE combined
+        ``telemetry_scrape_seconds``/``telemetry_series_count`` block is
+        appended (per-render stats would duplicate those TYPE headers
+        too)."""
         default = get_metrics()
         if self.metrics is None or self.metrics is default:
             return "200 OK", "text/plain; version=0.0.4", default.render_prometheus().encode()
-        own = self.metrics.render_prometheus().rstrip("\n")
+        t0 = time.perf_counter()
+        own = self.metrics.render_prometheus(self_scrape=False).rstrip("\n")
         rest = default.render_prometheus(
-            skip=self.metrics.family_names()
+            skip=self.metrics.family_names(), self_scrape=False
         ).rstrip("\n")
-        body = ("\n".join(p for p in (own, rest) if p) + "\n").encode()
+        parts = [p for p in (own, rest) if p]
+        if self.metrics.enabled or default.enabled:
+            series = sum(
+                1
+                for p in parts
+                for l in p.splitlines()
+                if not l.startswith("#")
+            )
+            parts.extend(scrape_stats_lines(time.perf_counter() - t0, series))
+        body = ("\n".join(parts) + "\n").encode() if parts else b"\n"
         return "200 OK", "text/plain; version=0.0.4", body
+
+    # --------------------------------------------------------- debug routes
+
+    def _debug_trace(self) -> tuple[str, str, bytes]:
+        """The flight recorder's ring as Chrome/Perfetto trace JSON."""
+        return (
+            "200 OK",
+            "application/json",
+            json.dumps(get_recorder().chrome()).encode(),
+        )
+
+    def _debug_lanes(self) -> tuple[str, str, bytes]:
+        """Live ingest scheduler snapshot (404 when the node runs the
+        standalone per-topic drains or no node is attached)."""
+        ingest = getattr(self.node, "ingest", None)
+        if ingest is None:
+            return self._error(404, "no ingest scheduler attached")
+        snap = ingest.snapshot()
+        snap["recorder"] = get_recorder().stats()
+        return self._json({"data": snap})
+
+    def _debug_slot(self) -> tuple[str, str, bytes]:
+        """Current slot-phase summary from the node's slot clock (built
+        from the store's genesis when no node is attached)."""
+        clock = getattr(self.node, "slot_clock", None)
+        if clock is None:
+            if self.store is None or self.spec is None:
+                return self._error(404, "no slot clock available")
+            clock = SlotClock(
+                int(self.store.genesis_time), int(self.spec.SECONDS_PER_SLOT)
+            )
+        phase = clock.phase(time.time())
+        if self.store is not None:
+            phase["store_slot"] = int(self.store.current_slot(self.spec))
+            cache = getattr(self.store, "head_cache", None)
+            if cache is not None:
+                head = cache.head()
+                head_block = self.store.blocks.get(head)
+                if head_block is not None:
+                    phase["head_slot"] = int(head_block.slot)
+                    phase["head_root"] = "0x" + head.hex()
+        return self._json({"data": phase})

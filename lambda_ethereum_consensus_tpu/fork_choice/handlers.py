@@ -8,6 +8,7 @@ state instead — ref: handlers.ex:80-88 — with the real path parked at
 from __future__ import annotations
 
 import logging
+import time as _time
 
 from ..config import ChainSpec, constants, get_chain_spec
 from ..state_transition import accessors, misc
@@ -301,6 +302,7 @@ def on_attestation_batch(
     attestations: list[Attestation],
     is_from_block: bool = False,
     spec: ChainSpec | None = None,
+    traces: list | None = None,
 ) -> list[ForkChoiceError | None]:
     """Record many attestations with ONE batched signature check.
 
@@ -322,21 +324,33 @@ def on_attestation_batch(
       latest-message/head-cache batch path;
     - **host path**: the per-item ``affine_add`` walk over cached pubkey
       points, for small batches and non-device hosts.
+
+    ``traces`` (position-aligned with ``attestations``, entries may be
+    None) links this ONE batched verify back to its member item traces:
+    the batch span carries the member trace ids, each member records the
+    batch id plus its outcome (``apply`` + the admission→apply latency
+    histogram, or ``drop`` with the error) — the causal fan-in that
+    makes "which flush verified this vote, and with whom" answerable
+    from a ``/debug/trace`` dump.
     """
     from ..crypto.bls.batch import _chain_enabled
 
     spec = spec or get_chain_spec()
     results: list[ForkChoiceError | None] = [None] * len(attestations)
-    if attestations and _chain_enabled(len(attestations)):
-        with span("attestation_batch_verify", path="cached"):
-            _attestation_batch_cached(
-                store, attestations, is_from_block, spec, results
-            )
-        return results
-    with span("attestation_batch_verify", path="host"):
-        return _attestation_batch_host(
-            store, attestations, is_from_block, spec, results
+    cached = bool(attestations) and _chain_enabled(len(attestations))
+    path = "cached" if cached else "host"
+    live_traces = traces is not None and any(t is not None for t in traces)
+    t0 = _time.monotonic() if live_traces else 0.0
+    verify = _attestation_batch_cached if cached else _attestation_batch_host
+    with span("attestation_batch_verify", path=path):
+        verify(store, attestations, is_from_block, spec, results)
+    if live_traces:
+        from ..tracing import record_verify_batch
+
+        record_verify_batch(
+            traces, results, path, t0, _time.monotonic() - t0
         )
+    return results
 
 
 def _attestation_batch_host(

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable
 
@@ -22,6 +23,7 @@ from ..compression.snappy import decompress as snappy_decompress
 from ..config import ChainSpec, get_chain_spec
 from ..state_transition import misc
 from ..telemetry import get_metrics, span
+from ..tracing import new_trace
 from .port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT, Port
 
 MAX_QUEUE = 1024
@@ -57,6 +59,19 @@ class GossipMessage:
     payload: bytes  # decompressed SSZ bytes
     peer_id: bytes
     value: object | None = None  # decoded container (when ssz_type given)
+    trace: object | None = None  # tracing.ItemTrace minted at admission
+
+
+# trace-terminal args, prebuilt and SHARED across items (ItemTrace.end
+# stores them without mutation): one dict per verdict, zero per-item
+# allocations on the verdict-dispatch hot loop
+_VERDICT_END_ARGS = {
+    VERDICT_ACCEPT: {"verdict": "accept"},
+    VERDICT_REJECT: {"verdict": "reject"},
+    VERDICT_IGNORE: {"verdict": "ignore"},
+}
+_DECODE_END_ARGS = {"verdict": "reject"}
+_QUEUE_FULL_ARGS = {"reason": "queue_full"}
 
 
 BatchHandler = Callable[[list[GossipMessage]], Awaitable[list[int]]]
@@ -111,6 +126,10 @@ class TopicSubscription:
         self.scheduler = scheduler
         self.lane = lane
         self.sink = sink
+        # prebuilt standalone-enqueue trace args: the admission callback
+        # runs at gossip arrival rate, so the per-item note must not
+        # allocate (ItemTrace stores shared dicts without mutating them)
+        self._enqueue_args = {"lane": self.topic_label}
 
     async def start(self) -> None:
         await self.port.subscribe(self.topic, self._on_gossip)
@@ -138,6 +157,11 @@ class TopicSubscription:
             self._task.cancel()
 
     async def _on_gossip(self, topic, msg_id, payload, peer_id) -> None:
+        # trace minted at ADMISSION (None when tracing is off): the item
+        # tuple carries it end to end — lane, flush, decode, verify,
+        # verdict — so "where did this message's budget go" is one
+        # /debug/trace lookup instead of histogram archaeology
+        trace = new_trace(self.topic_label)
         if self.scheduler is not None:
             # lane producer: admission (and any cross-lane shedding) is
             # the scheduler's call; this topic just dispatches the
@@ -145,10 +169,12 @@ class TopicSubscription:
             # a shared sink the item carries its subscription so one
             # flush can span every topic on the lane.
             if self.sink is not None:
-                source, item = self.sink, (self, msg_id, payload, peer_id)
+                source, item = self.sink, (self, msg_id, payload, peer_id, trace)
             else:
-                source, item = self, (msg_id, payload, peer_id)
-            for src, it, reason in self.scheduler.submit(self.lane, item, source):
+                source, item = self, (msg_id, payload, peer_id, trace)
+            for src, it, reason in self.scheduler.submit(
+                self.lane, item, source, trace=trace
+            ):
                 await src.shed(it, reason)
             return
         if self.queue.full():
@@ -158,9 +184,13 @@ class TopicSubscription:
             get_metrics().inc(
                 "gossip_shed_count", topic=self.topic_label, reason="queue_full"
             )
+            if trace is not None:
+                trace.end("shed", _QUEUE_FULL_ARGS)
             await self.port.validate_message(msg_id, VERDICT_IGNORE)
             return
-        self.queue.put_nowait((msg_id, payload, peer_id))
+        if trace is not None:
+            trace.note("enqueue", self._enqueue_args)
+        self.queue.put_nowait((msg_id, payload, peer_id, trace))
 
     # ------------------------------------------------- scheduler-lane target
 
@@ -208,7 +238,7 @@ class TopicSubscription:
         with span("gossip_drain", topic=self.topic_label):
             await _drain_decode_verify(
                 self,
-                [(self, m, p, pe) for m, p, pe in raw_batch],
+                [(self, m, p, pe, tr) for m, p, pe, tr in raw_batch],
                 # this topic's handler keeps its one-subscription shape
                 lambda pairs: self.handler([msg for _, msg in pairs]),
                 metric_topic=self.topic_label,
@@ -230,11 +260,11 @@ async def _drain_decode_verify(
     latch, not one per drain), short-verdict padding, and per-message
     verdict dispatch.
 
-    ``items`` are ``(subscription, msg_id, payload, peer_id)``;
+    ``items`` are ``(subscription, msg_id, payload, peer_id, trace)``;
     ``handler`` receives ``[(subscription, GossipMessage)]`` pairs.
     """
     pairs: list[tuple] = []
-    for sub, msg_id, payload, peer_id in items:
+    for sub, msg_id, payload, peer_id, trace in items:
         try:
             data = snappy_decompress(payload)
             value = (
@@ -243,15 +273,19 @@ async def _drain_decode_verify(
                 else None
             )
         except Exception:
+            if trace is not None:
+                trace.end("decode_error", _DECODE_END_ARGS)
             await sub.port.validate_message(msg_id, VERDICT_REJECT)
             continue
-        pairs.append((sub, GossipMessage(msg_id, data, peer_id, value)))
+        pairs.append((sub, GossipMessage(msg_id, data, peer_id, value, trace)))
     if not pairs:
         return
+    handler_failed = False
     try:
         verdicts = list(await handler(pairs))
         owner._handler_error_logged = False  # outage over: re-arm
     except Exception:
+        handler_failed = True
         get_metrics().inc(
             "gossip_batch_error_count",
             value=len(pairs),
@@ -264,7 +298,15 @@ async def _drain_decode_verify(
         verdicts = [VERDICT_IGNORE] * len(pairs)
     if len(verdicts) < len(pairs):  # short handler output: ignore rest
         verdicts += [VERDICT_IGNORE] * (len(pairs) - len(verdicts))
+    end_ts = time.monotonic()  # one clock read for the whole batch
+    end_stage = "error" if handler_failed else "done"
     for (sub, msg), verdict in zip(pairs, verdicts):
+        if msg.trace is not None:
+            msg.trace.end(
+                end_stage,
+                _VERDICT_END_ARGS.get(verdict) or {"verdict": str(verdict)},
+                end_ts,
+            )
         await sub.port.validate_message(msg.msg_id, verdict)
 
 
@@ -276,7 +318,7 @@ class SharedLaneSink:
     would turn a 128-item flush into 64 two-item device dispatches,
     exactly the batch-of-2 economics the scheduler exists to fix.  A
     sink makes the whole flush ONE handler call: items arrive as
-    ``(subscription, msg_id, payload, peer_id)``, decode runs per item
+    ``(subscription, msg_id, payload, peer_id, trace)``, decode runs per item
     under each subscription's ssz_type/spec, and ``handler`` receives
     ``[(subscription, GossipMessage)]`` pairs so e.g. the node can
     resolve each vote's subnet while verifying every signature in one

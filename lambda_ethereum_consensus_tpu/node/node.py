@@ -15,7 +15,7 @@ import time
 from dataclasses import dataclass, field
 
 from ..api.beacon_api import BeaconApiServer
-from ..config import ChainSpec, get_chain_spec
+from ..config import ChainSpec, constants, get_chain_spec
 from ..fork_choice import (
     Store,
     attestation_batch_target,
@@ -33,6 +33,12 @@ from ..pipeline import IngestScheduler, LaneConfig
 from ..state_transition import misc
 from ..state_transition.errors import SpecError
 from ..store import BlockStore, KvStore, StateStore
+from ..tracing import (
+    SlotClock,
+    get_recorder,
+    observe_block_arrival,
+    observe_head_update,
+)
 from ..types.beacon import BeaconBlock, BeaconBlockBody, BeaconState, SignedBeaconBlock
 from ..types.validator import SignedAggregateAndProof
 from .chain import LiveChainView
@@ -41,6 +47,10 @@ from .sync import SyncBlocks
 from .telemetry import Metrics, telemetry_enabled
 
 log = logging.getLogger("node")
+
+# recorder-overwrite counter cursor (see _device_telemetry_tick): the
+# flight recorder is process-wide, so the export cursor must be too
+_trace_dropped_exported = 0
 
 
 @dataclass
@@ -104,6 +114,8 @@ class BeaconNode:
         self.peerbook = Peerbook()
         self.pending: PendingBlocks | None = None
         self.api: BeaconApiServer | None = None
+        self.slot_clock: SlotClock | None = None
+        self._head_root: bytes | None = None  # last head seen by _on_applied
         self._tasks: list[asyncio.Task] = []
         self._subs: list[TopicSubscription] = []
         self.ingest: IngestScheduler | None = None
@@ -140,6 +152,14 @@ class BeaconNode:
         # fork_choice/store.ex:65-82) so blocks are acceptable before the
         # first timer tick
         on_tick(self.store, int(time.time()), spec)
+        # slot-phase clock for the delay histograms and /debug/slot —
+        # pure math over genesis_time/SECONDS_PER_SLOT, shared with the
+        # API server so both report the same slot arithmetic
+        self.slot_clock = SlotClock(
+            int(self.store.genesis_time),
+            int(spec.SECONDS_PER_SLOT),
+            constants.INTERVALS_PER_SLOT,
+        )
         anchor_root = anchor_root or anchor_block.hash_tree_root(spec)
         self.blocks_db.store_block(
             SignedBeaconBlock(message=anchor_block), spec, root=anchor_root
@@ -164,6 +184,7 @@ class BeaconNode:
             metrics=self.metrics,
             node_id=self.port.node_id,
             port=self.config.api_port,
+            node=self,  # /debug/lanes + /debug/slot read live node state
         )
         await self.api.start()
         log.info(
@@ -409,9 +430,25 @@ class BeaconNode:
         for msg in batch:
             block = msg.value
             self.metrics.inc("network_gossip_count", type="beacon_block")
+            if self.slot_clock is not None:
+                # arrival offset into the block's OWN slot: the slot-
+                # phase histogram that says whether blocks reach us in
+                # time to attest (decode follows admission within the
+                # flush deadline, so this is admission-accurate)
+                offset = observe_block_arrival(
+                    self.slot_clock, int(block.message.slot)
+                )
+                if msg.trace is not None:
+                    msg.trace.event(
+                        "slot_phase",
+                        slot=int(block.message.slot),
+                        offset_s=round(offset, 4),
+                    )
             # within-one-epoch window check (ref: gossip_handler.ex:21)
             if abs(block.message.slot - head_slot) <= self.spec.SLOTS_PER_EPOCH:
                 self.pending.add_block(block)
+                if msg.trace is not None:
+                    msg.trace.event("apply", kind="pending_queue")
                 verdicts.append(VERDICT_ACCEPT)
             else:
                 verdicts.append(VERDICT_IGNORE)
@@ -429,7 +466,14 @@ class BeaconNode:
             [extract(msg) for msg in batch],
             is_from_block=False,
             spec=self.spec,
+            # fan-in link: the ONE batched verify span records its
+            # member item traces (and each accepted member observes the
+            # admission->apply slot-phase histogram)
+            traces=[msg.trace for msg in batch],
         )
+        # an attestation batch can reorg the head onto an already-applied
+        # block with no _on_applied involved — observe that too
+        self._observe_head_transition()
         return [
             VERDICT_ACCEPT
             if err is None
@@ -615,6 +659,34 @@ class BeaconNode:
         self.blocks_db.store_block(signed, self.spec)
         self.states_db.store_state(root, self.store.block_states[root], self.spec)
         self.metrics.set_gauge("sync_store_slot", signed.message.slot)
+        self._observe_head_transition()
+
+    def _observe_head_transition(self) -> None:
+        """Record the head-update slot-phase metric whenever the cached
+        fork-choice head differs from the last head we observed — called
+        after block applies AND after attestation batches, so a weight
+        reorg onto an already-applied competing block (no apply involved)
+        still lands in ``head_update_delay_seconds`` and the recorder.
+        Delay is measured against the NEW head block's slot start;
+        catch-up blocks from old slots honestly report huge delays —
+        that is the point."""
+        cache = self.store.head_cache
+        if cache is None or self.slot_clock is None:
+            return
+        head = cache.head()
+        if head is None or head == self._head_root:
+            return
+        head_block = self.store.blocks.get(head)
+        if head_block is None:
+            return
+        self._head_root = head
+        delay = observe_head_update(self.slot_clock, int(head_block.slot))
+        get_recorder().record(
+            "inst", 0, "head_update",
+            {"slot": int(head_block.slot),
+             "root": head.hex()[:16],
+             "delay_s": round(delay, 4)},
+        )
 
     # ---------------------------------------------------------------- loops
 
@@ -640,6 +712,10 @@ class BeaconNode:
                         self.metrics.set_gauge(
                             "fork_choice_head_slot", int(head_block.slot)
                         )
+                    # proposer-boost expiry / checkpoint moves on the
+                    # tick can also flip the head with no apply or
+                    # attestation batch in sight
+                    self._observe_head_transition()
             except Exception:
                 log.exception("tick failed")
 
@@ -710,6 +786,24 @@ class BeaconNode:
         proc_m.set_gauge("bls_aot_retraces", float(stats.get("retraces", 0)))
         proc_m.set_gauge("bls_aot_compiles", float(stats.get("compiles", 0)))
         proc_m.set_gauge("bls_aot_loads", float(stats.get("loads", 0)))
+        # flight-recorder vitals: occupancy + overwrite pressure per tick
+        # (a dropped_total climbing faster than the scrape interval means
+        # the ring window is shorter than the debugging horizon)
+        rec = get_recorder().stats()
+        proc_m.set_gauge("trace_recorder_events", float(rec["events"]))
+        proc_m.set_gauge("trace_recorder_capacity", float(rec["capacity"]))
+        # _total names must expose as counters (rate() on a gauge copy
+        # both under-reports bursts and fails strict counter typing);
+        # the cursor is module-global so co-resident nodes ticking the
+        # same process-wide recorder never double-count the delta
+        global _trace_dropped_exported
+        delta = rec["dropped_total"] - _trace_dropped_exported
+        if delta > 0 and proc_m.enabled:
+            # advance the cursor only when the inc actually records —
+            # otherwise a disabled process registry (node gauges still
+            # on) would silently consume the delta and lose the drops
+            _trace_dropped_exported = rec["dropped_total"]
+            proc_m.inc("trace_recorder_dropped_total", value=delta)
 
     async def _range_sync(self) -> None:
         sync = SyncBlocks(self.store, self.pending, self.downloader, self.spec)

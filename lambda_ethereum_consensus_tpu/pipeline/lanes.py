@@ -1,7 +1,8 @@
 """Bounded priority lanes: the queue shape the ingest scheduler serves.
 
-A lane is a FIFO deque of ``(arrival, item, source)`` entries with two
-flush triggers:
+A lane is a FIFO deque of ``(arrival, item, source, trace)`` entries
+(``trace`` is the item's causal-trace context from :mod:`tracing`, or
+None when tracing is off) with two flush triggers:
 
 - **coalesce target**: the lane is ready the moment its depth reaches
   ``coalesce_target`` — the batch is already worth a device dispatch,
@@ -74,8 +75,8 @@ class Lane:
     def __len__(self) -> int:
         return len(self._items)
 
-    def push(self, arrival: float, item, source) -> None:
-        self._items.append((arrival, item, source))
+    def push(self, arrival: float, item, source, trace=None) -> None:
+        self._items.append((arrival, item, source, trace))
 
     def pop_oldest(self):
         """Shed path: evict the head entry (or None when empty)."""

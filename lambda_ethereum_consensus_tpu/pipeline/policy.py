@@ -63,8 +63,14 @@ class DegradedSignal:
         self.window_s = window_s
         self._last_shed: float | None = None
 
-    def mark(self, now: float) -> None:
+    def mark(self, now: float) -> bool:
+        """Record a shed; returns True when this mark ACTIVATED the
+        latch (it was clear) — the edge the transitions counter and the
+        flight-recorder event key on, so brief degraded episodes between
+        scrapes stay alertable instead of vanishing into a gauge."""
+        activated = not self.active(now)
         self._last_shed = now
+        return activated
 
     def active(self, now: float) -> bool:
         return self._last_shed is not None and (now - self._last_shed) < self.window_s

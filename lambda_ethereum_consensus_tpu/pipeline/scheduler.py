@@ -36,6 +36,7 @@ import logging
 import time
 
 from ..telemetry import get_metrics
+from ..tracing import get_recorder
 from .lanes import Lane, LaneConfig
 from .policy import DegradedSignal, choose_shed_victim, snap_batch
 
@@ -73,6 +74,7 @@ class IngestScheduler:
         self._inflight = 0  # dequeued into a flush that has not finished
         self.degraded = DegradedSignal(degraded_window_s)
         self._flush_error_logged = False
+        self._enqueue_args: dict[str, dict] = {}  # per-lane, see add_lane
         m = get_metrics()
         try:
             m.register_histogram("ingest_batch_size", BATCH_SIZE_BUCKETS)
@@ -92,6 +94,10 @@ class IngestScheduler:
         lane = Lane(config)
         self.lanes[config.name] = lane
         self._order = sorted(self.lanes.values(), key=lambda l: l.config.priority)
+        # prebuilt enqueue-note args: submit() runs at gossip arrival
+        # rate, so the per-item trace note must not allocate (ItemTrace
+        # stores shared dicts without mutating them)
+        self._enqueue_args[config.name] = {"lane": config.name}
         return lane
 
     @property
@@ -123,7 +129,16 @@ class IngestScheduler:
         if exc is None:
             return  # _run never returns normally
         log.error("ingest drain loop crashed; restarting in 1 s", exc_info=exc)
-        get_metrics().inc("ingest_loop_crash_count")
+        m = get_metrics()
+        m.inc("ingest_loop_crash_count")
+        # alertable + trace-dump-visible (a crash-looping drain was
+        # log-only): the counter feeds rate() alerts, the recorder event
+        # puts the restart ON the timeline next to the items it stalled
+        m.inc("pipeline_drain_restarts_total")
+        get_recorder().record(
+            "inst", 0, "drain_restart",
+            {"error": type(exc).__name__, "message": str(exc)},
+        )
         task.get_loop().call_later(1.0, self._restart)
 
     def _restart(self) -> None:
@@ -142,13 +157,18 @@ class IngestScheduler:
 
     # ------------------------------------------------------------- admission
 
-    def submit(self, lane_name: str, item, source) -> list:
+    def submit(self, lane_name: str, item, source, trace=None) -> list:
         """Admit one item; returns ``[(source, item, reason), ...]``
         entries shed to make room (empty in the common case).  The
         CALLER dispatches the sheds' IGNORE verdicts — submit itself
         never awaits, so the gossip callback can run it inline at
         arrival rate.  ``reason`` matches the ``ingest_shed_count``
-        label so per-topic and per-lane shed series agree on cause."""
+        label so per-topic and per-lane shed series agree on cause.
+
+        ``trace`` is the item's causal-trace context (or None): the
+        scheduler owns every termination IT decides — an incoming drop
+        or an eviction ends the trace here with the shed reason, so the
+        flight recorder can answer "why did this item never verify"."""
         lane = self.lanes[lane_name]
         now = time.monotonic()
         victim = reason = None
@@ -163,6 +183,10 @@ class IngestScheduler:
             if victim is None:
                 # every queued item outranks the incoming one: drop it
                 self._count_shed(lane, reason, now)
+                if trace is not None:
+                    trace.end(
+                        "shed", {"reason": reason, "lane": lane_name}, now
+                    )
                 return [(source, item, reason)]
         shed: list = []
         if victim is not None:
@@ -170,20 +194,39 @@ class IngestScheduler:
                 # parent-first lanes (blocks): keep the processable
                 # prefix, drop the incoming item instead of an ancestor
                 self._count_shed(lane, reason, now)
+                if trace is not None:
+                    trace.end(
+                        "shed", {"reason": reason, "lane": lane_name}, now
+                    )
                 return [(source, item, reason)]
             old = victim.pop_oldest()
             if old is not None:
                 self._total -= 1
                 self._count_shed(victim, reason, now)
+                if old[3] is not None:
+                    old[3].end(
+                        "shed",
+                        {"reason": reason, "lane": victim.config.name},
+                        now,
+                    )
                 shed.append((old[2], old[1], reason))
-        lane.push(now, item, source)
+        lane.push(now, item, source, trace)
+        if trace is not None:
+            trace.note("enqueue", self._enqueue_args[lane_name], now)
         self._total += 1
         self._wake.set()
         return shed
 
     def _count_shed(self, lane: Lane, reason: str, now: float) -> None:
         get_metrics().inc("ingest_shed_count", lane=lane.config.name, reason=reason)
-        self.degraded.mark(now)
+        if self.degraded.mark(now):
+            # the latch FLIP, not the level: a sub-scrape-interval
+            # degraded episode still increments, so it alerts
+            get_metrics().inc("ingest_degraded_transitions_total")
+            get_recorder().record(
+                "inst", 0, "ingest_degraded",
+                {"lane": lane.config.name, "reason": reason},
+            )
         self.metrics.set_gauge("ingest_degraded", 1.0)
 
     # ----------------------------------------------------------------- drain
@@ -308,7 +351,12 @@ class IngestScheduler:
         m.observe("ingest_flush_wait_seconds", now - batch[0][0], lane=name)
         groups: dict[int, list] = {}
         sources: dict[int, object] = {}
-        for _arrival, item, source in batch:
+        # one dequeue-args dict SHARED by the whole flush's traces (the
+        # per-item hot loop must not allocate per event)
+        dq_args = {"lane": name, "cause": cause, "batch": len(batch)}
+        for _arrival, item, source, trace in batch:
+            if trace is not None:
+                trace.note("dequeue", dq_args, now)
             groups.setdefault(id(source), []).append(item)
             sources[id(source)] = source
         try:
@@ -324,8 +372,49 @@ class IngestScheduler:
                     # kill the scheduler — but it must be visible:
                     # counter per flush, one traceback per outage
                     m.inc("ingest_flush_error_count", value=len(items), lane=name)
+                    # cold path: re-scan the batch for this group's
+                    # traces rather than taxing the hot loop above with
+                    # a parallel per-item structure
+                    fe_args = {"lane": name}  # shared across the group
+                    for _arrival, _item, source, trace in batch:
+                        if trace is not None and id(source) == sid:
+                            trace.end("flush_error", fe_args)
                     if not self._flush_error_logged:
                         self._flush_error_logged = True
                         log.exception("ingest flush failed on lane %s", name)
         finally:
             self._inflight -= len(batch)
+
+    # -------------------------------------------------------------- debug
+
+    def snapshot(self) -> dict:
+        """Live scheduler/lane state for the ``/debug/lanes`` route —
+        point-in-time reads only, no locking against the drain loop (the
+        event loop serializes us with it)."""
+        now = time.monotonic()
+        lanes = []
+        for lane in self._order:
+            cfg = lane.config
+            head = lane.head_arrival()
+            lanes.append({
+                "name": cfg.name,
+                "priority": cfg.priority,
+                "depth": len(lane),
+                "capacity": cfg.max_queue,
+                "occupancy": round(lane.occupancy(), 4),
+                "deficit": lane.deficit,
+                "weight": cfg.weight,
+                "coalesce_target": cfg.coalesce_target,
+                "deadline_s": cfg.deadline_s,
+                "oldest_wait_s": (
+                    None if head is None else round(now - head, 4)
+                ),
+                "ready": lane.ready(now),
+            })
+        return {
+            "depth": self._total,
+            "inflight": self._inflight,
+            "max_items": self.max_items,
+            "degraded": self.degraded.active(now),
+            "lanes": lanes,
+        }

@@ -48,6 +48,7 @@ __all__ = [
     "get_metrics",
     "inc",
     "observe",
+    "scrape_stats_lines",
     "set_gauge",
     "span",
     "telemetry_enabled",
@@ -101,6 +102,14 @@ _HELP = {
     "bls_aot_retraces": "jit retraces of the batch-verify device programs",
     "bls_aot_compiles": "XLA compiles of the batch-verify device programs",
     "bls_aot_loads": "AOT executable cache loads",
+    "ingest_degraded_transitions_total": "degraded-latch activations (0->1 flips)",
+    "pipeline_drain_restarts_total": "supervised ingest drain-loop restarts",
+    "slot_block_arrival_offset_seconds": "gossip block arrival offset into its slot",
+    "attestation_admit_apply_seconds": "attestation gossip admission -> fork-choice apply",
+    "head_update_delay_seconds": "head update delay after the head block's slot start",
+    "trace_recorder_events": "ring entries held by the flight recorder (one per terminated item trace / batch span / instant)",
+    "trace_recorder_capacity": "flight recorder ring capacity (entries)",
+    "trace_recorder_dropped_total": "flight recorder ring entries overwritten (overwrite-oldest)",
 }
 
 
@@ -410,12 +419,21 @@ class Metrics:
         lines.append(f"# HELP {name} {self._help.get(name) or _HELP.get(name, name)}")
         lines.append(f"# TYPE {name} {typ}")
 
-    def render_prometheus(self, skip=frozenset()) -> str:
+    def render_prometheus(self, skip=frozenset(), self_scrape: bool = True) -> str:
         """Prometheus text exposition format (0.0.4): HELP/TYPE headers
         per family, cumulative histogram buckets, escaped label values.
         Families named in ``skip`` are omitted — the merge-with-another-
         registry path uses this to guarantee a name can never emit two
-        TYPE headers in one scrape (which fails the whole target)."""
+        TYPE headers in one scrape (which fails the whole target).
+
+        ``self_scrape`` appends the exposition's own vitals
+        (``telemetry_scrape_seconds``/``telemetry_series_count``) so a
+        slow or cardinality-exploding scrape is visible from the scrape
+        itself; the merged `/metrics` route renders both registries with
+        ``self_scrape=False`` and appends ONE combined stats block
+        (:func:`scrape_stats_lines`) — two renders appending their own
+        would emit duplicate TYPE headers."""
+        t_start = time.perf_counter()
         lines: list[str] = []
         seen: set[str] = set()
         with self._lock:
@@ -455,7 +473,30 @@ class Metrics:
             )
             lines.append(f"{name}_sum{_labels_text(labels)} {_fmt(h_sum)}")
             lines.append(f"{name}_count{_labels_text(labels)} {h_count}")
+        if self_scrape and self._enabled:
+            # series counted BEFORE the stats block (it describes the
+            # payload, not itself); a disabled registry stays empty so
+            # the no-op contract (zero keys, empty exposition) holds
+            series = sum(1 for l in lines if not l.startswith("#"))
+            lines.extend(
+                scrape_stats_lines(time.perf_counter() - t_start, series)
+            )
         return "\n".join(lines) + "\n"
+
+
+def scrape_stats_lines(scrape_seconds: float, series_count: int) -> list[str]:
+    """The `/metrics` self-observability block: how long this render
+    took and how many sample series it carried.  Synthesized per scrape
+    (never stored — a stored gauge would describe the PREVIOUS scrape),
+    shared by the single-registry renderer and the merged API route."""
+    return [
+        "# HELP telemetry_scrape_seconds wall time spent rendering this exposition",
+        "# TYPE telemetry_scrape_seconds gauge",
+        f"telemetry_scrape_seconds {_fmt(scrape_seconds)}",
+        "# HELP telemetry_series_count sample series in this exposition",
+        "# TYPE telemetry_series_count gauge",
+        f"telemetry_series_count {series_count}",
+    ]
 
 
 # ------------------------------------------------------- default registry

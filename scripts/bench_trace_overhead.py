@@ -1,0 +1,169 @@
+"""Causal-tracing overhead micro-bench on the synthetic gossip drain.
+
+The ISSUE 4 tentpole threads a per-item trace context through admission
+-> lane -> flush -> batched verify -> verdict, so the full event
+sequence a traced item pays (mint + enqueue + dequeue + the batch
+fan-in's verify/apply events + terminal end — ~6 ring appends) must be
+provably cheap.  Acceptance bar: tracing enabled <= 3% of the drain
+item's cost, the ``TELEMETRY_OFF`` path unchanged from PR 2's no-op
+budget (< 0.5% — one module-global read + one attribute check per
+site), and the recorder's memory bounded by its configured capacity.
+
+Measurement mirrors ``bench_telemetry_overhead.py`` (whose helpers this
+script imports): the denominator is the REAL drain item (raw-snappy
+decompress + SSZ ``Attestation`` decode + top-level data root), the
+numerator is a tight paired-delta loop of the exact per-item trace
+sequence in all three modes (base / no-op / enabled), mode order
+rotated per round, median of per-round deltas.  The drain denominator
+runs INSIDE the same rotated rounds as the trace modes — measuring it
+in a separate phase let shared-host frequency drift between the phases
+skew the ratio by a factor of ~2 across runs.
+
+Emits one JSON line per metric (bench.py's guarded-subprocess contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import bench_telemetry_overhead as bto  # noqa: E402  (shared harness)
+
+from lambda_ethereum_consensus_tpu import telemetry, tracing  # noqa: E402
+from lambda_ethereum_consensus_tpu.config import (  # noqa: E402
+    minimal_spec,
+    use_chain_spec,
+)
+from lambda_ethereum_consensus_tpu.tracing import (  # noqa: E402
+    get_recorder,
+    new_trace,
+    record_verify_batch,
+)
+
+
+_DONE_ARGS = {"verdict": "accept"}
+
+
+def _trace_round(n: int) -> None:
+    """The full per-item causal-trace sequence for one n-item flush —
+    exactly the work the pipeline pays, with the same sharing: the
+    enqueue note reuses the submit path's existing arrival clock read,
+    dequeue/end share one args dict and one timestamp per batch, and
+    ONE batch fan-in records verify + apply + the admission->apply
+    histogram per member."""
+    traces = []
+    for _ in range(n):
+        t = new_trace("bench")
+        if t is not None:
+            t.note("enqueue", {"lane": "agg"}, t.t0)
+        traces.append(t)
+    now = time.monotonic()
+    dq_args = {"lane": "agg", "cause": "full", "batch": n}
+    for t in traces:
+        if t is not None:
+            t.note("dequeue", dq_args, now)
+    record_verify_batch(
+        traces, [None] * n, "cached", time.monotonic() - 0.001, 0.001
+    )
+    end_ts = time.monotonic()
+    for t in traces:
+        if t is not None:
+            t.end("done", _DONE_ARGS, end_ts)
+
+
+def _base_round(n: int) -> None:
+    """Loop scaffolding only — the paired-delta baseline."""
+    traces = []
+    for _ in range(n):
+        traces.append(None)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--rounds", type=int, default=51)
+    args = ap.parse_args()
+    n = args.batch
+
+    with use_chain_spec(minimal_spec()) as spec:
+        from lambda_ethereum_consensus_tpu.types.beacon import Attestation
+
+        payloads = bto._payloads(spec, n)
+        metrics = telemetry.get_metrics()
+        rec = get_recorder()
+        was_m, was_rec = metrics.enabled, rec.enabled
+
+        # -- all four measurements rotate within EACH round: the drain
+        # denominator, the loop-scaffolding base, and the trace
+        # sequence in both polarities — per-round ratios cancel
+        # machine-speed drift that separate phases cannot
+        def drain_round():
+            metrics.set_enabled(False)
+            rec.set_enabled(False)
+            bto._drain(payloads, spec, Attestation)
+
+        def on_round():
+            metrics.set_enabled(True)
+            rec.set_enabled(True)
+            _trace_round(n)
+
+        def noop_round():
+            metrics.set_enabled(False)
+            rec.set_enabled(False)
+            _trace_round(n)
+
+        def base_round():
+            _base_round(n)
+
+        drain_round(), on_round(), noop_round()  # warm (memos, ring)
+        med = bto._paired_deltas(
+            {"base": base_round, "noop": noop_round, "on": on_round,
+             "drain": drain_round},
+            args.rounds,
+        )
+        metrics.set_enabled(was_m)
+        rec.set_enabled(was_rec)
+
+        item_s = (med["drain"] + med["base"]) / n  # delta vs ~zero base
+        per_item_on_s = max(0.0, med["on"]) / n
+        per_item_noop_s = max(0.0, med["noop"]) / n
+        stats = rec.stats()
+
+    on_pct = per_item_on_s / item_s * 100.0
+    noop_pct = per_item_noop_s / item_s * 100.0
+    common = {
+        "unit": "%",
+        "batch": n,
+        "rounds": args.rounds,
+        "drain_item_us": round(item_s * 1e6, 2),
+        "recorder_capacity": stats["capacity"],
+        # the ring can never exceed its configured capacity — the bench
+        # just minted rounds*batch*~6 events through it
+        "recorder_bounded": stats["events"] <= stats["capacity"],
+    }
+    print(json.dumps({
+        "metric": "trace_overhead_pct",
+        "value": round(on_pct, 3),
+        "budget_pct": 3.0,
+        "within_budget": on_pct < 3.0,
+        "trace_cost_us": round(per_item_on_s * 1e6, 3),
+        **common,
+    }), flush=True)
+    print(json.dumps({
+        "metric": "trace_noop_overhead_pct",
+        "value": round(noop_pct, 3),
+        "budget_pct": 0.5,
+        "within_budget": noop_pct < 0.5,
+        "noop_cost_us": round(per_item_noop_s * 1e6, 3),
+        **common,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

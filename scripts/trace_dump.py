@@ -1,0 +1,60 @@
+"""Fetch a flight-recorder snapshot from a running node and write
+Perfetto-loadable trace JSON.
+
+Usage::
+
+    python scripts/trace_dump.py --url http://127.0.0.1:4000 \
+        --out trace.json
+
+then open the file in https://ui.perfetto.dev (or ``chrome://tracing``).
+The node serves the snapshot at ``GET /debug/trace`` (api/beacon_api.py);
+this script just validates the payload shape before writing so a partial
+read or an error body never masquerades as a trace file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def fetch_trace(url: str, timeout_s: float = 10.0) -> dict:
+    """GET ``<url>/debug/trace`` and validate the trace-event shape."""
+    endpoint = url.rstrip("/") + "/debug/trace"
+    with urllib.request.urlopen(endpoint, timeout=timeout_s) as resp:
+        payload = json.loads(resp.read().decode())
+    events = payload.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{endpoint} did not return trace-event JSON")
+    return payload
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--url", default="http://127.0.0.1:4000",
+        help="Beacon API base URL (default %(default)s)",
+    )
+    ap.add_argument(
+        "--out", default="trace.json",
+        help="output path for the Perfetto-loadable JSON (default %(default)s)",
+    )
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args()
+    try:
+        payload = fetch_trace(args.url, args.timeout)
+    except (urllib.error.URLError, OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"trace fetch failed: {e}", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(payload, f)
+    n = len(payload["traceEvents"])
+    print(f"wrote {n} trace events to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
