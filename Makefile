@@ -5,12 +5,25 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench clean
+.PHONY: all native test spec-test spec-vectors bench lint clean
 
 all: native
 
 native:
 	$(MAKE) -C native
+
+# Static analysis: graftlint (project-native rules — concurrency,
+# containment, retrace, metric contracts; see ARCHITECTURE.md "Static
+# analysis") + ruff (generic pyflakes-level issues, minimal rule set so
+# style noise never leaks into graftlint's scope).  ruff is optional in
+# the container; skip with a note rather than fail the target.
+lint:
+	python -m tools.graftlint lambda_ethereum_consensus_tpu
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check lambda_ethereum_consensus_tpu tools; \
+	else \
+	  echo "ruff not installed; generic lint skipped"; \
+	fi
 
 # Fast default lane (consensus, network, crypto-host, ssz, spec vectors
 # kept out): target < 5 min on one core.

@@ -18,7 +18,6 @@ import hashlib
 from typing import Sequence
 
 from . import curve as C
-from . import fields as F
 from .curve import DeserializationError
 from .hash_to_curve import DST_POP, hash_to_g2
 from .pairing import pairing_check

@@ -353,6 +353,28 @@ def on_attestation_batch(
     return results
 
 
+class _DrainContainment:
+    """Generic per-item containment for UNEXPECTED drain errors (the
+    ADVICE r5 class; graftlint exception-containment): wrap the exception
+    into an ignore-polarity verdict so one bad message never drops the
+    whole gossip batch, count it, and log the first traceback per drain —
+    a systemic failure (dead device tunnel) stays diagnosable without 8k
+    traceback copies."""
+
+    def __init__(self, where: str):
+        self.where = where
+        self.logged = False
+
+    def verdict(self, e: Exception, count: int = 1, stage: str = "item"):
+        if not self.logged:
+            self.logged = True
+            log.exception("unexpected error in %s", self.where)
+        get_metrics().inc("gossip_batch_error_count", value=count, stage=stage)
+        return ForkChoiceError(
+            f"attestation drain internal error: {type(e).__name__}: {e}"
+        )
+
+
 def _attestation_batch_host(
     store, attestations, is_from_block, spec, results
 ) -> list[ForkChoiceError | None]:
@@ -363,6 +385,7 @@ def _attestation_batch_host(
     from ..state_transition.predicates import indexed_attestation_signature_inputs
 
     prepared = []  # (index, attestation, indexed, point entry)
+    contain = _DrainContainment("host attestation drain")
     for i, attestation in enumerate(attestations):
         try:
             target_state, indexed = _prepare_attestation(
@@ -381,6 +404,9 @@ def _attestation_batch_host(
                 agg_pk = pt if agg_pk is None else g1.affine_add(agg_pk, pt)
             sig_pt = g2_from_bytes(bytes(indexed.signature))
             prepared.append((i, attestation, indexed, (agg_pk, signing_root, sig_pt)))
+        except ForkChoiceError as e:
+            # keep the original verdict (its reject polarity matters)
+            results[i] = e
         except (BlsError, DeserializationError) as e:
             # undecodable signature / bad point: protocol violation
             results[i] = ForkChoiceError(str(e), reject=True)
@@ -388,6 +414,9 @@ def _attestation_batch_host(
             # unknown block, timing, committee mismatch: could be a race
             # or missing context — ignore, don't penalize
             results[i] = ForkChoiceError(str(e))
+        except Exception as e:
+            # unexpected (e.g. an IndexError from a malformed bitfield)
+            results[i] = contain.verdict(e)
     if prepared:
         flags = batch_verify_each_points([entry[3] for entry in prepared])
         for (i, attestation, indexed, _), ok in zip(prepared, flags):
@@ -421,6 +450,7 @@ def _attestation_batch_cached(
     from .attestation import get_attestation_context
 
     pending = []  # (i, att, ctx, cid, attesting, missing, sroot, target_state)
+    contain = _DrainContainment("cached attestation drain")
     for i, attestation in enumerate(attestations):
         try:
             validate_on_attestation(store, attestation, is_from_block, spec)
@@ -443,8 +473,15 @@ def _attestation_batch_cached(
             results[i] = e
         except (BlsError, DeserializationError) as e:
             results[i] = ForkChoiceError(str(e), reject=True)
-        except SpecError as e:
+        except (SpecError, ValueError) as e:
+            # context build / numpy participation split can surface plain
+            # ValueError (bad bitfield buffer, cache shape checks) — same
+            # blast-radius rule as the device-cache loop below
             results[i] = ForkChoiceError(str(e))
+        except Exception as e:
+            # remaining ADVICE r5 gap: the PREP loop lacked the generic
+            # per-item containment the verify loop below already has
+            results[i] = contain.verdict(e)
 
     # one thread-pooled decompression pass (C++ when available) — AFTER
     # validation, so junk that fork choice rejects anyway never costs the
@@ -454,7 +491,6 @@ def _attestation_batch_cached(
     by_ctx: dict[int, list] = {}  # id(ctx) -> [(i, att, attesting, entry)]
     ctxs: dict[int, object] = {}
     host_entries = []  # (i, att, attesting, point-entry) — over-capacity
-    logged_unexpected = False  # one traceback per drain, not one per item
     for (i, attestation, ctx, cid, attesting, missing, signing_root,
          target_state), sig_pt in zip(pending, sig_points):
         try:
@@ -489,16 +525,8 @@ def _attestation_batch_cached(
             # whole gossip batch, repeatedly, for every future drain
             get_metrics().inc("gossip_batch_error_count", stage="item")
             results[i] = ForkChoiceError(str(e))
-        except Exception as e:  # unexpected: contain to the item, but a
-            # systemic failure (dead device tunnel) must stay diagnosable
-            # — log the first traceback per drain, not 8k copies
-            if not logged_unexpected:
-                logged_unexpected = True
-                log.exception("unexpected error in cached attestation drain")
-            get_metrics().inc("gossip_batch_error_count", stage="item")
-            results[i] = ForkChoiceError(
-                f"attestation drain internal error: {type(e).__name__}: {e}"
-            )
+        except Exception as e:  # unexpected: contain to the item
+            results[i] = contain.verdict(e)
 
     accepted = []  # (batch index, ctx, attestation, attesting array)
 
@@ -520,16 +548,9 @@ def _attestation_batch_cached(
                 results[i] = ForkChoiceError(str(e))
             continue
         except Exception as e:  # unexpected device failure: same blast radius
-            if not logged_unexpected:
-                logged_unexpected = True
-                log.exception("unexpected error in cached attestation drain")
-            get_metrics().inc(
-                "gossip_batch_error_count", value=len(group), stage="context"
-            )
+            v = contain.verdict(e, count=len(group), stage="context")
             for i, _, _, _ in group:
-                results[i] = ForkChoiceError(
-                    f"attestation drain internal error: {type(e).__name__}: {e}"
-                )
+                results[i] = v
             continue
         for (i, attestation, attesting, _), ok in zip(group, flags):
             if ok:

@@ -34,6 +34,15 @@ from collections import OrderedDict
 
 from .proto import p2p_pb2, port_pb2
 
+try:
+    # every peer.send_frame() containment site must also catch NoiseError
+    # (encrypt can refuse: nonce exhausted, unfinalized session) or one
+    # bad peer kills a whole broadcast loop
+    from .noise import NoiseError
+except ImportError:  # plaintext-only environment without `cryptography`
+    class NoiseError(Exception):
+        """Never raised here: without `cryptography`, peer.noise stays None."""
+
 MAX_FRAME = 1 << 28
 GOSSIP_SEEN_CAP = 4096
 MAX_DIALED_FROM_EXCHANGE = 32
@@ -87,7 +96,16 @@ class Peer:
         async with self.send_lock:
             # the lock also serializes AEAD nonces (counter per direction)
             if self.noise is not None:
-                raw = self.noise.encrypt(raw)
+                try:
+                    raw = self.noise.encrypt(raw)
+                except NoiseError:
+                    # the send direction is unrecoverable (nonce exhausted
+                    # / cipher desync) but the TCP side may look healthy:
+                    # close so run_peer's read loop tears the peer down —
+                    # containment sites that swallow the raise must not
+                    # leave a zombie mesh member that blackholes gossip
+                    self.writer.close()
+                    raise
             self.writer.write(struct.pack(">I", len(raw)) + raw)
             await self.writer.drain()
 
@@ -372,7 +390,8 @@ class Sidecar:
                 if frame is None:
                     break
                 await self.handle_frame(peer, frame)
-        except (asyncio.IncompleteReadError, asyncio.TimeoutError, OSError):
+        except (asyncio.IncompleteReadError, asyncio.TimeoutError, OSError,
+                NoiseError):
             pass
         finally:
             if peer.node_id and self.peers.get(peer.node_id) is peer:
@@ -434,7 +453,7 @@ class Sidecar:
         getattr(frame, kind).topic = topic
         try:
             await peer.send_frame(frame)
-        except (OSError, ConnectionError):
+        except (OSError, ConnectionError, NoiseError):
             pass
 
     async def _announce_sub(self, topic: str, subscribe: bool) -> None:
@@ -444,7 +463,7 @@ class Sidecar:
         for peer in list(self.peers.values()):
             try:
                 await peer.send_frame(frame)
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError, NoiseError):
                 pass
 
     async def on_graft(self, peer: Peer, topic: str) -> None:
@@ -531,7 +550,7 @@ class Sidecar:
             frame.peer_exchange.addrs.extend(sorted(addrs))
             try:
                 await peer.send_frame(frame)
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError, NoiseError):
                 pass
 
     async def _disconnect(self, peer: Peer) -> None:
@@ -539,7 +558,7 @@ class Sidecar:
         frame.goodbye.reason = 1  # fault
         try:
             await peer.send_frame(frame)
-        except (OSError, ConnectionError):
+        except (OSError, ConnectionError, NoiseError):
             pass
         peer.writer.close()
 
@@ -578,7 +597,7 @@ class Sidecar:
         for peer in self._route_targets(topic, exclude):
             try:
                 await peer.send_frame(frame)
-            except (OSError, ConnectionError):
+            except (OSError, ConnectionError, NoiseError):
                 pass
 
     async def on_gossip(self, peer: Peer, topic: str, payload: bytes) -> None:
@@ -653,7 +672,7 @@ class Sidecar:
         frame.req.payload = req.payload
         try:
             await peer.send_frame(frame)
-        except (OSError, ConnectionError) as e:
+        except (OSError, ConnectionError, NoiseError) as e:
             self.pending_requests.pop(req_id, None)
             await self.result(cmd.id, False, error=f"send: {e}")
             return
@@ -697,7 +716,7 @@ class Sidecar:
         try:
             await peer.send_frame(frame)
             await self.result(cmd.id, True)
-        except (OSError, ConnectionError) as e:
+        except (OSError, ConnectionError, NoiseError) as e:
             await self.result(cmd.id, False, error=f"send: {e}")
 
     async def on_resp(self, peer: Peer, resp: p2p_pb2.Resp) -> None:

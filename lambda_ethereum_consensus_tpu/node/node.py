@@ -31,7 +31,6 @@ from ..network.port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT
 from ..network.reqresp import BlockDownloader, ReqRespServer
 from ..pipeline import IngestScheduler, LaneConfig
 from ..state_transition import misc
-from ..state_transition.errors import SpecError
 from ..store import BlockStore, KvStore, StateStore
 from ..tracing import (
     SlotClock,
@@ -191,6 +190,8 @@ class BeaconNode:
             "node up: p2p=%s api=%s head=%s",
             self.port.listen_port,
             self.api.port,
+            # graftlint: disable=async-blocking — one cold head walk at
+            # the end of startup, before any gossip is flowing
             get_head(self.store, spec).hex()[:16],
         )
 
@@ -241,6 +242,8 @@ class BeaconNode:
 
             state = await sync_from_checkpoint(self.config.checkpoint_sync_url, spec)
             header = state.latest_block_header.copy(
+                # graftlint: disable=async-blocking — one anchor-state root
+                # during startup; nothing else is scheduled on the loop yet
                 state_root=state.hash_tree_root(spec)
             )
             anchor = BeaconBlock(
@@ -259,6 +262,8 @@ class BeaconNode:
                 slot=state.slot,
                 proposer_index=0,
                 parent_root=b"\x00" * 32,
+                # graftlint: disable=async-blocking — genesis-state root at
+                # startup, before the loop serves anything
                 state_root=state.hash_tree_root(spec),
                 body=BeaconBlockBody(),
             )

@@ -38,9 +38,8 @@ import os
 import numpy as np
 
 from ..crypto.bls.fields import BLS_X, BLS_X_IS_NEG
-from . import bigint as BI
 from . import bls_fq12 as FQ
-from .bls_g1 import _ints_batch, _limbs_batch, _use_planes
+from .bls_g1 import _limbs_batch, _use_planes
 
 __all__ = [
     "make_pairing_ops",

@@ -9,10 +9,9 @@ from __future__ import annotations
 
 import os
 
-from .. import types as T
 from ..config import ChainSpec
 from ..crypto import bls
-from ..state_transition import accessors, misc, process_slots
+from ..state_transition import misc, process_slots
 from ..state_transition.core import state_transition
 from ..state_transition.errors import SpecError
 from ..state_transition.mutable import BeaconStateMut

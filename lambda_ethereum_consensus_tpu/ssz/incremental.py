@@ -32,7 +32,6 @@ import numpy as np
 
 from .core import (
     ByteVector,
-    Container,
     List,
     SSZError,
     Uint,

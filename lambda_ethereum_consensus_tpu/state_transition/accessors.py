@@ -7,10 +7,6 @@ BeaconStateMut`; plain containers fall back to list scans.
 
 from __future__ import annotations
 
-from typing import Sequence
-
-import numpy as np
-
 from ..config import ChainSpec, constants, get_chain_spec
 from ..types.beacon import IndexedAttestation, SyncCommittee
 from . import misc

@@ -10,7 +10,6 @@ permutation for the many committee lookups within an epoch.
 from __future__ import annotations
 
 import functools
-import hashlib
 from typing import Sequence
 
 import numpy as np

@@ -17,7 +17,7 @@ from typing import Iterable
 
 import numpy as np
 
-from ..types.beacon import BeaconState, Validator
+from ..types.beacon import BeaconState
 
 _LIST_FIELDS = (
     "block_roots",

@@ -15,21 +15,16 @@ from typing import Sequence
 from ..config import ChainSpec, constants, get_chain_spec
 from ..crypto import bls
 from ..state_transition import accessors, misc, process_slots
-from ..state_transition.core import state_transition
 from ..state_transition.mutable import BeaconStateMut
 from ..types.beacon import (
     Attestation,
     AttestationData,
-    AttesterSlashing,
     BeaconBlock,
     BeaconBlockBody,
     BeaconState,
     Checkpoint,
     ExecutionPayload,
-    ProposerSlashing,
     SignedBeaconBlock,
-    SignedBLSToExecutionChange,
-    SignedVoluntaryExit,
     SyncAggregate,
 )
 

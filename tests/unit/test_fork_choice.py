@@ -446,3 +446,87 @@ def test_update_latest_messages_batch_matches_per_item_ordering(chain):
         assert host[0].root == C and host[0].epoch == 2
         assert host[1].root == B
         assert 2 not in host
+
+
+def test_on_attestation_batch_contains_per_item_errors(chain, monkeypatch):
+    """ADVICE r5 regression (graftlint exception-containment): one item
+    whose per-item prep raises — a SpecError from validation/committee
+    resolution OR an unexpected internal error (IndexError from a
+    malformed bitfield, a device-cache shape check) — must yield ITS
+    error verdict while the rest of the batch still verifies.  Before
+    the containment fix the exception escaped on_attestation_batch and
+    the drain dropped the WHOLE batch with no per-item verdicts,
+    repeatedly, on every future drain.  Covers both drain bodies."""
+    from lambda_ethereum_consensus_tpu.fork_choice import handlers
+    from lambda_ethereum_consensus_tpu.fork_choice import on_attestation_batch
+
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+
+        def make_att(store, root1, anchor_root, committee_index):
+            committee = accessors.get_beacon_committee(
+                store.block_states[root1], 1, committee_index, spec
+            )
+            data = AttestationData(
+                slot=1,
+                index=committee_index,
+                beacon_block_root=root1,
+                source=store.justified_checkpoint,
+                target=Checkpoint(epoch=0, root=anchor_root),
+            )
+            domain = accessors.get_domain(
+                store.block_states[root1], constants.DOMAIN_BEACON_ATTESTER, 0, spec
+            )
+            signing_root = misc.compute_signing_root(data, domain)
+            sigs = [bls.sign(SKS[i], signing_root) for i in committee]
+            return Attestation(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                signature=bls.aggregate(sigs),
+            )
+
+        # one shared chain build: verdicts don't depend on prior vote
+        # state, so both drain bodies run against the same store
+        store, anchor_root = make_store(genesis, anchor_block, spec)
+        on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+        signed1, _ = build_block(genesis, spec, 1)
+        root1 = on_block(store, signed1, spec=spec)
+        good0 = make_att(store, root1, anchor_root, 0)
+        good1 = make_att(store, root1, anchor_root, 1)
+        # SpecError mid-prep: a committee index the target epoch does
+        # not have resolves through validate/get_indexed_attestation
+        bad_spec = good0.copy(data=good0.data.copy(index=10_000))
+        # unexpected internal error mid-prep for ONE marked item
+        marked = good1.copy(data=good1.data.copy(slot=1))
+        real_validate = handlers.validate_on_attestation
+
+        def exploding_validate(store_, att, is_from_block, spec_):
+            if att is marked:
+                raise IndexError("synthetic internal prep error")
+            return real_validate(store_, att, is_from_block, spec_)
+
+        def scenario():
+            monkeypatch.setattr(
+                handlers, "validate_on_attestation", exploding_validate
+            )
+            try:
+                results = on_attestation_batch(
+                    store, [good0, bad_spec, marked, good1], spec=spec
+                )
+            finally:
+                monkeypatch.setattr(
+                    handlers, "validate_on_attestation", real_validate
+                )
+            # per-item verdicts: good items accepted, bad items carry
+            # their OWN errors — the batch was not dropped wholesale
+            assert results[0] is None
+            assert isinstance(results[1], ForkChoiceError)
+            assert isinstance(results[2], ForkChoiceError)
+            assert "internal error" in str(results[2])
+            assert results[3] is None
+            assert get_weight(store, root1, spec) > 0
+
+        scenario()  # host drain
+        monkeypatch.setenv("BLS_DEVICE_CHAIN", "1")
+        monkeypatch.setenv("BLS_DEVICE_CHAIN_MIN", "1")
+        scenario()  # cached device drain (prep loop has its own body)

@@ -1,0 +1,678 @@
+"""graftlint: per-rule firing/passing fixtures, suppression + baseline
+round-trips, and the repo self-check (the package must lint clean)."""
+
+import json
+import textwrap
+from pathlib import Path
+
+from tools.graftlint import Project, make_rules, run_rules
+from tools.graftlint.cli import main as cli_main
+from tools.graftlint.core import apply_baseline, load_baseline, write_baseline
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def lint_sources(tmp_path, sources: dict, rules=None, extra_files: dict = None):
+    """Write ``rel -> source`` files, lint them, return findings."""
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    for rel, content in (extra_files or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    project = Project.load(tmp_path, [tmp_path])
+    return run_rules(project, make_rules(rules))
+
+
+# ----------------------------------------------------------- async-blocking
+
+
+def test_async_blocking_fires(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import time
+
+            async def drain():
+                time.sleep(1.0)
+            """
+        },
+        rules=["async-blocking"],
+    )
+    assert len(findings) == 1 and "time.sleep" in findings[0].message
+
+
+def test_async_blocking_passes_when_executor_wrapped(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+            import time
+
+            async def drain(loop):
+                await asyncio.sleep(1.0)
+                await loop.run_in_executor(None, time.sleep, 1.0)
+            """
+        },
+        rules=["async-blocking"],
+    )
+    assert findings == []
+
+
+def test_async_blocking_resolves_dispatch_tables(tmp_path):
+    """The beacon-api shape: an async handler reaching a blocking route
+    through a sync dispatcher iterating a same-class route table."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "api.py": """
+            class Server:
+                def _routes(self):
+                    return [("/m", self._metrics), ("/h", self._health)]
+
+                def _metrics(self):
+                    return self.registry.render_prometheus()
+
+                def _health(self):
+                    return b"{}"
+
+                def _route(self, path):
+                    for pattern, handler in self._routes():
+                        if pattern == path:
+                            return handler()
+
+                async def handle(self, path):
+                    return self._route(path)
+            """
+        },
+        rules=["async-blocking"],
+    )
+    assert len(findings) == 1
+    assert "render_prometheus" in findings[0].message
+    assert "_route" in findings[0].message
+
+
+# --------------------------------------------------------- await-under-lock
+
+
+def test_await_under_lock_fires(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import threading
+
+            class Recorder:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def export(self, port):
+                    with self._lock:
+                        await port.send(b"x")
+            """
+        },
+        rules=["await-under-lock"],
+    )
+    assert len(findings) == 1 and "await while holding" in findings[0].message
+
+
+def test_await_under_lock_passes_for_asyncio_locks(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import asyncio
+
+            class Sender:
+                def __init__(self):
+                    self.send_lock = asyncio.Lock()
+
+                async def send(self, port):
+                    async with self.send_lock:
+                        await port.send(b"x")
+            """
+        },
+        rules=["await-under-lock"],
+    )
+    assert findings == []
+
+
+def test_await_under_lock_detects_order_cycle(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import threading
+
+            _REC_LOCK = threading.Lock()
+            _REG_LOCK = threading.Lock()
+
+            def record():
+                with _REC_LOCK:
+                    with _REG_LOCK:
+                        pass
+
+            def render():
+                with _REG_LOCK:
+                    with _REC_LOCK:
+                        pass
+            """
+        },
+        rules=["await-under-lock"],
+    )
+    assert len(findings) == 1
+    assert "inconsistent lock acquisition order" in findings[0].message
+
+
+def test_await_under_lock_sees_one_call_level(tmp_path):
+    """A slow/nested acquisition one call deep still builds the edge."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import threading
+
+            IO_LOCK = threading.Lock()
+            STATE_LOCK = threading.Lock()
+
+            def take_state():
+                with STATE_LOCK:
+                    with IO_LOCK:
+                        pass
+
+            def outer():
+                with IO_LOCK:
+                    take_state()
+            """
+        },
+        rules=["await-under-lock"],
+    )
+    assert len(findings) == 1  # A -> B (via call) and B -> A (direct) cycle
+
+
+# ---------------------------------------------------- exception-containment
+
+
+def test_exception_containment_fires(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            class SpecError(Exception):
+                pass
+
+            def expect(ok):
+                if not ok:
+                    raise SpecError("bad")
+
+            def resolve(item):
+                expect(item >= 0)
+                return item
+
+            def drain(items):
+                results = [None] * len(items)
+                for i, item in enumerate(items):
+                    try:
+                        results[i] = resolve(item)
+                    except KeyError:
+                        results[i] = "bad-key"
+                return results
+            """
+        },
+        rules=["exception-containment"],
+    )
+    assert len(findings) == 1
+    assert "SpecError" in findings[0].message
+    assert "drop the whole batch" in findings[0].message
+
+
+def test_exception_containment_passes_when_covered(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            class SpecError(Exception):
+                pass
+
+            class ItemError(SpecError):
+                pass
+
+            def resolve(item):
+                if item < 0:
+                    raise ItemError("bad")
+                return item
+
+            def drain(items):
+                results = [None] * len(items)
+                for i, item in enumerate(items):
+                    try:
+                        results[i] = resolve(item)
+                    except SpecError as e:  # parent class covers the raise
+                        results[i] = e
+                return results
+            """
+        },
+        rules=["exception-containment"],
+    )
+    assert findings == []
+
+
+def test_exception_containment_skips_translation_wrappers(tmp_path):
+    """A handler that re-raises is an error-translation contract, not a
+    containment loop — the crypto aggregate helpers' shape."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            class BlsError(Exception):
+                pass
+
+            class DecodeError(Exception):
+                pass
+
+            def load(raw):
+                if not raw:
+                    raise BlsError("identity")
+                return raw
+
+            def aggregate(keys):
+                acc = None
+                for raw in keys:
+                    try:
+                        acc = (acc or 0) + load(raw)
+                    except DecodeError as e:
+                        raise BlsError(str(e)) from None
+                return acc
+            """
+        },
+        rules=["exception-containment"],
+    )
+    assert findings == []
+
+
+def test_exception_containment_ignores_tries_outside_the_loop(tmp_path):
+    """A try wrapping the WHOLE loop doesn't contain per-item failures —
+    catching there still aborts the iteration and drops every remaining
+    item, so its handlers must not mask the finding (regression: the
+    enclosing-try stack used to cross loop boundaries)."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            class SpecError(Exception):
+                pass
+
+            def resolve(item):
+                if item < 0:
+                    raise SpecError("bad")
+                return item
+
+            def drain(items):
+                results = [None] * len(items)
+                try:
+                    for i, item in enumerate(items):
+                        try:
+                            results[i] = resolve(item)
+                        except KeyError:
+                            results[i] = "bad-key"
+                except SpecError:
+                    results = None  # coarse guard outside the loop
+                return results
+            """
+        },
+        rules=["exception-containment"],
+    )
+    assert len(findings) == 1
+    assert "SpecError" in findings[0].message
+
+
+def test_exception_containment_resolves_method_calls(tmp_path):
+    """The flagship ADVICE-r5 class: an ``obj.method()`` call inside a
+    batch loop resolves through the bare-name method table, so its raise
+    signature reaches the check (regression: tuple candidates used to be
+    dropped on the checking side)."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            class SpecError(Exception):
+                pass
+
+            class AttestationContext:
+                def participation(self, att):
+                    if att is None:
+                        raise SpecError("no bits")
+                    return att
+
+            def drain(ctx, items):
+                results = [None] * len(items)
+                for i, att in enumerate(items):
+                    try:
+                        results[i] = ctx.participation(att)
+                    except ValueError as e:
+                        results[i] = e
+                return results
+            """
+        },
+        rules=["exception-containment"],
+    )
+    assert len(findings) == 1
+    assert "SpecError" in findings[0].message
+
+
+def test_exception_containment_ambiguous_methods_need_agreement(tmp_path):
+    """Several same-named method candidates: only raises shared by ALL of
+    them are attributed (the receiver is one unknown candidate) — e.g. a
+    ``.drain()`` that is asyncio's on one class and a raising mux stream's
+    on another must not flag the asyncio call site."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            class MuxError(Exception):
+                pass
+
+            class MuxStream:
+                def flush_out(self):
+                    raise MuxError("reset")
+
+            class PlainStream:
+                def flush_out(self):
+                    return None
+
+            def broadcast(peers):
+                for peer in peers:
+                    try:
+                        peer.flush_out()
+                    except ConnectionError:
+                        pass
+            """
+        },
+        rules=["exception-containment"],
+    )
+    assert findings == []
+
+
+# ----------------------------------------------------------- retrace-hazard
+
+
+def test_retrace_hazard_fires_on_varying_shape(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def _kernel(xs):
+                return xs * 2
+
+            kernel = jax.jit(_kernel)
+
+            def drain(items):
+                return kernel(jnp.asarray(items))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert len(findings) == 1 and "variable-length" in findings[0].message
+
+
+def test_retrace_hazard_fires_on_varying_scalar(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+
+            def _kernel(xs, n):
+                return xs[:n]
+
+            kernel = jax.jit(_kernel)
+
+            def drain(xs, items):
+                return kernel(xs, len(items))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert len(findings) == 1 and "Python-varying scalar" in findings[0].message
+
+
+def test_retrace_hazard_passes_with_shape_discipline(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import functools
+
+            import jax
+            import jax.numpy as jnp
+
+            def snap_batch(n, buckets):
+                return n
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def kernel(xs, n):
+                return xs[:n]
+
+            def drain(items):
+                n = snap_batch(len(items), (8, 64))
+                padded = items[:n] + [0] * (n - len(items))
+                return kernel(jnp.asarray(padded), n=len(items))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------- metric-contract
+
+
+METRIC_FIXTURE_TELEMETRY = """
+_HELP = {
+    "requests_total": "requests",
+    "queue_depth": "queued items",
+    "phantom_total": "declared but never emitted",
+}
+"""
+
+METRIC_FIXTURE_DASH = json.dumps(
+    {
+        "panels": [
+            {
+                "targets": [
+                    {"expr": "rate(requests_total[5m])", "legendFormat": "{{route}}"},
+                    {"expr": "rate(reqeusts_total[5m])"},
+                    {"expr": "sum by (shard) (queue_depth)"},
+                ]
+            }
+        ]
+    }
+)
+
+
+def test_metric_contract_fires(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "telemetry.py": METRIC_FIXTURE_TELEMETRY,
+            "app.py": """
+            from telemetry import metrics
+
+            def handle(m):
+                m.inc("requests_total", route="/x")
+                m.set_gauge("queue_depth", 3)
+                m.inc("undeclared_total")
+            """,
+        },
+        rules=["metric-contract"],
+        extra_files={"metrics/grafana/dash.json": METRIC_FIXTURE_DASH},
+    )
+    messages = "\n".join(f.message for f in findings)
+    assert "'undeclared_total' is emitted here but missing" in messages
+    assert "'phantom_total' is declared in telemetry._HELP" in messages
+    assert "'reqeusts_total' is never emitted" in messages  # the typo
+    assert "label 'shard' on 'queue_depth'" in messages
+    assert len(findings) == 4
+
+
+def test_metric_contract_passes_when_consistent(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "telemetry.py": """
+            _HELP = {
+                "requests_total": "requests",
+                "drain_seconds": "drain latency",
+            }
+            """,
+            "app.py": """
+            def handle(m):
+                m.inc("requests_total", route="/x")
+                with m.span("drain", topic="blocks"):
+                    pass
+            """,
+        },
+        rules=["metric-contract"],
+        extra_files={
+            "metrics/grafana/dash.json": json.dumps(
+                {
+                    "panels": [
+                        {
+                            "targets": [
+                                {
+                                    "expr": "histogram_quantile(0.99, sum by (le, topic) (rate(drain_seconds_bucket[5m])))",
+                                    "legendFormat": "p99 {{topic}}",
+                                },
+                                {"expr": "rate(requests_total[5m])"},
+                            ]
+                        }
+                    ]
+                }
+            )
+        },
+    )
+    assert findings == []
+
+
+# ------------------------------------------------- suppression and baseline
+
+
+def test_inline_suppression_roundtrip(tmp_path):
+    src = {
+        "mod.py": """
+        import time
+
+        async def drain():
+            time.sleep(1.0)  # graftlint: disable=async-blocking — fixture
+        """
+    }
+    assert lint_sources(tmp_path, src, rules=["async-blocking"]) == []
+    # standalone comment form covers the next line
+    src2 = {
+        "mod2.py": """
+        import time
+
+        async def drain():
+            # graftlint: disable=async-blocking — fixture rationale
+            time.sleep(1.0)
+        """
+    }
+    assert lint_sources(tmp_path, src2, rules=["async-blocking"]) == []
+    # a different rule name does NOT suppress
+    src3 = {
+        "mod3.py": """
+        import time
+
+        async def drain():
+            time.sleep(1.0)  # graftlint: disable=retrace-hazard
+        """
+    }
+    assert len(lint_sources(tmp_path, src3, rules=["async-blocking"])) == 1
+
+
+def test_baseline_roundtrip(tmp_path):
+    sources = {
+        "mod.py": """
+        import time
+
+        async def drain():
+            time.sleep(1.0)
+        """
+    }
+    findings = lint_sources(tmp_path, sources, rules=["async-blocking"])
+    assert len(findings) == 1
+    baseline_path = tmp_path / "baseline.json"
+    write_baseline(baseline_path, findings)
+    accepted = load_baseline(baseline_path)
+    assert findings[0].finding_id in accepted
+    assert apply_baseline(findings, accepted) == []
+    # ids are content-addressed: shifting the line must not invalidate
+    shifted = {"mod.py": "import os\n\n" + textwrap.dedent(sources["mod.py"])}
+    refound = lint_sources(tmp_path, shifted, rules=["async-blocking"])
+    assert len(refound) == 1
+    assert apply_baseline(refound, accepted) == []
+
+
+# ----------------------------------------------------------- CLI + package
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    baseline = tmp_path / "bl.json"
+    rc = cli_main(
+        [str(tmp_path / "mod.py"), "--root", str(tmp_path), "--json",
+         "--baseline", str(baseline)]
+    )
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert len(report["findings"]) == 1
+    assert report["findings"][0]["rule"] == "async-blocking"
+    # accept into baseline, then the same run is clean
+    rc = cli_main(
+        [str(tmp_path / "mod.py"), "--root", str(tmp_path),
+         "--baseline", str(baseline), "--write-baseline"]
+    )
+    capsys.readouterr()
+    assert rc == 0
+    rc = cli_main(
+        [str(tmp_path / "mod.py"), "--root", str(tmp_path),
+         "--baseline", str(baseline)]
+    )
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_list_rules_names_five_active_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in (
+        "async-blocking",
+        "await-under-lock",
+        "exception-containment",
+        "retrace-hazard",
+        "metric-contract",
+    ):
+        assert name in out
+
+
+def test_repo_lints_clean():
+    """The whole package (and the Grafana dashboards) must stay clean
+    under all five rules with the checked-in (empty) baseline — real
+    defects get fixed, intended patterns get inline suppressions."""
+    rc = cli_main(
+        [
+            str(REPO_ROOT / "lambda_ethereum_consensus_tpu"),
+            "--root",
+            str(REPO_ROOT),
+        ]
+    )
+    assert rc == 0

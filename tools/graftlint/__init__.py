@@ -1,0 +1,10 @@
+"""graftlint: AST-based, rule-plugin static analysis for this codebase.
+
+See ARCHITECTURE.md "Static analysis (round 10)" for the rule catalogue
+and tools/graftlint/rules/__init__.py for the plugin contract.
+"""
+
+from .core import Finding, Module, Project, run_rules
+from .rules import ALL_RULES, make_rules
+
+__all__ = ["ALL_RULES", "Finding", "Module", "Project", "make_rules", "run_rules"]

@@ -1,0 +1,114 @@
+"""graftlint CLI: ``python -m tools.graftlint [paths...]``.
+
+Exit codes: 0 = clean (after suppressions + baseline), 1 = findings,
+2 = usage/internal error.  ``--json`` prints a machine-readable report
+for CI; ``--write-baseline`` accepts the current findings into the
+baseline file so later runs only surface NEW findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import Project, apply_baseline, load_baseline, run_rules, write_baseline
+from .rules import ALL_RULES, make_rules
+
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="project-native static analysis (concurrency, containment, "
+        "retrace, and metric contracts)",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        default=["lambda_ethereum_consensus_tpu"],
+        help="files/directories to lint (default: the package)",
+    )
+    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--rules",
+        help="comma-separated rule subset (default: all)",
+    )
+    p.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    p.add_argument(
+        "--root",
+        default=".",
+        help="project root for relative paths + dashboard discovery (default: cwd)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE),
+        help="baseline file of accepted finding ids",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true", help="report baselined findings too"
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="accept current findings into the baseline file",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for cls in ALL_RULES:
+            rule = cls()
+            print(f"{rule.name:24} {rule.description}")
+        return 0
+    try:
+        rules = make_rules(args.rules.split(",") if args.rules else None)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+
+    root = Path(args.root)
+    paths = [Path(p) for p in args.paths]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(
+            f"no such path: {', '.join(str(p) for p in missing)}", file=sys.stderr
+        )
+        return 2
+    project = Project.load(root, paths)
+    findings = run_rules(project, rules)
+
+    baseline_path = Path(args.baseline)
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"baseline: accepted {len(findings)} finding(s) -> {baseline_path}")
+        return 0
+    accepted = set() if args.no_baseline else load_baseline(baseline_path)
+    fresh = apply_baseline(findings, accepted)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "rules": [r.name for r in rules],
+                    "modules": len(project.modules),
+                    "findings": [f.as_dict() for f in fresh],
+                    "baselined": len(findings) - len(fresh),
+                },
+                indent=1,
+            )
+        )
+    else:
+        for f in fresh:
+            print(f.render())
+        baselined = len(findings) - len(fresh)
+        suffix = f" ({baselined} baselined)" if baselined else ""
+        print(
+            f"graftlint: {len(fresh)} finding(s) in {len(project.modules)} "
+            f"module(s), {len(rules)} rule(s){suffix}"
+        )
+    return 1 if fresh else 0

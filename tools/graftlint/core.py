@@ -1,0 +1,240 @@
+"""graftlint core: project model, findings, suppressions, baseline.
+
+The analyzer is a plain-AST framework (no runtime imports of the code it
+checks): a :class:`Project` parses every ``*.py`` file under the given
+paths once, rules walk the shared trees, and findings flow through two
+filters before they reach the exit code — inline suppressions
+(``# graftlint: disable=<rule>``) and the checked-in baseline file.
+
+Finding identity is content-addressed, not line-addressed: the id hashes
+``rule | relative path | enclosing symbol | stripped source line |
+occurrence index`` so a baseline survives unrelated edits that shift
+line numbers, and goes stale exactly when the flagged code itself
+changes — which is when a human should re-look anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+
+SUPPRESS_MARKER = "graftlint:"
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # project-root-relative, posix separators
+    line: int
+    message: str
+    symbol: str = ""  # enclosing function/class, for stable ids + context
+    finding_id: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "id": self.finding_id,
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule}{sym}: {self.message}  (id={self.finding_id})"
+
+
+class Module:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        # line -> set of rule names suppressed there ("all" wildcard).
+        # A standalone suppression comment covers the next code line, an
+        # inline one covers its own line.
+        self.suppressions: dict[int, set[str]] = {}
+        self._collect_suppressions()
+        # line -> enclosing def/class qualname (innermost), for finding ids
+        self._symbols: dict[int, str] = {}
+        self._index_symbols()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _collect_suppressions(self) -> None:
+        pending: set[str] | None = None
+        pending_line = -1
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.source).readline))
+        except tokenize.TokenError:
+            return
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT and SUPPRESS_MARKER in tok.string:
+                rules = _parse_suppression(tok.string)
+                if not rules:
+                    continue
+                line_text = self.lines[tok.start[0] - 1]
+                if line_text.strip().startswith("#"):
+                    # standalone comment: applies to the next code line
+                    pending = rules
+                    pending_line = tok.start[0]
+                else:
+                    self.suppressions.setdefault(tok.start[0], set()).update(rules)
+            elif tok.type not in (
+                tokenize.NL,
+                tokenize.NEWLINE,
+                tokenize.INDENT,
+                tokenize.DEDENT,
+                tokenize.COMMENT,
+            ):
+                if pending is not None and tok.start[0] > pending_line:
+                    self.suppressions.setdefault(tok.start[0], set()).update(pending)
+                    pending = None
+
+    def _index_symbols(self) -> None:
+        def visit(node, prefix: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    end = getattr(child, "end_lineno", child.lineno) or child.lineno
+                    for ln in range(child.lineno, end + 1):
+                        self._symbols[ln] = qual
+                    visit(child, qual)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+
+    def symbol_at(self, line: int) -> str:
+        return self._symbols.get(line, "")
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+
+def _parse_suppression(comment: str) -> set[str]:
+    # "# graftlint: disable=rule-a,rule-b" (anything after is rationale)
+    text = comment.split(SUPPRESS_MARKER, 1)[1].strip()
+    if not text.startswith("disable="):
+        return set()
+    spec = text[len("disable="):].split()[0]
+    return {r.strip() for r in spec.split(",") if r.strip()}
+
+
+class Project:
+    """Every parsed module under the requested paths, plus lazily-built
+    cross-module analyses shared between rules (see rules/common.py)."""
+
+    def __init__(self, root: Path, modules: list[Module]):
+        self.root = root
+        self.modules = modules
+        self.by_rel = {m.rel: m for m in modules}
+        self.caches: dict = {}  # rules stash shared analyses here
+
+    @classmethod
+    def load(cls, root: Path, paths: list[Path]) -> "Project":
+        root = root.resolve()
+        files: list[Path] = []
+        for p in paths:
+            p = p.resolve()
+            if p.is_dir():
+                files.extend(sorted(p.rglob("*.py")))
+            elif p.suffix == ".py":
+                files.append(p)
+        modules = []
+        for f in files:
+            try:
+                rel = f.relative_to(root).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            try:
+                modules.append(Module(f, rel, f.read_text()))
+            except SyntaxError:
+                # unparsable files are a job for the compiler, not a linter
+                continue
+        return cls(root, modules)
+
+    def dotted_name(self, module: Module) -> str:
+        """``lambda_ethereum_consensus_tpu.fork_choice.handlers``-style
+        dotted path for a module (for resolving relative imports)."""
+        rel = module.rel
+        if rel.endswith("/__init__.py"):
+            rel = rel[: -len("/__init__.py")]
+        elif rel.endswith(".py"):
+            rel = rel[:-3]
+        return rel.replace("/", ".")
+
+    def module_by_dotted(self, dotted: str) -> Module | None:
+        return self.by_rel.get(dotted.replace(".", "/") + ".py") or self.by_rel.get(
+            dotted.replace(".", "/") + "/__init__.py"
+        )
+
+
+# ------------------------------------------------------------------ runner
+
+
+def assign_ids(project: Project, findings: list[Finding]) -> None:
+    counts: dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        mod = project.by_rel.get(f.path)
+        line_text = ""
+        if mod and 1 <= f.line <= len(mod.lines):
+            line_text = mod.lines[f.line - 1].strip()
+        key = (f.rule, f.path, f.symbol, line_text)
+        n = counts.get(key, 0)
+        counts[key] = n + 1
+        raw = f"{f.rule}|{f.path}|{f.symbol}|{line_text}|{n}"
+        f.finding_id = hashlib.sha256(raw.encode()).hexdigest()[:12]
+
+
+def run_rules(project: Project, rules: list) -> list[Finding]:
+    findings: list[Finding] = []
+    for rule in rules:
+        for f in rule.check(project):
+            mod = project.by_rel.get(f.path)
+            if mod is not None:
+                if not f.symbol:
+                    f.symbol = mod.symbol_at(f.line)
+                if mod.suppressed(rule.name, f.line):
+                    continue
+            findings.append(f)
+    assign_ids(project, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------- baseline
+
+
+def load_baseline(path: Path) -> set[str]:
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text())
+    return {entry["id"] for entry in data.get("findings", [])}
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    payload = {
+        "comment": (
+            "Accepted graftlint findings. Entries are matched by content-"
+            "addressed id; remove entries to re-surface them."
+        ),
+        "findings": [f.as_dict() for f in findings],
+    }
+    path.write_text(json.dumps(payload, indent=1) + "\n")
+
+
+def apply_baseline(findings: list[Finding], accepted: set[str]) -> list[Finding]:
+    return [f for f in findings if f.finding_id not in accepted]

@@ -1,0 +1,34 @@
+"""graftlint rule registry.
+
+A rule is any object with ``name``, ``description`` and
+``check(project) -> list[Finding]``.  Adding a rule = adding a module
+here and listing its class in :data:`ALL_RULES` (see ARCHITECTURE.md
+"Static analysis" for the authoring contract).
+"""
+
+from __future__ import annotations
+
+from .async_blocking import AsyncBlockingRule
+from .await_under_lock import AwaitUnderLockRule
+from .exception_containment import ExceptionContainmentRule
+from .metric_contract import MetricContractRule
+from .retrace_hazard import RetraceHazardRule
+
+ALL_RULES = [
+    AsyncBlockingRule,
+    AwaitUnderLockRule,
+    ExceptionContainmentRule,
+    RetraceHazardRule,
+    MetricContractRule,
+]
+
+
+def make_rules(names: list[str] | None = None) -> list:
+    rules = [cls() for cls in ALL_RULES]
+    if names is None:
+        return rules
+    by_name = {r.name: r for r in rules}
+    unknown = [n for n in names if n not in by_name]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+    return [by_name[n] for n in names]

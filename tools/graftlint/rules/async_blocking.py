@@ -1,0 +1,262 @@
+"""async-blocking: blocking work on the asyncio event loop.
+
+Flags, inside ``async def`` bodies (directly or through sync helpers the
+function calls), calls that stall the loop that runs gossip verdicts and
+ms-scale flush deadlines:
+
+- classic blockers: ``time.sleep``, sync file/socket/subprocess I/O;
+- device synchronization: ``block_until_ready``, ``jax.device_get``,
+  ``.item()`` on device values;
+- snapshot/exposition helpers that expand large state
+  (``render_prometheus``, the flight recorder's ``chrome()``);
+- the project's span-instrumented CPU-heavy ops (``hash_tree_root``,
+  ``get_head``, ``state_transition``, ``process_slots``) — the telemetry
+  layer gives each of these a latency histogram with multi-second
+  buckets, which is exactly the budget an event loop does not have.
+
+A call is exempt when it is executor-wrapped (an argument of
+``run_in_executor`` / ``asyncio.to_thread``).  Propagation is
+transitive through *same-module* sync functions and methods, including
+one dispatch-table hop: ``handler(...)`` where ``handler`` iterates a
+same-class table method (``for pat, handler in self._routes(): ...``)
+is resolved against the method references that table returns.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Project
+from .common import FuncInfo, call_name, dotted, module_functions, walk_excluding_nested
+
+# terminal call name -> (required dotted prefixes or None, reason)
+_BLOCKING = {
+    "sleep": (("time",), "time.sleep blocks the event loop (use asyncio.sleep)"),
+    "block_until_ready": (None, "device sync blocks until the accelerator finishes"),
+    "device_get": (("jax",), "jax.device_get synchronously copies off-device"),
+    "item": (None, ".item() synchronizes a device value to host"),
+    "urlopen": (None, "sync HTTP I/O"),
+    "system": (("os",), "os.system blocks on a subprocess"),
+    "check_output": (("subprocess",), "sync subprocess I/O"),
+    "check_call": (("subprocess",), "sync subprocess I/O"),
+    "render_prometheus": (None, "full exposition render expands every metric family"),
+    "chrome": (None, "flight-recorder export expands the whole ring"),
+    # only *state* Merkleization (receiver name contains "state"): a whole
+    # BeaconState root is seconds of hashing, a block/header root is not
+    "hash_tree_root": (None, "full-state SSZ Merkleization is span-instrumented as CPU-heavy"),
+    "get_head": (None, "uncached LMD-GHOST head walk is span-instrumented as CPU-heavy"),
+    "state_transition": (None, "full state transition is span-instrumented as CPU-heavy"),
+    "process_slots": (None, "slot processing is span-instrumented as CPU-heavy"),
+}
+_OPEN_REASON = "sync file I/O on the event loop"
+_EXECUTOR_NAMES = {"run_in_executor", "to_thread"}
+
+
+class AsyncBlockingRule:
+    name = "async-blocking"
+    description = "blocking calls inside async def bodies unless executor-wrapped"
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            findings.extend(self._check_module(module))
+        return findings
+
+    # ---------------------------------------------------------------- guts
+
+    def _check_module(self, module: Module) -> list[Finding]:
+        funcs = module_functions(module)
+        by_name: dict[str, FuncInfo] = {}
+        by_class: dict[tuple, FuncInfo] = {}
+        for fi in funcs:
+            if fi.class_name is None:
+                by_name[fi.name] = fi
+            by_class[(fi.class_name, fi.name)] = fi
+
+        direct: dict[str, list] = {}  # qualname -> [(label, reason, line)]
+        edges: dict[str, list] = {}  # qualname -> [(callee qualname, line)]
+        for fi in funcs:
+            d, e = self._scan(fi, by_name, by_class, module)
+            direct[fi.qualname] = d
+            edges[fi.qualname] = e
+
+        # fixpoint over sync functions: what blocking work does calling
+        # this function transitively reach? value: label -> (reason, chain)
+        reach: dict[str, dict] = {}
+        for fi in funcs:
+            if not fi.is_async:
+                reach[fi.qualname] = {
+                    label: (reason, fi.qualname) for label, reason, _ in direct[fi.qualname]
+                }
+        changed = True
+        while changed:
+            changed = False
+            for fi in funcs:
+                if fi.is_async:
+                    continue
+                mine = reach[fi.qualname]
+                for callee, _line in edges[fi.qualname]:
+                    for label, (reason, chain) in reach.get(callee, {}).items():
+                        if label not in mine:
+                            mine[label] = (reason, f"{fi.qualname} -> {chain}")
+                            changed = True
+
+        findings: list[Finding] = []
+        for fi in funcs:
+            if not fi.is_async:
+                continue
+            for label, reason, line in direct[fi.qualname]:
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=module.rel,
+                        line=line,
+                        symbol=fi.qualname,
+                        message=f"blocking call {label} in async def: {reason}",
+                    )
+                )
+            seen: set[tuple] = set()
+            for callee, line in edges[fi.qualname]:
+                for label, (reason, chain) in reach.get(callee, {}).items():
+                    key = (callee, label, line)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(
+                        Finding(
+                            rule=self.name,
+                            path=module.rel,
+                            line=line,
+                            symbol=fi.qualname,
+                            message=(
+                                f"async def reaches blocking call {label}"
+                                f" via {chain}: {reason}"
+                            ),
+                        )
+                    )
+        return findings
+
+    def _scan(self, fi: FuncInfo, by_name, by_class, module: Module):
+        """(direct blocking facts, same-module sync call edges) for one
+        function, with executor-wrapped subtrees exempted."""
+        nodes = walk_excluding_nested(fi.node)
+        exempt: set[int] = set()
+        awaited: set[int] = set()
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                if cname in _EXECUTOR_NAMES:
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        for sub in ast.walk(arg):
+                            exempt.add(id(sub))
+            if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+                awaited.add(id(node.value))
+
+        # dispatch-table origins: local names bound from a call to a
+        # same-scope table provider (for-loop target or plain assignment)
+        providers: dict[str, str] = {}  # local name -> provider qualname
+
+        def provider_of(call: ast.Call) -> str | None:
+            cname = call_name(call)
+            if cname is None:
+                return None
+            if (
+                isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id == "self"
+                and (fi.class_name, cname) in by_class
+            ):
+                return by_class[(fi.class_name, cname)].qualname
+            if isinstance(call.func, ast.Name) and cname in by_name:
+                return by_name[cname].qualname
+            return None
+
+        def bind_targets(target, provider: str) -> None:
+            if isinstance(target, ast.Name):
+                providers[target.id] = provider
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    bind_targets(elt, provider)
+
+        for node in nodes:
+            if isinstance(node, ast.For) and isinstance(node.iter, ast.Call):
+                p = provider_of(node.iter)
+                if p:
+                    bind_targets(node.target, p)
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                p = provider_of(node.value)
+                if p:
+                    for t in node.targets:
+                        bind_targets(t, p)
+
+        direct: list = []
+        edges: list = []
+        for node in nodes:
+            if not isinstance(node, ast.Call) or id(node) in exempt:
+                continue
+            cname = call_name(node)
+            if cname is None:
+                continue
+            dot = dotted(node.func)
+            # direct blocking facts
+            if cname == "open" and isinstance(node.func, ast.Name):
+                direct.append(("open", _OPEN_REASON, node.lineno))
+            elif cname in _BLOCKING:
+                prefixes, reason = _BLOCKING[cname]
+                qualifies = prefixes is None or (
+                    dot is not None and dot.split(".")[0] in prefixes
+                )
+                if cname == "hash_tree_root":
+                    # state-receiver restriction (see _BLOCKING comment)
+                    recv = (
+                        dotted(node.func.value)
+                        if isinstance(node.func, ast.Attribute)
+                        else None
+                    )
+                    qualifies = bool(recv) and "state" in recv.split(".")[-1]
+                if qualifies:
+                    direct.append((cname, reason, node.lineno))
+            if id(node) in awaited:
+                continue  # awaiting a coroutine is not a sync edge
+            # same-module sync call edges
+            target = provider_of(node)
+            if target is not None:
+                edges.append((target, node.lineno))
+            elif isinstance(node.func, ast.Name) and node.func.id in providers:
+                # call through a dispatch-table variable: resolve against
+                # the references the table provider returns
+                table = providers[node.func.id]
+                for ref in self._table_refs(table, by_name, by_class, fi.class_name):
+                    edges.append((ref, node.lineno))
+        return direct, edges
+
+    def _table_refs(self, provider_qual: str, by_name, by_class, class_name):
+        """Method/function references appearing (as values, not calls) in
+        a dispatch-table provider's body."""
+        fi = None
+        for (cls, name), cand in by_class.items():
+            if cand.qualname == provider_qual:
+                fi = cand
+                break
+        if fi is None:
+            fi = by_name.get(provider_qual)
+        if fi is None:
+            return []
+        refs: list[str] = []
+        call_funcs = set()
+        for node in walk_excluding_nested(fi.node):
+            if isinstance(node, ast.Call):
+                call_funcs.add(id(node.func))
+        for node in walk_excluding_nested(fi.node):
+            if id(node) in call_funcs:
+                continue
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and (fi.class_name, node.attr) in by_class
+            ):
+                refs.append(by_class[(fi.class_name, node.attr)].qualname)
+            elif isinstance(node, ast.Name) and node.id in by_name:
+                refs.append(by_name[node.id].qualname)
+        return refs

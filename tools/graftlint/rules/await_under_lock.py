@@ -1,0 +1,198 @@
+"""await-under-lock: event-loop stalls and deadlocks around thread locks.
+
+Three checks over every ``with <lock>:`` block (a lock is any context
+expression whose terminal name matches ``lock``/``mutex``, e.g.
+``self._lock``, ``_LOCK``, ``registry.lock``):
+
+1. **await under lock** — an ``await`` (or ``async with``/``async for``)
+   while holding a ``threading.Lock`` parks the coroutine with the lock
+   held; any thread then blocking on that lock (the recorder ring, the
+   metrics registry) stalls until the event loop resumes the coroutine —
+   and if the loop needs that thread's result, never.
+2. **known-slow call under lock** — ``time.sleep``, device sync
+   (``block_until_ready``/``device_get``), XLA ``lower``/``compile``,
+   and full-exposition renders hold the lock for the whole operation,
+   turning every other acquirer into a convoy.
+3. **lock-order consistency** — acquiring lock B while holding lock A
+   (directly, or one call level deep into same-module functions) adds
+   an A→B edge to a project-wide graph; a cycle in that graph is a
+   latent deadlock between the recorder, registry and scheduler locks.
+   Lock identity is ``module:Class.attr`` so two classes' ``_lock``
+   attributes never alias.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Project
+from .common import call_name, dotted, module_functions, walk_excluding_nested
+
+_SLOW_CALLS = {
+    "sleep": "time.sleep holds the lock while sleeping",
+    "block_until_ready": "device sync under a lock convoys every other acquirer",
+    "device_get": "host-device copy under a lock convoys every other acquirer",
+    "lower": "XLA tracing under a lock can take tens of seconds",
+    "compile": "XLA compilation under a lock can take minutes",
+    "render_prometheus": "full exposition render under a lock blocks every recorder",
+    "urlopen": "network I/O under a lock",
+}
+
+_LOCK_NAME_HINTS = ("lock", "mutex")
+
+
+def _lock_terminal(expr: ast.AST) -> str | None:
+    """The lock-ish terminal name of a with-context expression, or None."""
+    name = dotted(expr)
+    if name is None:
+        return None
+    terminal = name.split(".")[-1].lower()
+    if any(terminal == h or terminal.endswith("_" + h) or terminal == "_" + h
+           for h in _LOCK_NAME_HINTS):
+        return name
+    return None
+
+
+class AwaitUnderLockRule:
+    name = "await-under-lock"
+    description = "await/slow calls while holding a threading lock + lock-order cycles"
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        # lock-order edges: (lockA id, lockB id) -> (module rel, line)
+        order_edges: dict[tuple[str, str], tuple[str, int]] = {}
+        for module in project.modules:
+            findings.extend(self._check_module(module, project, order_edges))
+        findings.extend(self._check_cycles(order_edges))
+        return findings
+
+    # ---------------------------------------------------------------- guts
+
+    def _check_module(self, module: Module, project: Project, order_edges) -> list[Finding]:
+        findings: list[Finding] = []
+        funcs = module_functions(module)
+        # function name -> lock ids its body acquires directly (for the
+        # one-level interprocedural order edges)
+        acquires: dict[str, set[str]] = {}
+        for fi in funcs:
+            mine: set[str] = set()
+            for node in walk_excluding_nested(fi.node):
+                for lock_id, _item in self._lock_items(node, module, fi):
+                    mine.add(lock_id)
+            acquires[fi.name] = acquires.get(fi.name, set()) | mine
+
+        for fi in funcs:
+            for node in walk_excluding_nested(fi.node):
+                for lock_id, item in self._lock_items(node, module, fi):
+                    findings.extend(
+                        self._check_body(
+                            node, item, lock_id, module, fi, acquires, order_edges
+                        )
+                    )
+        return findings
+
+    def _lock_items(self, node: ast.AST, module: Module, fi):
+        """``(lock id, withitem)`` for THREAD-lock acquisitions: sync
+        ``with`` only — ``async with`` means an asyncio.Lock, which is
+        designed to be awaited under."""
+        if not isinstance(node, ast.With):
+            return
+        for item in node.items:
+            name = _lock_terminal(item.context_expr)
+            if name is None:
+                continue
+            scope = fi.class_name if name.startswith("self.") else ""
+            attr = name.split(".")[-1]
+            yield f"{module.rel}:{scope + '.' if scope else ''}{attr}", item
+
+    def _check_body(self, with_node, item, lock_id, module, fi, acquires, order_edges):
+        findings: list[Finding] = []
+        body_nodes: list[ast.AST] = []
+        stack = list(with_node.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            body_nodes.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+
+        for node in body_nodes:
+            if isinstance(node, (ast.Await, ast.AsyncWith, ast.AsyncFor)):
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=module.rel,
+                        line=node.lineno,
+                        symbol=fi.qualname,
+                        message=(
+                            f"await while holding {lock_id.split(':')[-1]}: the "
+                            "coroutine parks with the lock held and every thread "
+                            "contending on it stalls behind the event loop"
+                        ),
+                    )
+                )
+            elif isinstance(node, ast.Call):
+                cname = call_name(node)
+                if cname in _SLOW_CALLS:
+                    dot = dotted(node.func) or ""
+                    if cname == "sleep" and not dot.startswith("time"):
+                        continue
+                    findings.append(
+                        Finding(
+                            rule=self.name,
+                            path=module.rel,
+                            line=node.lineno,
+                            symbol=fi.qualname,
+                            message=(
+                                f"slow call {cname} while holding "
+                                f"{lock_id.split(':')[-1]}: {_SLOW_CALLS[cname]}"
+                            ),
+                        )
+                    )
+                elif cname in acquires:
+                    # one call level deep: callee acquires its own lock(s)
+                    for inner in acquires[cname]:
+                        if inner != lock_id:
+                            order_edges.setdefault(
+                                (lock_id, inner), (module.rel, node.lineno)
+                            )
+            # directly nested lock acquisition
+            for inner_id, _item in self._lock_items(node, module, fi):
+                if inner_id != lock_id:
+                    order_edges.setdefault(
+                        (lock_id, inner_id), (module.rel, node.lineno)
+                    )
+        return findings
+
+    def _check_cycles(self, order_edges) -> list[Finding]:
+        graph: dict[str, set[str]] = {}
+        for a, b in order_edges:
+            graph.setdefault(a, set()).add(b)
+        findings: list[Finding] = []
+        reported: set[frozenset] = set()
+        for start in graph:
+            stack = [(start, [start])]
+            while stack:
+                cur, path = stack.pop()
+                for nxt in graph.get(cur, ()):
+                    if nxt == start and len(path) > 1:
+                        key = frozenset(path)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        rel, line = order_edges[(cur, start)]
+                        findings.append(
+                            Finding(
+                                rule=self.name,
+                                path=rel,
+                                line=line,
+                                message=(
+                                    "inconsistent lock acquisition order: "
+                                    + " -> ".join(path + [start])
+                                    + " (latent deadlock)"
+                                ),
+                            )
+                        )
+                    elif nxt not in path:
+                        stack.append((nxt, path + [nxt]))
+        return findings

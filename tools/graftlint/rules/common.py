@@ -1,0 +1,204 @@
+"""Shared AST analyses for graftlint rules.
+
+Everything here is name-based static analysis: no imports of the checked
+code, no type inference.  Resolution is deliberately conservative —
+same-module functions, same-class methods, project-relative ``from``
+imports, and (for attribute calls) a project-wide method table capped at
+a small ambiguity limit — because a project linter that guesses wrong is
+worse than one that stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Module, Project
+
+# ------------------------------------------------------------- call names
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """The terminal name of a call: ``f`` for ``f(...)``, ``m`` for
+    ``obj.x.m(...)``."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def is_self_call(call: ast.Call) -> bool:
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "self"
+    )
+
+
+# ---------------------------------------------------------- function index
+
+
+class FuncInfo:
+    __slots__ = ("node", "module", "name", "qualname", "class_name", "is_async")
+
+    def __init__(self, node, module: Module, class_name: str | None):
+        self.node = node
+        self.module = module
+        self.name = node.name
+        self.class_name = class_name
+        self.qualname = f"{class_name}.{node.name}" if class_name else node.name
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+
+
+def module_functions(module: Module) -> list[FuncInfo]:
+    """Every function/method in a module (not nested defs)."""
+    out: list[FuncInfo] = []
+    for node in module.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(FuncInfo(node, module, None))
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append(FuncInfo(item, module, node.name))
+    return out
+
+
+def walk_excluding_nested(func_node) -> list[ast.AST]:
+    """All nodes of a function body, excluding nested function/class
+    scopes (their calls are not this function's calls)."""
+    out: list[ast.AST] = []
+    stack: list[ast.AST] = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+# -------------------------------------------------------------- import map
+
+
+def import_map(module: Module, project: Project) -> dict[str, str]:
+    """Local name -> absolute dotted target for ``import``/``from``
+    statements (relative imports resolved against the module path)."""
+    base = project.dotted_name(module).split(".")
+    out: dict[str, str] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative: strip the module's own name + (level-1) parents
+                prefix = base[: len(base) - node.level]
+                mod = ".".join(prefix + ([node.module] if node.module else []))
+            else:
+                mod = node.module or ""
+            for alias in node.names:
+                out[alias.asname or alias.name] = f"{mod}.{alias.name}"
+    return out
+
+
+# ------------------------------------------------------- exception classes
+
+# the slice of the builtin exception hierarchy project code raises/catches
+BUILTIN_BASES: dict[str, list[str]] = {
+    "BaseException": [],
+    "Exception": ["BaseException"],
+    "ArithmeticError": ["Exception"],
+    "ZeroDivisionError": ["ArithmeticError"],
+    "OverflowError": ["ArithmeticError"],
+    "AssertionError": ["Exception"],
+    "AttributeError": ["Exception"],
+    "LookupError": ["Exception"],
+    "KeyError": ["LookupError"],
+    "IndexError": ["LookupError"],
+    "NameError": ["Exception"],
+    "NotImplementedError": ["RuntimeError"],
+    "OSError": ["Exception"],
+    "IOError": ["OSError"],
+    "TimeoutError": ["OSError"],
+    "ConnectionError": ["OSError"],
+    "RuntimeError": ["Exception"],
+    "StopIteration": ["Exception"],
+    "StopAsyncIteration": ["Exception"],
+    "TypeError": ["Exception"],
+    "ValueError": ["Exception"],
+    "UnicodeDecodeError": ["ValueError"],
+}
+
+
+def exception_table(project: Project) -> dict[str, list[str]]:
+    """Class name -> base-class names, project classes layered over the
+    builtin table.  Name-keyed: two project classes sharing a name merge
+    (conservative for coverage checks)."""
+    if "exception_table" in project.caches:
+        return project.caches["exception_table"]
+    table = dict(BUILTIN_BASES)
+    for module in project.modules:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                bases = []
+                for b in node.bases:
+                    name = dotted(b)
+                    if name:
+                        bases.append(name.split(".")[-1])
+                if bases:
+                    table.setdefault(node.name, bases)
+    project.caches["exception_table"] = table
+    return table
+
+
+def exception_ancestors(name: str, table: dict[str, list[str]]) -> set[str]:
+    seen: set[str] = set()
+    stack = [name]
+    while stack:
+        cur = stack.pop()
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(table.get(cur, []))
+    return seen
+
+
+def is_exception_class(name: str, table: dict[str, list[str]]) -> bool:
+    return "BaseException" in exception_ancestors(name, table)
+
+
+def handler_names(handler: ast.ExceptHandler) -> list[str] | None:
+    """Exception names caught by one ``except`` clause; None = bare
+    ``except:`` (catches everything)."""
+    if handler.type is None:
+        return None
+    types = (
+        handler.type.elts if isinstance(handler.type, ast.Tuple) else [handler.type]
+    )
+    out = []
+    for t in types:
+        name = dotted(t)
+        if name:
+            out.append(name.split(".")[-1])
+    return out
+
+
+def covered_by(raised: str, caught: list[str] | None, table: dict[str, list[str]]) -> bool:
+    if caught is None:
+        return True
+    ancestors = exception_ancestors(raised, table)
+    return any(c in ancestors for c in caught)

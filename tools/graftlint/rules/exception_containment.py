@@ -1,0 +1,367 @@
+"""exception-containment: per-item batch loops whose except set is too
+narrow for what the try body can raise.
+
+The defect class (ADVICE r5, fixed by hand twice already): a drain loop
+processes N gossip items with a per-item ``try/except`` so one bad item
+yields one bad verdict — but a call in the try body can raise an
+exception type the handlers don't cover, so one bad item throws away the
+WHOLE batch, repeatedly, on every future drain.
+
+Mechanics: collect ``raise X`` statements per function (minus raises the
+function itself contains locally), then propagate raise signatures a
+bounded number of call levels through resolvable callees — same-module
+functions, same-class ``self.`` methods, project ``from`` imports, and
+methods by bare name project-wide (ambiguity cap: names with more than
+three definitions are skipped; with several candidates only raises
+shared by ALL of them are attributed, since the receiver is one unknown
+candidate).  Inside every loop-carried ``try``
+with handlers, each call (and direct raise) is checked against the
+handlers of all enclosing tries in the function; an uncovered project
+exception is a finding.  Only explicitly-raised classes are inferred —
+builtin exceptions surfacing from library calls are out of scope (and
+why generic containment around device-cache builds still matters).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Project
+from .common import (
+    FuncInfo,
+    call_name,
+    covered_by,
+    dotted,
+    exception_table,
+    handler_names,
+    import_map,
+    is_exception_class,
+    is_self_call,
+    module_functions,
+    walk_excluding_nested,
+)
+
+PROPAGATION_DEPTH = 2  # raise signatures travel at most this many call levels
+AMBIGUITY_CAP = 3  # attr-call resolution: skip names defined more often
+
+
+class ExceptionContainmentRule:
+    name = "exception-containment"
+    description = "batch-loop call sites whose except set misses inferred raises"
+
+    def check(self, project: Project) -> list[Finding]:
+        table = exception_table(project)
+        index = _FunctionIndex(project)
+        signatures = _raise_signatures(project, table, index)
+        findings: list[Finding] = []
+        for module in project.modules:
+            for fi in module_functions(module):
+                findings.extend(
+                    self._check_function(fi, module, project, table, index, signatures)
+                )
+        return findings
+
+    def _check_function(self, fi, module, project, table, index, signatures):
+        findings: list[Finding] = []
+        tries = _tries_in_loops(fi.node)
+        if not tries:
+            return findings
+        imports = import_map(module, project)
+        for try_node, enclosing in tries:
+            if not _is_containment_try(try_node):
+                # every handler re-raises: an error-translation wrapper
+                # (raise BlsError(...) from e), not per-item containment —
+                # an escaping exception is its contract, not a batch drop
+                continue
+            caught: list[list[str] | None] = []
+            bare = False
+            for t in [try_node] + enclosing:
+                for h in t.handlers:
+                    names = handler_names(h)
+                    if names is None:
+                        bare = True
+                    else:
+                        caught.append(names)
+            if bare:
+                continue
+            flat = [n for names in caught for n in names]
+            for node in _try_body_nodes(try_node):
+                raised: set[str] = set()
+                context = ""
+                if isinstance(node, ast.Raise) and node.exc is not None:
+                    name = _raised_name(node.exc)
+                    if name and is_exception_class(name, table):
+                        raised = {name}
+                        context = f"raise {name}"
+                elif isinstance(node, ast.Call):
+                    target = _resolve_callee(node, fi, module, imports, index)
+                    if target is not None:
+                        raised = _candidate_raises(target, signatures)
+                        context = f"{call_name(node)}() may raise"
+                uncovered = sorted(
+                    r for r in raised if not covered_by(r, flat, table)
+                )
+                if uncovered:
+                    findings.append(
+                        Finding(
+                            rule=self.name,
+                            path=module.rel,
+                            line=node.lineno,
+                            symbol=fi.qualname,
+                            message=(
+                                f"{context} {', '.join(uncovered)} inside a "
+                                "per-item batch loop, but the surrounding "
+                                f"except set ({', '.join(sorted(set(flat))) or 'none'}) "
+                                "does not cover it — one bad item would drop "
+                                "the whole batch"
+                            ),
+                        )
+                    )
+        return findings
+
+
+# ------------------------------------------------------------- resolution
+
+
+class _FunctionIndex:
+    """Project-wide function lookup: by (module, name), (module, class,
+    name), and bare method name (with definition counts for the
+    ambiguity cap)."""
+
+    def __init__(self, project: Project):
+        self.by_module: dict[tuple[str, str], FuncInfo] = {}
+        self.by_class: dict[tuple[str, str, str], FuncInfo] = {}
+        self.by_bare: dict[str, list[FuncInfo]] = {}
+        # module dotted path -> its import map, for one re-export hop
+        # (``from ..fork_choice import on_block`` resolves through the
+        # package __init__ to the defining module)
+        self.reexports: dict[str, dict[str, str]] = {}
+        for module in project.modules:
+            dotted_mod = project.dotted_name(module)
+            self.reexports[dotted_mod] = import_map(module, project)
+            for fi in module_functions(module):
+                if fi.class_name is None:
+                    self.by_module[(dotted_mod, fi.name)] = fi
+                else:
+                    self.by_class[(dotted_mod, fi.class_name, fi.name)] = fi
+                self.by_bare.setdefault(fi.name, []).append(fi)
+
+    def module_function(self, mod: str, func: str) -> FuncInfo | None:
+        hit = self.by_module.get((mod, func))
+        if hit is not None:
+            return hit
+        # one re-export hop through the target module's own imports
+        target = self.reexports.get(mod, {}).get(func)
+        if target is not None:
+            mod2, _, func2 = target.rpartition(".")
+            return self.by_module.get((mod2, func2))
+        return None
+
+
+def _func_key(fi: FuncInfo) -> str:
+    return f"{fi.module.rel}:{fi.qualname}"
+
+
+def _candidate_raises(target, signatures: dict) -> set[str]:
+    """Raise set for a resolved callee.  A unique resolution keeps its
+    full signature; an ambiguous attr-call (tuple of candidate keys under
+    the cap) contributes only raises EVERY candidate shares — the call's
+    receiver is one unknown candidate, so a raise must hold for all of
+    them to be attributable (e.g. ``.drain()`` resolves to asyncio's
+    writer AND both mux streams; only the mux ones raise, so nothing is
+    attributed — while ``.encrypt()`` raises NoiseError in every
+    definition and keeps it)."""
+    if not isinstance(target, tuple):
+        return set(signatures.get(target, ()))
+    sets = [signatures.get(t, set()) for t in target]
+    return set.intersection(*sets) if sets else set()
+
+
+def _resolve_callee(call: ast.Call, fi, module, imports, index: _FunctionIndex):
+    """Resolve a call to a function key, or None."""
+    cname = call_name(call)
+    if cname is None:
+        return None
+    dotted_mod = _module_dotted(module)
+    if isinstance(call.func, ast.Name):
+        hit = index.by_module.get((dotted_mod, cname))
+        if hit is not None:
+            return _func_key(hit)
+        target = imports.get(cname)
+        if target is not None:
+            mod, _, func = target.rpartition(".")
+            hit = index.module_function(mod, func)
+            if hit is not None:
+                return _func_key(hit)
+        return None
+    if is_self_call(call) and fi.class_name is not None:
+        hit = index.by_class.get((dotted_mod, fi.class_name, cname))
+        if hit is not None:
+            return _func_key(hit)
+    # obj.method(): bare-name method table under the ambiguity cap
+    candidates = [c for c in index.by_bare.get(cname, []) if c.class_name is not None]
+    if 0 < len(candidates) <= AMBIGUITY_CAP:
+        return tuple(_func_key(c) for c in candidates)
+    return None
+
+
+def _module_dotted(module: Module) -> str:
+    rel = module.rel
+    if rel.endswith("/__init__.py"):
+        rel = rel[: -len("/__init__.py")]
+    elif rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+def _raised_name(exc: ast.AST) -> str | None:
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    name = dotted(exc)
+    return name.split(".")[-1] if name else None
+
+
+# ----------------------------------------------------------- raise tables
+
+
+def _raise_signatures(project, table, index: _FunctionIndex) -> dict:
+    """Function key -> set of exception names escaping it, propagated
+    ``PROPAGATION_DEPTH`` call levels.  A raise (or callee raise) inside
+    a try whose handlers cover it locally does not escape."""
+    sigs: dict[str, set[str]] = {}
+    calls: dict[str, list] = {}  # key -> [(callee key(s), covering handler names)]
+    for module in project.modules:
+        imports = import_map(module, project)
+        for fi in module_functions(module):
+            key = _func_key(fi)
+            direct: set[str] = set()
+            callee_sites: list = []
+            trys = _enclosing_try_map(fi.node)
+            for node in walk_excluding_nested(fi.node):
+                covering = [
+                    n
+                    for t in trys.get(id(node), [])
+                    for h in t.handlers
+                    for n in (handler_names(h) or ["__ALL__"])
+                ]
+                if isinstance(node, ast.Raise) and node.exc is not None:
+                    name = _raised_name(node.exc)
+                    if (
+                        name
+                        and is_exception_class(name, table)
+                        and not _locally_covered(name, covering, table)
+                    ):
+                        direct.add(name)
+                elif isinstance(node, ast.Call):
+                    target = _resolve_callee(node, fi, module, imports, index)
+                    if target is not None:
+                        callee_sites.append((target, covering))
+            sigs[key] = direct
+            calls[key] = callee_sites
+    for _ in range(PROPAGATION_DEPTH):
+        changed = False
+        for key, sites in calls.items():
+            for target, covering in sites:
+                for name in _candidate_raises(target, sigs):
+                    if not _locally_covered(name, covering, table) and name not in sigs[key]:
+                        sigs[key].add(name)
+                        changed = True
+        if not changed:
+            break
+    return sigs
+
+
+def _locally_covered(name: str, covering: list[str], table) -> bool:
+    if "__ALL__" in covering:
+        return True
+    return covered_by(name, covering, table) if covering else False
+
+
+def _enclosing_try_map(func_node) -> dict[int, list]:
+    """node id -> list of Try nodes whose *body* (not handlers) encloses
+    it, innermost first, within one function."""
+    out: dict[int, list] = {}
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Try):
+                for stmt in child.body:
+                    _mark(stmt, [child] + stack)
+                for part in (child.handlers, child.orelse, child.finalbody):
+                    for stmt in part:
+                        _mark(stmt, stack)
+            else:
+                out[id(child)] = stack
+                visit(child, stack)
+
+    def _mark(node, stack):
+        out[id(node)] = stack
+        visit(node, stack)
+
+    visit(func_node, [])
+    return out
+
+
+def _is_containment_try(try_node: ast.Try) -> bool:
+    """True when at least one handler contains the error instead of
+    re-raising (last statement is not ``raise``)."""
+    return any(
+        h.body and not isinstance(h.body[-1], ast.Raise) for h in try_node.handlers
+    )
+
+
+def _tries_in_loops(func_node):
+    """``(try, enclosing-tries)`` for every Try with handlers inside a
+    loop body (the per-item batch pattern), nested scopes excluded.
+
+    Only enclosing tries entered at the SAME loop depth count as
+    containment: a handler on a try that wraps the loop itself (or an
+    outer loop) still aborts the iteration when it catches, dropping
+    every remaining item — exactly the batch-drop this rule targets, so
+    it must not mask the finding."""
+    found = []
+
+    def walk(node, loop_depth, try_stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for child in ast.iter_child_nodes(node):
+                walk(child, loop_depth + 1, try_stack)
+            return
+        if isinstance(node, ast.Try):
+            if loop_depth > 0 and node.handlers:
+                found.append(
+                    (node, [t for t, depth in try_stack if depth == loop_depth])
+                )
+            for stmt in node.body:
+                walk(stmt, loop_depth, [(node, loop_depth)] + try_stack)
+            for part in (node.handlers, node.orelse, node.finalbody):
+                for stmt in part:
+                    walk(stmt, loop_depth, try_stack)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, loop_depth, try_stack)
+
+    for stmt in func_node.body:
+        walk(stmt, 0, [])
+    return found
+
+
+def _try_body_nodes(try_node):
+    """Calls and raises in a try's body (handlers excluded, nested
+    scopes excluded, nested tries excluded — they have their own
+    handlers and are checked as their own pattern instance)."""
+    out = []
+    stack = list(try_node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef, ast.Try)
+        ):
+            continue
+        if isinstance(node, (ast.Raise, ast.Call)):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
