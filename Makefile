@@ -26,9 +26,14 @@ lint:
 	fi
 
 # Fast default lane (consensus, network, crypto-host, ssz, spec vectors
-# kept out): target < 5 min on one core.
+# kept out): target < 5 min on one core.  The 8-way host-platform mesh
+# lane rides along (round 11): shard routing/padding/Merkle-plane tests
+# — cheap (no multi-minute shard_map compiles; those stay in
+# test-device-heavy).  test_multichip.py is unmarked and already runs
+# in the first invocation.
 test: native
 	python -m pytest tests/ -q -m "not spectest and not device"
+	python -m pytest tests/unit/test_shard_plane.py -q
 
 # Device-kernel lane: plane/einsum stacks on the CPU backend.  The
 # multi-minute compile units (sharded mesh verify, bisection chain, the

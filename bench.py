@@ -15,12 +15,15 @@ EVERY stage runs in a guarded subprocess under one shared contract
 (round-5 advisor: an unguarded in-process device dispatch on a dead TPU
 tunnel hung the whole run at rc=124 with zero evidence):
 
-- a per-stage wall-clock budget (env-overridable), trimmed so the stage
-  SUM fits one bench run's ~2 h budget: SSZ 600 + mainnet 1500 + ingest
-  1500 + boot 600 + registry-planes 300 + telemetry 120 + pipeline 120
-  + trace 60 + BLS 2x1200 = 7200 s worst case (the telemetry stage gave
-  up 60 s to fund the trace-overhead stage — both finish in well under
-  their budgets);
+- a per-stage wall-clock budget (env-overridable), each CLAMPED at
+  launch to what remains of the driver-level total budget
+  (``BENCH_TOTAL_BUDGET_S``, default 7000 s): nominal budgets are SSZ
+  600 + mainnet 1500 + ingest 1500 + boot 600 + registry-planes 300 +
+  telemetry 120 + pipeline 120 + trace 60 + sharded mesh 900 + BLS
+  2x1200, and when elapsed time eats a later stage's slice the stage
+  shrinks (or is skipped with a ``truncated: true`` absence record)
+  instead of letting the SUM blow past the outer timeout — the
+  BENCH_r05 zero-record failure mode;
 - honest absence — a stage that times out/crashes still emits its metric
   lines with ``value: null`` and a note, so "broke" is distinguishable
   from "skipped";
@@ -43,6 +46,34 @@ import sys
 import time
 
 import numpy as np
+
+# ---- driver-level total budget (round 11 / VERDICT r5 next #1a) --------
+#
+# BENCH_r05 was rc 124 with ZERO records: per-stage budgets existed but
+# their sum exceeded the driver's outer timeout, so the driver killed the
+# run mid-stage with nothing flushed.  Now every stage budget is clamped
+# to the time REMAINING under BENCH_TOTAL_BUDGET_S (default 7000 s —
+# deliberately inside the driver's ~2 h wall); a stage that finds the
+# budget exhausted emits its honest-absence records with
+# ``truncated: true`` instead of launching, so every round records
+# *something* for every metric before the outer timeout can fire.
+
+_T0 = time.monotonic()
+_TRUNCATED: list[str] = []  # stages skipped by the total-budget guard
+
+
+def _total_budget_s() -> float:
+    return float(os.environ.get("BENCH_TOTAL_BUDGET_S", "7000"))
+
+
+def _remaining_s(reserve_s: float = 30.0) -> float:
+    """Wall clock left under the total budget, minus a reserve that
+    keeps the final flush (and the BLS record ordering) off the cliff."""
+    return _total_budget_s() - (time.monotonic() - _T0) - reserve_s
+
+
+def _clamp_budget(budget_s: float) -> float:
+    return max(0.0, min(float(budget_s), _remaining_s()))
 
 
 def _bench_device(blocks: np.ndarray, iters: int = 20) -> float:
@@ -90,6 +121,11 @@ def _bench_host(blocks: np.ndarray, budget_s: float = 2.0) -> float:
 
 def _bls_attempt(budget_s: float) -> tuple[list[dict], str | None]:
     """One subprocess run of the chain bench; (records, failure-note)."""
+    budget_s = _clamp_budget(budget_s)
+    if budget_s <= 1.0:
+        if "bench_chain.py" not in _TRUNCATED:  # once across retries
+            _TRUNCATED.append("bench_chain.py")
+        return [], "skipped: total bench budget exhausted"
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache"))
@@ -166,19 +202,48 @@ def _bench_mainnet_root(budget_s: float | None = None) -> list[dict]:
     return recs
 
 
+def _absent_records(
+    name: str, metrics: tuple[str, ...], note: str,
+    units: dict | None = None, truncated: bool = False,
+) -> list[dict]:
+    """Honest-absence records for a whole stage (crash, timeout, or the
+    total-budget guard refusing to launch it)."""
+    recs = []
+    for m in metrics:
+        rec = {"metric": m, "value": None, "note": f"{name}: {note}"}
+        if truncated:
+            rec["truncated"] = True
+        if units and m in units:
+            rec["unit"] = units[m]
+        recs.append(rec)
+    return recs
+
+
 def _bench_script(
     name: str,
     metrics: tuple[str, ...],
     budget_s: float,
     argv_extra=(),
     units: dict | None = None,
+    env_extra: dict | None = None,
 ) -> list[dict]:
     """The shared stage guard: run a bench script in a subprocess under a
-    wall-clock budget, keep only its metric lines, and emit per-metric
-    honest-absence records (with the metric's ``unit`` from ``units`` and
-    the crash tail in the note) for anything it failed to produce."""
+    wall-clock budget — clamped to the driver-level total budget — keep
+    only its metric lines, and emit per-metric honest-absence records
+    (with the metric's ``unit`` from ``units`` and the crash tail in the
+    note) for anything it failed to produce."""
+    budget_s = _clamp_budget(budget_s)
+    if budget_s <= 1.0:
+        _TRUNCATED.append(name)
+        return _absent_records(
+            name, metrics,
+            "skipped: total bench budget exhausted before this stage",
+            units, truncated=True,
+        )
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache"))
     argv = [sys.executable, os.path.join(here, "scripts", name), *argv_extra]
     fail_note = None
@@ -222,6 +287,16 @@ def _ssz_line_guarded(budget_s: float | None = None) -> dict:
     at its first in-process dispatch."""
     if budget_s is None:
         budget_s = float(os.environ.get("BENCH_SSZ_BUDGET_S", "600"))
+    budget_s = _clamp_budget(budget_s)
+    if budget_s <= 1.0:
+        _TRUNCATED.append("ssz kernel")
+        return {
+            "metric": "ssz_merkle_node_hashes_per_sec",
+            "value": None,
+            "unit": "hashes/s",
+            "truncated": True,
+            "note": "skipped: total bench budget exhausted",
+        }
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache"))
@@ -268,7 +343,77 @@ def _ssz_line_guarded(budget_s: float | None = None) -> dict:
         }
 
 
+def _bench_sharded_stage() -> list[dict]:
+    """The multichip bench stage (round 11): the sharded pairing/verify
+    plane on an 8-way mesh, hang-proof by construction — the backend is
+    probed in a budgeted subprocess (60 s default), a too-small or dead
+    backend falls back to the virtual CPU mesh (same programs, honest
+    ``backend`` note), and the stage itself runs under the shared
+    subprocess guard so a wedged device tunnel costs one sub-budget, not
+    the round."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import __graft_entry__ as graft
+
+    mesh_n = int(os.environ.get("BENCH_SHARD_DEVICES", "8"))
+    budget = float(os.environ.get("BENCH_SHARD_BUDGET_S", "900"))
+    units = {
+        "sharded_verify_entries_per_sec": "entries/s",
+        "multichip_aggregate_verifications_per_sec": "aggregate verifications/s",
+    }
+    n_live = graft._initialized_backend_device_count()
+    if n_live is None:
+        n_live = graft._probe_live_devices()  # subprocess, short budget
+    live_mesh = n_live >= mesh_n
+    # BLS_SHARD_DRAIN rides along so a live-mesh stage measures the env
+    # a sharded NODE would run; bench_pairing itself calls the sharded
+    # ops directly and emits the multichip aggregate line only on a
+    # real TPU mesh (the sharded plane, not a relabeled single-device
+    # number — bench_chain's cached drain never reads these flags)
+    env_extra = {"BLS_SHARD": "1", "BLS_SHARD_DRAIN": "1"}
+    metrics = ("sharded_verify_entries_per_sec",)
+    if live_mesh:
+        metrics += ("multichip_aggregate_verifications_per_sec",)
+    else:
+        env_extra = graft.virtual_cpu_env(mesh_n, dict(os.environ))
+        env_extra["BLS_SHARD"] = "1"
+        # validation run, not a throughput record: narrow the RLC width
+        # to the dryrun-warmed ladder shapes so the virtual mesh can
+        # finish inside the stage budget instead of recompiling a fresh
+        # 64-bit ladder program for minutes
+        env_extra.setdefault("BLS_RLC_BITS", "16")
+    recs = _bench_script(
+        "bench_pairing.py",
+        metrics,
+        budget,
+        argv_extra=("--devices", str(mesh_n)),
+        units=units,
+        env_extra=env_extra,
+    )
+    for rec in recs:
+        rec.setdefault("backend_devices", n_live)
+        rec.setdefault("mesh", "live" if live_mesh else "virtual-cpu")
+    if not live_mesh:
+        recs.append({
+            "metric": "multichip_aggregate_verifications_per_sec",
+            "value": None,
+            "unit": units["multichip_aggregate_verifications_per_sec"],
+            "note": (
+                f"no live {mesh_n}-device backend "
+                f"({n_live} device(s) probed); sharded plane validated "
+                "on the virtual CPU mesh instead"
+            ),
+        })
+    return recs
+
+
 def main() -> None:
+    # first evidence within seconds of launch (VERDICT r5 next #1a): the
+    # budget line also timestamps the run for the truncation note below
+    print(json.dumps({
+        "metric": "bench_total_budget_s",
+        "value": _total_budget_s(),
+        "unit": "s",
+    }), flush=True)
     ssz_line = _ssz_line_guarded()
 
     if not os.environ.get("BENCH_NO_MAINNET"):
@@ -347,24 +492,53 @@ def main() -> None:
         ):
             print(json.dumps(rec), flush=True)
 
+    if not os.environ.get("BENCH_NO_SHARD"):
+        # sharded crypto plane on the 8-way mesh (probe-guarded; falls
+        # back to the virtual CPU mesh when no live multichip backend)
+        for rec in _bench_sharded_stage():
+            print(json.dumps(rec), flush=True)
+
     bls_recs, err = _bench_bls()
     if err is not None:
-        # headline stays the SSZ metric; record the failure honestly
-        print(json.dumps({"metric": "aggregate_bls_verifications_per_sec",
-                          "value": None,
-                          "unit": "aggregate verifications/s",
-                          "note": f"bls chain bench failed: {err}"}))
+        # headline stays the SSZ metric; record the failure honestly —
+        # with the truncated flag when the total-budget guard (not the
+        # bench itself) was the cause, like every other clipped stage
+        rec = {"metric": "aggregate_bls_verifications_per_sec",
+               "value": None,
+               "unit": "aggregate verifications/s",
+               "note": f"bls chain bench failed: {err}"}
+        if "total bench budget exhausted" in err:
+            rec["truncated"] = True
+        print(json.dumps(rec), flush=True)
         for rec in bls_recs:  # partial records (e.g. smoke) still count
-            print(json.dumps(rec))
-        print(json.dumps(ssz_line))
+            print(json.dumps(rec), flush=True)
+        if _TRUNCATED:
+            print(json.dumps(_truncation_record()), flush=True)
+        print(json.dumps(ssz_line), flush=True)
     else:
-        print(json.dumps(ssz_line))
+        print(json.dumps(ssz_line), flush=True)
+        if _TRUNCATED:
+            print(json.dumps(_truncation_record()), flush=True)
         for rec in bls_recs:
             if rec["metric"] != "aggregate_bls_verifications_per_sec":
-                print(json.dumps(rec))
+                print(json.dumps(rec), flush=True)
         for rec in bls_recs:
             if rec["metric"] == "aggregate_bls_verifications_per_sec":
-                print(json.dumps(rec))
+                print(json.dumps(rec), flush=True)
+
+
+def _truncation_record() -> dict:
+    """One summary line naming every stage the total-budget guard cut —
+    the ``truncated: true`` note ROADMAP item 2 demands so a clipped
+    round is distinguishable from a complete one."""
+    return {
+        "metric": "bench_truncated",
+        "value": len(_TRUNCATED),
+        "truncated": True,
+        "unit": "stages",
+        "note": "total budget clipped: " + ", ".join(_TRUNCATED),
+        "elapsed_s": round(time.monotonic() - _T0, 1),
+    }
 
 
 if __name__ == "__main__":

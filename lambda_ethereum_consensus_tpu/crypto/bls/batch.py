@@ -47,6 +47,7 @@ __all__ = [
     "batch_verify",
     "batch_verify_each_points",
     "batch_verify_each_cached",
+    "shard_active",
     "verify_points",
 ]
 
@@ -76,6 +77,51 @@ def _chain_enabled(n: int) -> bool:
     if n < device_chain_threshold():
         return False
     return env_flag("BLS_DEVICE_CHAIN") or device_default()
+
+
+def shard_active() -> bool:
+    """Is the mesh-sharded verify the selected device implementation?
+
+    True exactly when the chained device path would run AND the mesh
+    policy (:func:`...ops.mesh.shard_enabled`) is on — default for a
+    multi-device TPU backend; ``BLS_SHARD=1`` forces it anywhere (CI's
+    virtual 8-CPU mesh), ``BLS_NO_SHARD=1`` pins the single-device
+    chain.  Importable by the serving layers (fork_choice/handlers.py)
+    so path selection and the actual verify routing can never
+    disagree."""
+    if not (env_flag("BLS_DEVICE_CHAIN") or device_default()):
+        return False
+    from ...ops.mesh import shard_enabled
+
+    return shard_enabled()
+
+
+def shard_drain_active() -> bool:
+    """Should the ATTESTATION DRAIN swap its cached device-committee
+    body for the host-prep + sharded-verify body?
+
+    Opt-in (``BLS_SHARD_DRAIN=1``) on top of :func:`shard_active`: the
+    cached drain's aggregate pubkeys come from the epoch committee cache
+    ON DEVICE (the machinery behind the r04 6.7k/s record), and the
+    sharded drain trades that for host EC aggregation per attestation in
+    exchange for the mesh-wide verify — a trade that must be MEASURED on
+    a live mesh (the bench sharded stage sets this flag) before it can
+    be the multi-device default."""
+    return shard_active() and env_flag("BLS_SHARD_DRAIN")
+
+
+def _device_chain_verify(checks) -> list[bool]:
+    """The ONE device-routing decision for whole RLC checks: the
+    mesh-sharded pipeline when more than one device is live, the
+    single-device chain otherwise (identical results either way —
+    bit-exact, same infinity semantics)."""
+    if shard_active():
+        from ...ops.bls_shard import sharded_chain_verify
+
+        return sharded_chain_verify(checks)
+    from ...ops.bls_batch import chain_verify
+
+    return chain_verify(checks)
 
 
 def _pack_check(entry_list, dst, message_points):
@@ -143,9 +189,7 @@ def verify_points(
     if message_points is None:
         message_points = {}
     if _chain_enabled(len(entries)):
-        from ...ops.bls_batch import chain_verify
-
-        return chain_verify([_pack_check(entries, dst, message_points)])[0]
+        return _device_chain_verify([_pack_check(entries, dst, message_points)])[0]
     from . import native
 
     if native.rlc_available() and not env_flag("BLS_NO_NATIVE_RLC"):
@@ -199,8 +243,6 @@ def batch_verify_each_points(
             )
 
         if _chain_enabled(max((len(r) for r in ranges), default=0)):
-            from ...ops.bls_batch import chain_verify
-
             # ranges containing an undecodable (None) point are invalid
             # by definition (verify_points semantics) — no device needed
             results: dict[int, bool] = {
@@ -213,7 +255,7 @@ def batch_verify_each_points(
                 _pack_check([entries[i] for i in r], dst, message_points)
                 for _, r in live_ranges
             ]
-            for (k, _), ok in zip(live_ranges, chain_verify(checks)):
+            for (k, _), ok in zip(live_ranges, _device_chain_verify(checks)):
                 results[k] = ok
             return [results[k] for k in range(len(ranges))]
         return [

@@ -331,24 +331,49 @@ def on_attestation_batch(
     batch id plus its outcome (``apply`` + the admission→apply latency
     histogram, or ``drop`` with the error) — the causal fan-in that
     makes "which flush verified this vote, and with whom" answerable
-    from a ``/debug/trace`` dump.
+    from a ``/debug/trace`` dump.  Batch spans and trace records carry
+    ``n_devices`` so a ``/debug/trace`` dump distinguishes sharded from
+    single-device flushes.
+
+    Path selection on a multi-device mesh (round 11): when the sharded
+    DRAIN is opted in (``crypto.bls.batch.shard_drain_active`` —
+    ``BLS_SHARD_DRAIN=1`` on top of an active sharded plane), the drain
+    runs the host-prep body, whose ``batch_verify_each_points`` routes
+    every RLC check through
+    :func:`...ops.bls_shard.sharded_chain_verify` — points and
+    coefficients dealt over the 8-chip ``dp`` axis.  Without the
+    opt-in, a multi-device mesh keeps the epoch-committee device-cache
+    drain (aggregate pubkeys never touch the host — the r04-measured
+    body); the sharded plane still serves every point-based verify that
+    routes through ``crypto.bls.batch``.  The opt-in exists because the
+    sharded drain trades the device committee cache for host EC
+    aggregation per attestation — a trade to be measured on a live
+    mesh, not defaulted.
     """
-    from ..crypto.bls.batch import _chain_enabled
+    from ..crypto.bls.batch import _chain_enabled, shard_drain_active
 
     spec = spec or get_chain_spec()
     results: list[ForkChoiceError | None] = [None] * len(attestations)
-    cached = bool(attestations) and _chain_enabled(len(attestations))
-    path = "cached" if cached else "host"
+    device = bool(attestations) and _chain_enabled(len(attestations))
+    sharded = device and shard_drain_active()
+    cached = device and not sharded
+    path = "sharded" if sharded else ("cached" if cached else "host")
+    n_devices = 1
+    if sharded:
+        from ..ops.mesh import initialized_device_count
+
+        n_devices = initialized_device_count() or 1
     live_traces = traces is not None and any(t is not None for t in traces)
     t0 = _time.monotonic() if live_traces else 0.0
     verify = _attestation_batch_cached if cached else _attestation_batch_host
-    with span("attestation_batch_verify", path=path):
+    with span("attestation_batch_verify", path=path, n_devices=n_devices):
         verify(store, attestations, is_from_block, spec, results)
     if live_traces:
         from ..tracing import record_verify_batch
 
         record_verify_batch(
-            traces, results, path, t0, _time.monotonic() - t0
+            traces, results, path, t0, _time.monotonic() - t0,
+            n_devices=n_devices,
         )
     return results
 

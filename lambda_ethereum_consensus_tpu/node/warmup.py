@@ -21,7 +21,12 @@ from __future__ import annotations
 import threading
 import time
 
-__all__ = ["DrainShapes", "warm_drain_programs", "start_warmer"]
+__all__ = [
+    "DrainShapes",
+    "warm_drain_programs",
+    "warm_sharded_programs",
+    "start_warmer",
+]
 
 
 class DrainShapes:
@@ -50,16 +55,48 @@ class DrainShapes:
         self.coeff_bits = coeff_bits
 
 
+def warm_sharded_programs(shapes: DrainShapes) -> float:
+    """Dispatch one dummy SHARDED verify at ``shapes`` — the mesh
+    analogue of :func:`warm_drain_programs`: loads/compiles the
+    shard_map ladder, reduce and Miller-combine executables (plus the
+    replicated tail) at the exact padded shapes the scheduler's
+    deadline flushes snap to, so the first real sharded drain finds
+    every program resident.  Values are generators (garbage); program
+    identity is keyed by shape, which is all warming needs."""
+    from ..crypto.bls import curve as C
+    from ..ops.bls_shard import sharded_chain_verify
+
+    t0 = time.perf_counter()
+    checks = []
+    per_check = max(1, shapes.entries // max(shapes.checks, 1))
+    groups = max(1, min(shapes.groups, per_check))
+    h_points = [C.G2_GENERATOR] * groups
+    for _ in range(max(shapes.checks, 1)):
+        entries = [(C.G1_GENERATOR, C.G2_GENERATOR, 1)] * per_check
+        gids = [i % groups for i in range(per_check)]
+        checks.append((entries, h_points, gids))
+    ok = sharded_chain_verify(checks, coeff_bits=shapes.coeff_bits)
+    assert len(ok) == len(checks)
+    return time.perf_counter() - t0
+
+
 def warm_drain_programs(shapes: DrainShapes) -> float:
     """Dispatch one dummy drain at ``shapes``; blocks until every program
-    ran on device.  Returns seconds spent (load/compile time)."""
+    ran on device.  Returns seconds spent (load/compile time).  On a
+    multi-device mesh with the sharded plane selected, the SHARDED
+    executables are warmed first — they are what the scheduler's flushes
+    will actually dispatch — and the single-device programs after (the
+    fallback, and the committee-cache drain's op set)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from ..crypto.bls.batch import shard_active
     from ..ops import bls_batch as BB
 
     t0 = time.perf_counter()
+    if shard_active():
+        warm_sharded_programs(shapes)
     interpret = not BB._use_planes()
     ops = BB._get_chain_ops(interpret)
 

@@ -644,6 +644,31 @@ class RegistryPlaneStore:
         # per-chain planes cache holds anyway — no copy)
         self._host_rx = None
         self._host_ry = None
+        # mesh-sharded placement (round 11): the registry column axis is
+        # dealt over ``dp`` so an 8-chip mesh pins 1/8 of the planes per
+        # chip and the committee gathers read mostly-local shards.
+        # Decided once at construction — re-deciding per update() would
+        # bounce the resident buffer between layouts.
+        from .mesh import shard_plane_store_enabled
+
+        self._sharded = shard_plane_store_enabled()
+
+    def _place(self, arr):
+        """Pin a (32, capacity) plane buffer in the store's layout —
+        column-sharded over the mesh when enabled (capacity is pow2, so
+        it always divides the pow2 ``dp`` axis), resident-as-is
+        otherwise."""
+        if not self._sharded:
+            return arr
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .mesh import default_mesh
+
+        mesh = default_mesh()
+        if arr.shape[1] % mesh.devices.size:
+            return arr  # sub-mesh capacity: keep unsharded
+        return jax.device_put(arr, NamedSharding(mesh, P(None, "dp")))
 
     @property
     def resident_bytes(self) -> int:
@@ -684,15 +709,19 @@ class RegistryPlaneStore:
         if n <= self.capacity:
             from jax import lax
 
-            self.rx = lax.dynamic_update_slice(self.rx, new_x, (0, self.count))
-            self.ry = lax.dynamic_update_slice(self.ry, new_y, (0, self.count))
+            self.rx = self._place(
+                lax.dynamic_update_slice(self.rx, new_x, (0, self.count))
+            )
+            self.ry = self._place(
+                lax.dynamic_update_slice(self.ry, new_y, (0, self.count))
+            )
         else:
             cap = _pow2(max(n, self._min_cap))
             zx = jnp.zeros((32, cap - n), new_x.dtype)
             prefix_x = [self.rx[:, : self.count]] if self.count else []
             prefix_y = [self.ry[:, : self.count]] if self.count else []
-            self.rx = jnp.concatenate(prefix_x + [new_x, zx], axis=1)
-            self.ry = jnp.concatenate(prefix_y + [new_y, zx], axis=1)
+            self.rx = self._place(jnp.concatenate(prefix_x + [new_x, zx], axis=1))
+            self.ry = self._place(jnp.concatenate(prefix_y + [new_y, zx], axis=1))
             self.capacity = cap
         self.uploaded_cols += n - self.count
         self.count = n

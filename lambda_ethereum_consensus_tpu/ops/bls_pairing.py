@@ -282,6 +282,14 @@ def make_pairing_ops(
         )
     jits = {
         "miller": wrap(miller, "miller"),
+        # UNwrapped bodies for shard_map composition (ops/bls_shard.py):
+        # the aot_jit wrapper cannot run under another trace (it calls
+        # .lower()/compiled executables with tracers), so the sharded
+        # pipeline builds ONE program from these and jits that whole
+        # shard_map — same discipline as bls_batch's staged_reduce_*.
+        "miller_raw": miller,
+        "masked_product_raw": masked_product,
+        "mul_raw": f12m,
         "pow_x_abs": wrap(pow_x_abs, "pow_x_abs"),
         # easy_part is host-composed from inv/conj/frob/mul below on the
         # staged path (as one program it was a multi-hour axon compile);

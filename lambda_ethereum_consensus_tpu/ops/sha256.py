@@ -43,6 +43,7 @@ __all__ = [
     "hash_blocks_jnp",
     "hash_blocks_pallas",
     "merkle_root_device",
+    "merkle_root_words_sharded",
     "DeviceHashBackend",
     "install_device_backend",
 ]
@@ -290,10 +291,110 @@ def _merkle_tree_jnp(words: jax.Array, depth: int) -> jax.Array:
     return hash_blocks_jnp(level)[0]
 
 
+# ---- mesh-sharded subtree reduction (the round-11 sharded Merkle plane)
+#
+# The leaf-block batch axis is the tree's only data-parallel axis: shard
+# it over ``dp``, let each device reduce its LOCAL subtree with zero
+# communication, all_gather the per-device subtree roots (n_devices x 32
+# bytes — the whole collective), and run the final log2(n_devices)
+# levels replicated.  Bit-identical to the single-device reduction
+# because a Merkle tree's value is independent of which chip hashed
+# which subtree; the driver's dryrun asserts exactly that equality.
+
+_SHARDED_TREES: dict = {}
+
+
+def _sharded_tree_fn(mesh, depth_local: int, depth_global: int):
+    """One compiled sharded-tree program per (mesh, shape) key."""
+    from .mesh import shard_map_compat
+
+    key = (tuple(d.id for d in mesh.devices.flat), depth_local, depth_global)
+    fn = _SHARDED_TREES.get(key)
+    if fn is not None:
+        return fn
+    from jax.sharding import PartitionSpec as P
+
+    def shard_fn(local):  # (local_blocks, 16) per device
+        level = local
+        for _ in range(depth_local):
+            level = hash_blocks_jnp(level).reshape(-1, 16)
+        root = hash_blocks_jnp(level)  # (1, 8) local subtree root
+        if depth_global == 0:
+            return root
+        roots = jax.lax.all_gather(root, "dp", axis=0, tiled=True)
+        level = roots.reshape(-1, 16)
+        for _ in range(depth_global - 1):
+            level = hash_blocks_jnp(level).reshape(-1, 16)
+        return hash_blocks_jnp(level)  # (1, 8) replicated
+
+    fn = jax.jit(
+        shard_map_compat(shard_fn, mesh, P("dp", None), P())
+    )
+    _SHARDED_TREES[key] = fn
+    return fn
+
+
+def merkle_root_words_sharded(words, mesh=None) -> jax.Array:
+    """``(M, 16) uint32`` leaf blocks -> ``(8,)`` root digest, reduced
+    over the mesh.  M must be a power of two with at least one block per
+    device.  Shared by :func:`merkle_root_device`'s multi-device route
+    and the driver's ``dryrun_multichip`` step (one copy of the sharded
+    tree program — the dryrun validates the code the node serves with).
+    """
+    from .mesh import default_mesh
+
+    if mesh is None:
+        mesh = default_mesh()
+    d = int(mesh.devices.size)
+    m = int(words.shape[0])
+    assert d & (d - 1) == 0, "dp axis size must be a power of two"
+    assert m % d == 0 and m // d >= 1, (m, d)
+    depth_global = d.bit_length() - 1
+    depth_local = (m // d).bit_length() - 1
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    words = jax.device_put(
+        jnp.asarray(words), NamedSharding(mesh, P("dp", None))
+    )
+    return _sharded_tree_fn(mesh, depth_local, depth_global)(words)[0]
+
+
+def _shard_tree_min_blocks() -> int:
+    """Below this many leaf blocks the all_gather + replicated-tail
+    bookkeeping beats the win from splitting the level-0 hashing; also
+    keeps small-container SSZ tests off the sharded program (the
+    conftest CPU mesh makes every test process "multi-device")."""
+    import os
+
+    return int(os.environ.get("SSZ_SHARD_MIN_BLOCKS", "8192"))
+
+
+def _shard_tree_enabled(n_blocks: int) -> bool:
+    from ..utils.env import env_flag
+
+    if env_flag("SSZ_NO_SHARD"):
+        return False
+    from .mesh import _multi_device_tpu, initialized_device_count
+
+    n = initialized_device_count()
+    if n is None or n <= 1:
+        return False
+    if env_flag("SSZ_SHARD"):
+        return True
+    # default-on only for a multi-device TPU mesh: the conftest-forced
+    # virtual CPU mesh must not silently reroute every big-tree test
+    return _multi_device_tpu(n) and n_blocks >= _shard_tree_min_blocks()
+
+
 def merkle_root_device(chunks: np.ndarray) -> tuple[bytes, int]:
     """Root of ``(N, 32) uint8`` chunks padded to the next power of two with
     zero chunks.  Returns ``(root, depth_of_padded_subtree)`` — the caller
     extends with precomputed zero-subtree hashes up to the SSZ limit depth.
+
+    Registry-scale subtrees (the 1M-validator planes) route through the
+    mesh-sharded reduction when more than one device is live
+    (``SSZ_SHARD=1`` forces, ``SSZ_NO_SHARD=1`` falls back — results are
+    bit-identical either way).
     """
     n = chunks.shape[0]
     pairs = max(1, -(-n // 2))
@@ -303,6 +404,18 @@ def merkle_root_device(chunks: np.ndarray) -> tuple[bytes, int]:
     flat = np.ascontiguousarray(chunks).reshape(-1)
     buf.reshape(-1)[: flat.shape[0]] = flat
     words = buf.view(">u4").astype(np.uint32)
+    if _shard_tree_enabled(m):
+        from .mesh import default_mesh
+
+        mesh = default_mesh()
+        if m >= mesh.devices.size:
+            digest = np.asarray(merkle_root_words_sharded(words, mesh))
+            return (
+                np.ascontiguousarray(digest.astype(">u4"))
+                .view(np.uint8)
+                .tobytes(),
+                depth + 1,
+            )
     digest = np.asarray(_merkle_tree_jnp(words, depth))
     return np.ascontiguousarray(digest.astype(">u4")).view(np.uint8).tobytes(), depth + 1
 

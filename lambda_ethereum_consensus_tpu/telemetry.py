@@ -100,6 +100,9 @@ _HELP = {
     "attestation_context_evictions_count": "epoch-LRU context evictions",
     "checkpoint_cache_pruned_count": "checkpoint states/contexts pruned on finality",
     "bls_aot_retraces": "jit retraces of the batch-verify device programs",
+    "ops_shard_devices": "devices in the sharded crypto plane's dp mesh",
+    "ops_shard_batch_per_device": "padded verify entries per device shard",
+    "ops_shard_combine_seconds": "sharded Miller + Fq12 partial-product combine dispatch",
     "bls_aot_compiles": "XLA compiles of the batch-verify device programs",
     "bls_aot_loads": "AOT executable cache loads",
     "ingest_degraded_transitions_total": "degraded-latch activations (0->1 flips)",

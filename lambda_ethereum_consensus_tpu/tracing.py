@@ -383,6 +383,7 @@ def new_trace(label: str) -> ItemTrace | None:
 def record_verify_batch(
     traces, errors, path: str, t0: float, dur_s: float,
     span_name: str = "attestation_batch_verify",
+    n_devices: int = 1,
 ) -> int | None:
     """Fan-in bookkeeping for ONE batched verify over many item traces.
 
@@ -392,6 +393,9 @@ def record_verify_batch(
     admission→apply latency histogram for accepted items, ``drop`` with
     the error string for rejected ones.  ``errors`` is one ``None``
     (accepted) or error per trace position; ``t0`` is monotonic seconds.
+    ``n_devices`` is the mesh width the verify dispatched over (1 for
+    the single-device chain) — the batch span carries it so a
+    ``/debug/trace`` dump tells sharded flushes from single-device ones.
     Returns the batch id (None when no live trace was in the batch)."""
     members = [t for t in traces if t is not None]
     if not members:
@@ -403,7 +407,7 @@ def record_verify_batch(
         rec.record(
             "span", batch_id, span_name,
             args={
-                "path": path, "n": len(errors),
+                "path": path, "n": len(errors), "n_devices": n_devices,
                 # clip the link list so one 8k-item flush cannot occupy
                 # a large slice of the ring's byte budget by itself
                 "members": [t.trace_id for t in members[:128]],

@@ -1,0 +1,311 @@
+"""Sharded crypto plane (round 11): routing, padding discipline, the
+mesh Merkle tree, sharded registry placement and trace tagging.
+
+The heavy arithmetic equality (full sharded verify incl. Miller loops,
+bit-exact vs the single-device chain and the host pairing oracle) lives
+in ``test_bls_shard.py`` behind the BLS_HEAVY_TESTS gate and in the
+driver's ``dryrun_multichip``; this module is the DEFAULT-lane coverage:
+everything here runs without a multi-minute shard_map compile.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from lambda_ethereum_consensus_tpu.ops import mesh as M
+from lambda_ethereum_consensus_tpu.ops.bls_shard import pad_to_devices
+
+pytestmark = pytest.mark.device
+
+
+def _require_mesh(n=8):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs the {n}-device CPU mesh (conftest)")
+
+
+# ----------------------------------------------------- selection policy
+
+
+def test_shard_enabled_env_precedence(monkeypatch):
+    monkeypatch.setenv("BLS_NO_SHARD", "1")
+    monkeypatch.setenv("BLS_SHARD", "1")
+    assert M.shard_enabled() is False  # the kill-switch always wins
+    monkeypatch.delenv("BLS_NO_SHARD")
+    assert M.shard_enabled() is True  # forced on, no backend question
+    monkeypatch.delenv("BLS_SHARD")
+    # default: multi-device TPU only — this process IS an 8-device mesh
+    # (conftest), but a virtual CPU mesh must not flip serving routing
+    assert M.shard_enabled(n_devices=8) is False
+    assert M.shard_enabled(n_devices=1) is False
+    assert M._multi_device_tpu(8) is False  # cpu backend here
+
+
+def test_shard_active_requires_device_chain(monkeypatch):
+    from lambda_ethereum_consensus_tpu.crypto.bls import batch as B
+
+    monkeypatch.setenv("BLS_SHARD", "1")
+    monkeypatch.delenv("BLS_DEVICE_CHAIN", raising=False)
+    monkeypatch.setenv("BLS_NO_DEVICE", "1")
+    assert B.shard_active() is False  # no device chain -> no sharded plane
+    monkeypatch.delenv("BLS_NO_DEVICE")
+    monkeypatch.setenv("BLS_DEVICE_CHAIN", "1")
+    assert B.shard_active() is True
+
+
+def test_device_chain_verify_routes_sharded(monkeypatch):
+    """The ONE routing decision: sharded implementation when the mesh
+    policy says so, single-device chain otherwise — with identical
+    call shapes (the fallback contract)."""
+    from lambda_ethereum_consensus_tpu.crypto.bls import batch as B
+    from lambda_ethereum_consensus_tpu.ops import bls_batch, bls_shard
+
+    calls = []
+    monkeypatch.setattr(
+        bls_shard, "sharded_chain_verify",
+        lambda checks, **kw: calls.append(("sharded", len(checks)))
+        or [True] * len(checks),
+    )
+    monkeypatch.setattr(
+        bls_batch, "chain_verify",
+        lambda checks, **kw: calls.append(("single", len(checks)))
+        or [True] * len(checks),
+    )
+    monkeypatch.setenv("BLS_DEVICE_CHAIN", "1")
+
+    monkeypatch.setenv("BLS_SHARD", "1")
+    assert B._device_chain_verify([("c1",), ("c2",)]) == [True, True]
+    monkeypatch.setenv("BLS_NO_SHARD", "1")
+    assert B._device_chain_verify([("c3",)]) == [True]
+    assert calls == [("sharded", 2), ("single", 1)]
+
+
+def test_verify_points_falls_back_identically(monkeypatch):
+    """BLS_NO_SHARD pins the single-device chain for the same entries
+    the sharded route would get — the env-gated fallback of the serving
+    path (crypto/bls/batch.py)."""
+    from lambda_ethereum_consensus_tpu.crypto.bls import batch as B
+    from lambda_ethereum_consensus_tpu.ops import bls_batch, bls_shard
+
+    seen = {}
+    monkeypatch.setattr(
+        bls_shard, "sharded_chain_verify",
+        lambda checks, **kw: seen.setdefault("sharded", checks)
+        and [True] * len(checks) or [True] * len(checks),
+    )
+    monkeypatch.setattr(
+        bls_batch, "chain_verify",
+        lambda checks, **kw: seen.setdefault("single", checks)
+        and [True] * len(checks) or [True] * len(checks),
+    )
+    monkeypatch.setenv("BLS_DEVICE_CHAIN", "1")
+    monkeypatch.setenv("BLS_DEVICE_CHAIN_MIN", "1")
+
+    from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
+
+    entries = [(C.G1_GENERATOR, b"m", C.G2_GENERATOR)] * 2
+    monkeypatch.setenv("BLS_SHARD", "1")
+    assert B.verify_points(entries) is True
+    monkeypatch.setenv("BLS_NO_SHARD", "1")
+    assert B.verify_points(entries) is True
+    assert "sharded" in seen and "single" in seen
+    # both implementations received the same packed layout
+    (s_entries, s_h, s_gids), = seen["sharded"]
+    (e_entries, e_h, e_gids), = seen["single"]
+    assert len(s_entries) == len(e_entries) == 2
+    assert s_gids == e_gids and len(s_h) == len(e_h) == 1
+
+
+def test_handlers_select_sharded_path(monkeypatch):
+    """With BLS_SHARD_DRAIN opted in, on_attestation_batch tags the
+    batch span/trace with the sharded path and the mesh width and runs
+    the host-prep body; WITHOUT the opt-in the epoch-committee cached
+    drain stays selected even when the sharded plane is active."""
+    from lambda_ethereum_consensus_tpu.fork_choice import handlers as H
+
+    monkeypatch.setenv("BLS_DEVICE_CHAIN", "1")
+    monkeypatch.setenv("BLS_DEVICE_CHAIN_MIN", "1")
+    monkeypatch.setenv("BLS_SHARD", "1")
+
+    ran = {}
+
+    def fake_host(store, attestations, is_from_block, spec, results):
+        ran["body"] = "host"
+
+    def fake_cached(store, attestations, is_from_block, spec, results):
+        ran["body"] = "cached"
+
+    monkeypatch.setattr(H, "_attestation_batch_host", fake_host)
+    monkeypatch.setattr(H, "_attestation_batch_cached", fake_cached)
+
+    spans = []
+
+    def fake_span(name, slow=None, **labels):
+        spans.append((name, labels))
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    monkeypatch.setattr(H, "span", fake_span)
+    spec = object()
+    # sharded plane active but drain NOT opted in: cached body keeps the
+    # drain (the committee cache is the r04-measured machinery)
+    H.on_attestation_batch(object(), [object(), object()], spec=spec)
+    assert ran["body"] == "cached"
+    assert spans[-1][1]["path"] == "cached"
+
+    monkeypatch.setenv("BLS_SHARD_DRAIN", "1")
+    H.on_attestation_batch(object(), [object(), object()], spec=spec)
+    assert ran["body"] == "host"
+    name, labels = spans[-1]
+    assert name == "attestation_batch_verify"
+    assert labels["path"] == "sharded"
+    assert labels["n_devices"] >= 1
+
+
+def test_record_verify_batch_carries_n_devices():
+    from lambda_ethereum_consensus_tpu import tracing as T
+
+    rec = T.get_recorder()
+    was = rec.enabled
+    rec.set_enabled(True)
+    rec.clear()
+    try:
+        t = T.new_trace("test-shard")
+        import time as _t
+
+        T.record_verify_batch(
+            [t], [None], "sharded", _t.monotonic(), 0.001, n_devices=8
+        )
+        t.end("done", {})
+        evs = rec.chrome()["traceEvents"]
+        (batch,) = [e for e in evs if e.get("ph") == "X"]
+        assert batch["args"]["n_devices"] == 8
+        assert batch["args"]["path"] == "sharded"
+    finally:
+        rec.set_enabled(was)
+        rec.clear()
+
+
+# --------------------------------------------------- padding discipline
+
+
+def test_pad_to_devices_discipline():
+    # pow2 operands (every caller's case): pad is max(m, d)
+    for m in (1, 2, 4, 8, 16):
+        for d in (1, 2, 4, 8):
+            assert pad_to_devices(m, d) == max(m, d)
+    # general contract: smallest multiple of d >= m
+    assert pad_to_devices(5, 4) == 8
+    assert pad_to_devices(9, 8) == 16
+    with pytest.raises(ValueError):
+        pad_to_devices(4, 0)
+
+
+def test_sharded_entry_deal_reserves_dead_slot():
+    """The round-robin deal keeps >= 1 dead slot per device even when a
+    device is full — the off-by-one that would corrupt every padding
+    gather (bls_shard's bl > ceil(n/d) rule)."""
+    d = 8
+    for n in (1, 7, 8, 9, 64, 65):
+        q = 8  # interpret-mode quantum
+        nl = -(-n // d)
+        bl = (nl // q + 1) * q
+        assert bl * d > n
+        assert bl > nl  # the busiest device keeps a dead tail slot
+
+
+# ------------------------------------------------- sharded Merkle plane
+
+
+def test_merkle_root_words_sharded_matches_single_device():
+    _require_mesh(8)
+    from lambda_ethereum_consensus_tpu.ops.sha256 import (
+        _merkle_tree_jnp,
+        merkle_root_words_sharded,
+    )
+
+    rng = np.random.default_rng(7)
+    words = rng.integers(0, 2**32, size=(64, 16), dtype=np.uint32)
+    got = np.asarray(merkle_root_words_sharded(words))
+    want = np.asarray(_merkle_tree_jnp(words, 6))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_merkle_root_device_shard_route_bit_identical(monkeypatch):
+    _require_mesh(8)
+    from lambda_ethereum_consensus_tpu.ops import sha256 as S
+
+    rng = np.random.default_rng(11)
+    chunks = rng.integers(0, 256, size=(256, 32), dtype=np.uint8)
+    monkeypatch.setenv("SSZ_NO_SHARD", "1")
+    want = S.merkle_root_device(chunks)
+    monkeypatch.delenv("SSZ_NO_SHARD")
+    monkeypatch.setenv("SSZ_SHARD", "1")  # force past the size floor
+    got = S.merkle_root_device(chunks)
+    assert got == want
+
+
+def test_merkle_shard_respects_size_floor(monkeypatch):
+    """Without the force flag, small trees stay on the single-device
+    program (the conftest CPU mesh makes every test 'multi-device' —
+    the floor is what keeps unit-scale SSZ off the collective)."""
+    from lambda_ethereum_consensus_tpu.ops import sha256 as S
+
+    monkeypatch.delenv("SSZ_SHARD", raising=False)
+    monkeypatch.delenv("SSZ_NO_SHARD", raising=False)
+    assert S._shard_tree_enabled(8) is False
+    # virtual CPU mesh: even registry-scale trees stay single-device
+    # unless forced (multi-device TPU is the only default-on backend)
+    assert S._shard_tree_enabled(S._shard_tree_min_blocks()) is False
+    monkeypatch.setenv("SSZ_NO_SHARD", "1")
+    assert S._shard_tree_enabled(1 << 20) is False
+
+
+# ------------------------------------------- sharded registry placement
+
+
+def test_plane_store_sharded_placement_equality(monkeypatch):
+    """BLS_SHARD_PLANES=1 deals the registry column axis over the mesh;
+    committee sums through the sharded buffer match host affine math
+    (and the unsharded store) exactly, and growth keeps the layout."""
+    _require_mesh(8)
+    monkeypatch.setenv("BLS_SHARD_PLANES", "1")
+    from lambda_ethereum_consensus_tpu.crypto.bls import curve as C
+    from lambda_ethereum_consensus_tpu.ops import bls_batch as BB
+    from lambda_ethereum_consensus_tpu.ops.bls_g1 import _ints_batch
+
+    pts = [C.g1.multiply_raw(C.G1_GENERATOR, 3 + 5 * i) for i in range(16)]
+    rx, ry = BB._g1_planes(pts)
+    store = BB.RegistryPlaneStore(interpret=True, min_capacity=8)
+    assert store._sharded is True
+    store.update(rx, ry)
+    from jax.sharding import NamedSharding
+
+    assert isinstance(store.rx.sharding, NamedSharding)
+
+    comm = np.array([[0, 1, 2, 3], [4, 5, 6, 7]], np.int32)
+    cache = BB.DeviceCommitteeCache(store, comm, chunk=2)
+
+    def host_sum(idxs):
+        acc = None
+        for i in idxs:
+            acc = pts[i] if acc is None else C.g1.affine_add(acc, pts[i])
+        return acc
+
+    sx = np.asarray(cache.sum_x)
+    sy = np.asarray(cache.sum_y)
+    for ci, idxs in enumerate(comm):
+        want = host_sum(idxs)
+        got = (
+            _ints_batch(sx[:, ci : ci + 1].T.astype(np.int32))[0],
+            _ints_batch(sy[:, ci : ci + 1].T.astype(np.int32))[0],
+        )
+        assert got == want
+
+    # growth within capacity keeps the sharded layout
+    pts2 = pts + [C.g1.multiply_raw(C.G1_GENERATOR, 997)] * 4
+    rx2, ry2 = BB._g1_planes(pts2)
+    store.update(rx2, ry2)
+    assert store.count == 20
+    assert isinstance(store.rx.sharding, NamedSharding)
