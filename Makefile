@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate slo-smoke lint clean
 
 all: native
 
@@ -34,6 +34,13 @@ lint:
 test: native
 	python -m pytest tests/ -q -m "not spectest and not device"
 	python -m pytest tests/unit/test_shard_plane.py -q
+	python scripts/slo_check.py --smoke
+
+# The SLO budget gate alone (round 12): a recorded load profile through
+# the real ingest pipeline + API, evaluated against slo.DEFAULT_SLOS —
+# exits nonzero with a structured violation report on any budget miss.
+slo-smoke:
+	python scripts/slo_check.py --smoke
 
 # Device-kernel lane: plane/einsum stacks on the CPU backend.  The
 # multi-minute compile units (sharded mesh verify, bisection chain, the
@@ -70,6 +77,13 @@ spec-test-dryrun:
 
 bench:
 	python bench.py
+
+# Artifact self-check (round 12): the artifact must be non-empty and
+# every env-enabled stage must carry a result or a truncated:true
+# absence record — the rc-124 empty-BENCH_r05 failure mode can never
+# silently recur.  BENCH_ARTIFACT overrides the newest BENCH_r*.json.
+bench-validate:
+	python bench.py --validate "$${BENCH_ARTIFACT:-$$(ls -t BENCH_r*.json | head -1)}"
 
 clean:
 	$(MAKE) -C native clean

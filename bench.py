@@ -60,6 +60,203 @@ import numpy as np
 
 _T0 = time.monotonic()
 _TRUNCATED: list[str] = []  # stages skipped by the total-budget guard
+_EMITTED: list[dict] = []  # every record printed this run (self-check)
+
+
+def _emit(rec: dict) -> None:
+    """Print one artifact record AND remember it for the end-of-run
+    self-check (rc-124/BENCH_r05: an artifact must never again end the
+    round empty without the run itself saying so)."""
+    _EMITTED.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+# ---- artifact self-check (round 12 satellite) ---------------------------
+#
+# The per-stage metric inventory, gated the same way main() gates the
+# stages: validation demands, for every metric a run SHOULD have
+# produced, either a result (value != null) or an explicit
+# ``truncated: true`` absence record.  A crashed stage's honest-absence
+# record (value null + note) deliberately FAILS validation — the gate's
+# job is "did this round record a number", not "did it explain why not".
+
+_STAGE_METRICS: tuple[tuple[str | None, tuple[str, ...]], ...] = (
+    (None, ("ssz_merkle_node_hashes_per_sec",)),
+    ("BENCH_NO_MAINNET", (
+        "mainnet_state_root_warm_s",
+        "mainnet_state_root_incremental_slot_s",
+        "epoch_boundary_root_s",
+        "capella_replay_blocks_per_sec",
+    )),
+    ("BENCH_NO_INGEST", (
+        "node_ingest_aggregate_verifications_per_sec",
+        "node_first_verify_s",
+    )),
+    ("BENCH_NO_PLANES", (
+        "registry_planes_resident_bytes",
+        "registry_context_rebuild_s",
+    )),
+    ("BENCH_NO_PIPELINE", (
+        "pipeline_overload_block_p95_ms",
+        "pipeline_overload_shed_lowest_frac",
+        "pipeline_coalesce_batch_gain",
+        "pipeline_sched_overhead_us_per_item",
+    )),
+    ("BENCH_NO_TELEMETRY", (
+        "telemetry_span_overhead_pct",
+        "telemetry_noop_overhead_pct",
+    )),
+    ("BENCH_NO_TRACE", (
+        "trace_overhead_pct",
+        "trace_noop_overhead_pct",
+    )),
+    ("BENCH_NO_SHARD", ("sharded_verify_entries_per_sec",)),
+    (None, ("aggregate_bls_verifications_per_sec",)),
+)
+
+
+def _disabled_stage_gates(env=None) -> list[str]:
+    """The BENCH_NO_* knobs active in ``env`` — recorded into the run's
+    first artifact line so validation can judge the artifact by the
+    knobs the PRODUCING run honored, not the validator's shell."""
+    env = os.environ if env is None else env
+    return sorted(
+        gate for gate, _metrics in _STAGE_METRICS
+        if gate is not None and env.get(gate)
+    )
+
+
+def required_metrics(env=None) -> tuple[str, ...]:
+    """Every metric the given env's stage gating says a run must record
+    (``env`` defaults to the validator's shell — callers with a better
+    source of truth, like the artifact's own recorded knobs, pass it)."""
+    env = os.environ if env is None else env
+    out: list[str] = []
+    for gate, metrics in _STAGE_METRICS:
+        if gate is None or not env.get(gate):
+            out.extend(metrics)
+    return tuple(out)
+
+
+def _artifact_env(records) -> dict | None:
+    """The producing run's stage knobs, if any record carried them
+    (``disabled_stages`` on the budget line since round 12); ``None``
+    means an older artifact — fall back to the validator's shell."""
+    for rec in records:
+        if isinstance(rec, dict) and isinstance(rec.get("disabled_stages"), list):
+            return {gate: "1" for gate in rec["disabled_stages"]}
+    return None
+
+
+def validate_records(records, required) -> list[str]:
+    """Problems with one artifact's record list (empty list = valid).
+
+    A surviving ``bench_artifact_selfcheck`` record with ``ok: true``
+    vouches for the whole run: the in-run check saw the FULL record
+    stream, while a driver-wrapper artifact keeps only a bounded stdout
+    tail — early-stage records scroll out of it on a long healthy run,
+    and judging those as "missing" would fail exactly the rounds that
+    recorded the most.  A failed or absent selfcheck falls through to
+    the full per-metric audit."""
+    metric_recs: dict[str, list[dict]] = {}
+    for rec in records:
+        if isinstance(rec, dict) and isinstance(rec.get("metric"), str):
+            metric_recs.setdefault(rec["metric"], []).append(rec)
+    if not metric_recs:
+        return ["artifact contains no metric records at all"]
+    for rec in metric_recs.get("bench_artifact_selfcheck", ()):
+        if rec.get("ok") is True:
+            # the vouch covers only records PRINTED BEFORE the selfcheck
+            # line — the records it listed as pending (the headline,
+            # emitted after it) must still be audited, or a run killed
+            # between the two flushes would validate green while missing
+            # the round's primary metric
+            still_pending = set(rec.get("pending") or ())
+            required = [m for m in required if m in still_pending]
+            break
+    problems = []
+    for name in required:
+        recs = metric_recs.get(name)
+        if not recs:
+            problems.append(f"stage metric {name!r} missing from artifact")
+            continue
+        if not any(
+            rec.get("value") is not None or rec.get("truncated") is True
+            for rec in recs
+        ):
+            note = next((r.get("note") for r in recs if r.get("note")), None)
+            suffix = f" (note: {note})" if note else ""
+            problems.append(
+                f"stage metric {name!r} has neither a result nor a "
+                f"truncated:true absence record{suffix}"
+            )
+    return problems
+
+
+def _artifact_records(path: str) -> list[dict]:
+    """Parse a bench artifact: the driver's wrapper JSON (``tail`` holds
+    the run's stdout lines, ``parsed`` sometimes the last record), a
+    plain JSON list, or raw JSON-lines output from ``python bench.py``."""
+    with open(path) as fh:
+        text = fh.read()
+    records: list[dict] = []
+
+    def _scan_lines(blob: str) -> None:
+        for line in blob.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        data = None
+    if isinstance(data, dict) and ("tail" in data or "parsed" in data):
+        _scan_lines(data.get("tail") or "")
+        parsed = data.get("parsed")
+        if isinstance(parsed, dict):
+            records.append(parsed)
+        elif isinstance(parsed, list):
+            records.extend(r for r in parsed if isinstance(r, dict))
+    elif isinstance(data, list):
+        records.extend(r for r in data if isinstance(r, dict))
+    elif isinstance(data, dict):
+        records.append(data)
+    else:
+        _scan_lines(text)
+    return records
+
+
+def validate_main(path: str) -> int:
+    """``python bench.py --validate ARTIFACT`` — the ``make
+    bench-validate`` entry point.  Exit 0 iff the artifact is non-empty
+    and every stage required under the current BENCH_NO_* env has a
+    result or a truncated absence record."""
+    try:
+        records = _artifact_records(path)
+    except OSError as e:
+        print(f"bench-validate: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    required = required_metrics(env=_artifact_env(records))
+    problems = validate_records(records, required)
+    print(json.dumps({
+        "metric": "bench_artifact_validation",
+        "artifact": path,
+        "records": len(records),
+        "required": len(required),
+        "value": len(problems),
+        "unit": "problems",
+        "ok": not problems,
+    }))
+    for p in problems:
+        print(f"bench-validate: {p}", file=sys.stderr)
+    return 1 if problems else 0
 
 
 def _total_budget_s() -> float:
@@ -409,16 +606,19 @@ def _bench_sharded_stage() -> list[dict]:
 def main() -> None:
     # first evidence within seconds of launch (VERDICT r5 next #1a): the
     # budget line also timestamps the run for the truncation note below
-    print(json.dumps({
+    _emit({
         "metric": "bench_total_budget_s",
         "value": _total_budget_s(),
         "unit": "s",
-    }), flush=True)
+        # the stage knobs this run honors: validation of the artifact
+        # judges coverage by THESE, not by the validating shell's env
+        "disabled_stages": _disabled_stage_gates(),
+    })
     ssz_line = _ssz_line_guarded()
 
     if not os.environ.get("BENCH_NO_MAINNET"):
         for rec in _bench_mainnet_root():
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
 
     if not os.environ.get("BENCH_NO_INGEST"):
         # node-path throughput (VERDICT r4 next #1) + boot timeline (#6)
@@ -429,13 +629,13 @@ def main() -> None:
             units={"node_ingest_aggregate_verifications_per_sec":
                    "aggregate verifications/s"},
         ):
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
         for rec in _bench_script(
             "bench_boot.py", ("node_first_verify_s",),
             float(os.environ.get("BENCH_BOOT_BUDGET_S", "600")),
             units={"node_first_verify_s": "s"},
         ):
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
 
     if not os.environ.get("BENCH_NO_PLANES"):
         # registry-plane sharing: device bytes resident must be flat in
@@ -447,7 +647,7 @@ def main() -> None:
             units={"registry_planes_resident_bytes": "bytes",
                    "registry_context_rebuild_s": "s"},
         ):
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
 
     if not os.environ.get("BENCH_NO_PIPELINE"):
         # ingest scheduler regimes (ISSUE 3): bounded high-priority p95 +
@@ -465,7 +665,7 @@ def main() -> None:
                    "pipeline_coalesce_batch_gain": "x",
                    "pipeline_sched_overhead_us_per_item": "us/item"},
         ):
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
 
     if not os.environ.get("BENCH_NO_TELEMETRY"):
         # span/no-op overhead on the synthetic gossip drain (ISSUE 2:
@@ -477,7 +677,7 @@ def main() -> None:
             units={"telemetry_span_overhead_pct": "%",
                    "telemetry_noop_overhead_pct": "%"},
         ):
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
 
     if not os.environ.get("BENCH_NO_TRACE"):
         # causal-tracing overhead on the same synthetic drain (ISSUE 4:
@@ -490,13 +690,13 @@ def main() -> None:
             units={"trace_overhead_pct": "%",
                    "trace_noop_overhead_pct": "%"},
         ):
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
 
     if not os.environ.get("BENCH_NO_SHARD"):
         # sharded crypto plane on the 8-way mesh (probe-guarded; falls
         # back to the virtual CPU mesh when no live multichip backend)
         for rec in _bench_sharded_stage():
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
 
     bls_recs, err = _bench_bls()
     if err is not None:
@@ -509,22 +709,48 @@ def main() -> None:
                "note": f"bls chain bench failed: {err}"}
         if "total bench budget exhausted" in err:
             rec["truncated"] = True
-        print(json.dumps(rec), flush=True)
+        _emit(rec)
         for rec in bls_recs:  # partial records (e.g. smoke) still count
-            print(json.dumps(rec), flush=True)
+            _emit(rec)
         if _TRUNCATED:
-            print(json.dumps(_truncation_record()), flush=True)
-        print(json.dumps(ssz_line), flush=True)
+            _emit(_truncation_record())
+        _emit(_selfcheck_record(pending=[ssz_line]))
+        _emit(ssz_line)
     else:
-        print(json.dumps(ssz_line), flush=True)
+        _emit(ssz_line)
         if _TRUNCATED:
-            print(json.dumps(_truncation_record()), flush=True)
+            _emit(_truncation_record())
+        headline = [
+            rec for rec in bls_recs
+            if rec["metric"] == "aggregate_bls_verifications_per_sec"
+        ]
         for rec in bls_recs:
             if rec["metric"] != "aggregate_bls_verifications_per_sec":
-                print(json.dumps(rec), flush=True)
-        for rec in bls_recs:
-            if rec["metric"] == "aggregate_bls_verifications_per_sec":
-                print(json.dumps(rec), flush=True)
+                _emit(rec)
+        _emit(_selfcheck_record(pending=headline))
+        for rec in headline:
+            _emit(rec)
+
+
+def _selfcheck_record(pending: list[dict]) -> dict:
+    """The run's own artifact validation (the same check ``make
+    bench-validate`` applies to a saved artifact), emitted second-to-last
+    so the headline contract holds.  ``pending`` carries records the
+    caller will still print after this line."""
+    problems = validate_records(_EMITTED + pending, required_metrics())
+    return {
+        "metric": "bench_artifact_selfcheck",
+        "value": len(problems),
+        "unit": "problems",
+        "ok": not problems,
+        # metrics vouched for but not yet flushed when this line prints:
+        # a later validator must still audit THESE from the artifact
+        "pending": sorted({
+            rec.get("metric") for rec in pending
+            if isinstance(rec.get("metric"), str)
+        }),
+        "note": "; ".join(problems[:6]) or None,
+    }
 
 
 def _truncation_record() -> dict:
@@ -542,4 +768,11 @@ def _truncation_record() -> dict:
 
 
 if __name__ == "__main__":
+    if "--validate" in sys.argv:
+        i = sys.argv.index("--validate")
+        if i + 1 >= len(sys.argv):
+            print("usage: python bench.py --validate ARTIFACT.json",
+                  file=sys.stderr)
+            sys.exit(2)
+        sys.exit(validate_main(sys.argv[i + 1]))
     main()

@@ -19,7 +19,19 @@ debug contract from the causal-tracing round):
 - ``GET /debug/lanes`` — live ingest scheduler/lane snapshot (depths,
   deficits, oldest waits, degraded latch);
 - ``GET /debug/slot`` — current slot-phase summary (slot, offset,
-  sub-interval, store/head slots) from the node's slot clock.
+  sub-interval, store/head slots) from the node's slot clock;
+- ``GET /debug/compile`` — the AOT compile/retrace attribution table
+  (ops/aot.py): every cached executable with shapes, compile/load cost,
+  cache hit/miss counts, causing call site and last use;
+- ``GET /debug/slo`` — one SLO-engine evaluation (observed quantiles vs
+  budgets, multi-window burn rates) as JSON; ``scripts/slo_check.py``
+  turns the same report into a CI exit code.
+
+Every matched route records its handler latency into the
+``api_request_seconds{route=...}`` histogram (the family the
+``api_request_p99`` SLO budgets), labeled with the route pattern's
+readable form (``/eth/v1/beacon/states/{id}/root``) so cardinality is
+bounded by the route table, not by request paths.
 """
 
 from __future__ import annotations
@@ -61,6 +73,12 @@ class BeaconApiServer:
         self.node = node
         self._server: asyncio.AbstractServer | None = None
         self._inline_paths = frozenset(p for p, _ in self._inline_routes())
+        # route pattern -> bounded-cardinality label for api_request_seconds
+        # ("/eth/v1/beacon/states/([^/]+)/root" -> ".../{id}/root")
+        self._route_labels = {
+            pattern: pattern.replace("([^/]+)", "{id}")
+            for pattern, _ in self._routes()
+        }
 
     # Routes answered ON the event loop (derived from _inline_routes in
     # __init__ — the patterns are literal paths): trivially cheap, and
@@ -130,6 +148,7 @@ class BeaconApiServer:
         for pattern, handler in self._routes():
             m = re.fullmatch(pattern, path)
             if m:
+                t0 = time.perf_counter()
                 try:
                     return handler(*m.groups())
                 except KeyError:
@@ -143,6 +162,14 @@ class BeaconApiServer:
                     # connection task silently
                     log.exception("beacon api handler failed on %s", path)
                     return self._error(500, "internal error")
+                finally:
+                    # handler latency (error answers included) into the
+                    # family the api_request_p99 SLO budgets
+                    get_metrics().observe(
+                        "api_request_seconds",
+                        time.perf_counter() - t0,
+                        route=self._route_labels[pattern],
+                    )
         return self._error(404, "unknown route")
 
     def _route_inline(self, method: str, path: str) -> tuple[str, str, bytes]:
@@ -154,12 +181,21 @@ class BeaconApiServer:
         for pattern, handler in self._inline_routes():
             m = re.fullmatch(pattern, path)
             if m:
+                t0 = time.perf_counter()
                 try:
                     return handler(*m.groups())
                 except KeyError:
                     return self._error(404, "not found")
                 except ValueError as e:
                     return self._error(400, str(e))
+                finally:
+                    # one lock + bisect — cheap enough for the loop-
+                    # serialized inline handlers it times
+                    get_metrics().observe(
+                        "api_request_seconds",
+                        time.perf_counter() - t0,
+                        route=self._route_labels[pattern],
+                    )
         return self._error(404, "unknown route")
 
     def _routes(self) -> list[tuple[str, Callable]]:
@@ -172,6 +208,8 @@ class BeaconApiServer:
             (r"/eth/v2/debug/beacon/states/([^/]+)", self._debug_state),
             (r"/metrics", self._metrics),
             (r"/debug/trace", self._debug_trace),
+            (r"/debug/compile", self._debug_compile),
+            (r"/debug/slo", self._debug_slo),
         ] + self._inline_routes()
 
     def _inline_routes(self) -> list[tuple[str, Callable]]:
@@ -324,6 +362,39 @@ class BeaconApiServer:
             "200 OK",
             "application/json",
             json.dumps(get_recorder().chrome()).encode(),
+        )
+
+    def _debug_compile(self) -> tuple[str, str, bytes]:
+        """The AOT compile/retrace attribution table: every cached
+        executable with its shape signature, compile/load seconds, cache
+        hit/miss counts, causing call site and last use — plus the
+        process-wide stat counters.  Offloaded route: the table snapshot
+        copies under ops/aot._LOCK."""
+        from ..ops.aot import aot_stats, compile_profile, shape_buckets
+
+        return self._json({
+            "data": {
+                "stats": aot_stats(),
+                "warmed_buckets": {
+                    "attestation_entries": list(
+                        shape_buckets("attestation_entries")
+                    ),
+                },
+                "executables": compile_profile(),
+            }
+        })
+
+    def _debug_slo(self) -> tuple[str, str, bytes]:
+        """One READ-ONLY evaluation of the process-wide SLO engine.  The
+        engine is shared with the node tick loop, so the burn-rate
+        windows served here carry the tick history; a node-less process
+        still gets the cumulative quantiles.  emit/snapshot are off so a
+        polling client can neither inflate the evaluation/violation
+        counters nor shorten the snapshot deque's window."""
+        from ..slo import get_engine
+
+        return self._json(
+            {"data": get_engine().evaluate(emit=False, snapshot=False)}
         )
 
     def _debug_lanes(self) -> tuple[str, str, bytes]:

@@ -30,6 +30,7 @@ from ..network.peerbook import Peerbook
 from ..network.port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT
 from ..network.reqresp import BlockDownloader, ReqRespServer
 from ..pipeline import IngestScheduler, LaneConfig
+from ..slo import get_engine
 from ..state_transition import misc
 from ..store import BlockStore, KvStore, StateStore
 from ..tracing import (
@@ -684,7 +685,17 @@ class BeaconNode:
         head_block = self.store.blocks.get(head)
         if head_block is None:
             return
+        first = self._head_root is None
         self._head_root = head
+        if first:
+            # adopting the anchor at boot is not a head UPDATE: the
+            # anchor's age (minutes on a devnet, hours after checkpoint
+            # sync) would land one giant sample in
+            # head_update_delay_seconds and leave the round-12
+            # head_update_delay_p95 SLO violated until real transitions
+            # dilute it.  Real catch-up transitions still observe —
+            # their huge delays are the point (see PR-4 note above).
+            return
         delay = observe_head_update(self.slot_clock, int(head_block.slot))
         get_recorder().record(
             "inst", 0, "head_update",
@@ -704,6 +715,11 @@ class BeaconNode:
             try:
                 on_tick(self.store, int(time.time()), self.spec)
                 self._sample_device_telemetry()
+                # one SLO evaluation per tick: publishes the slo_* gauges
+                # and appends the burn-rate snapshot the multi-window
+                # evaluation (and /debug/slo) reads — at 1 Hz the engine's
+                # bounded history covers well past the slow window
+                get_engine().evaluate()
                 if self.store.head_cache is not None:
                     # O(1) cached head for the per-tick gauge — the full
                     # LMD-GHOST get_head stays on the consensus-critical
@@ -785,12 +801,11 @@ class BeaconNode:
                 "state_attestation_context_count",
                 float(attestation.state_context_count()),
             )
-        from ..ops.aot import aot_stats  # import-light (no jax at import)
-
-        stats = aot_stats()
-        proc_m.set_gauge("bls_aot_retraces", float(stats.get("retraces", 0)))
-        proc_m.set_gauge("bls_aot_compiles", float(stats.get("compiles", 0)))
-        proc_m.set_gauge("bls_aot_loads", float(stats.get("loads", 0)))
+        # AOT retrace/compile/load counts are no longer per-tick gauge
+        # copies of ops/aot._STATS: round 12 promoted them to process-wide
+        # counters (aot_retraces_total & co) emitted at the increment
+        # sites in ops/aot.py, so they exist — and scrape correctly as
+        # counters — without a running node tick loop.
         # flight-recorder vitals: occupancy + overwrite pressure per tick
         # (a dropped_total climbing faster than the scrape interval means
         # the ring window is shorter than the debugging horizon)

@@ -21,6 +21,9 @@ from __future__ import annotations
 import threading
 import time
 
+from ..ops.aot import compile_context
+from ..telemetry import observe
+
 __all__ = [
     "DrainShapes",
     "warm_drain_programs",
@@ -75,9 +78,15 @@ def warm_sharded_programs(shapes: DrainShapes) -> float:
         entries = [(C.G1_GENERATOR, C.G2_GENERATOR, 1)] * per_check
         gids = [i % groups for i in range(per_check)]
         checks.append((entries, h_points, gids))
-    ok = sharded_chain_verify(checks, coeff_bits=shapes.coeff_bits)
+    # compile_context tags every lower/compile this dummy verify causes,
+    # so /debug/compile attributes them to the planned warmup rather
+    # than to a mid-drain retrace
+    with compile_context("warmup:sharded"):
+        ok = sharded_chain_verify(checks, coeff_bits=shapes.coeff_bits)
     assert len(ok) == len(checks)
-    return time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    observe("warmup_phase_seconds", dt, phase="sharded")
+    return dt
 
 
 def warm_drain_programs(shapes: DrainShapes) -> float:
@@ -99,46 +108,51 @@ def warm_drain_programs(shapes: DrainShapes) -> float:
         warm_sharded_programs(shapes)
     interpret = not BB._use_planes()
     ops = BB._get_chain_ops(interpret)
+    t_single = time.perf_counter()
 
-    b, _dead = BB._entry_budget(shapes.entries, interpret)
-    kp = BB._pow2(shapes.committee)
-    mmax = BB._pow2(max(shapes.committee // 8, 2))
-    m1 = BB._pow2(shapes.groups + 1) - 1
-    per_check = (shapes.entries + shapes.checks - 1) // shapes.checks
-    s = BB._pow2(max(per_check // max(shapes.groups // shapes.checks, 1), 1))
-    e = BB._pow2(per_check)
+    with compile_context("warmup:drain"):
+        b, _dead = BB._entry_budget(shapes.entries, interpret)
+        kp = BB._pow2(shapes.committee)
+        mmax = BB._pow2(max(shapes.committee // 8, 2))
+        m1 = BB._pow2(shapes.groups + 1) - 1
+        per_check = (shapes.entries + shapes.checks - 1) // shapes.checks
+        s = BB._pow2(max(per_check // max(shapes.groups // shapes.checks, 1), 1))
+        e = BB._pow2(per_check)
 
-    zreg = jnp.zeros((32, shapes.n_validators), jnp.int32)
-    chunk = min(256, max(1, shapes.n_committees))
-    ops["committee_sums"](
-        zreg, zreg,
-        jnp.zeros((chunk, kp), jnp.int32),
-        jnp.zeros((chunk, kp), bool),
+        zreg = jnp.zeros((32, shapes.n_validators), jnp.int32)
+        chunk = min(256, max(1, shapes.n_committees))
+        ops["committee_sums"](
+            zreg, zreg,
+            jnp.zeros((chunk, kp), jnp.int32),
+            jnp.zeros((chunk, kp), bool),
+        )
+        sx = jnp.zeros((32, shapes.n_committees), jnp.int32)
+        ax, ay, _ = ops["agg_corrected"](
+            zreg, zreg, sx, sx,
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b, mmax), jnp.int32),
+            jnp.ones((b, mmax), bool),
+        )
+        kb = jnp.zeros((shapes.coeff_bits, b), jnp.int32)
+        lv = jnp.zeros((b,), bool)
+        jac1 = ops["ladder_g1"](ax, ay, kb, lv)
+        jac2 = ops["ladder_g2"](
+            jnp.zeros((32, 2, b), jnp.int32), jnp.zeros((32, 2, b), jnp.int32),
+            kb, lv,
+        )
+        px, py, qx, qy, mask = ops["prep"](
+            jac1, jac2,
+            jnp.zeros((shapes.checks, m1, s), jnp.int32),
+            jnp.zeros((shapes.checks, e), jnp.int32),
+            jnp.zeros((32, 2, shapes.checks, m1), jnp.int32),
+            jnp.zeros((32, 2, shapes.checks, m1), jnp.int32),
+            jnp.zeros((shapes.checks, m1 + 1), bool),
+        )
+        f = ops["miller"](px, py, qx, qy)
+        np.asarray(ops["check_tail"](f, mask))  # pull: blocks until loaded
+    observe(
+        "warmup_phase_seconds", time.perf_counter() - t_single, phase="drain"
     )
-    sx = jnp.zeros((32, shapes.n_committees), jnp.int32)
-    ax, ay, _ = ops["agg_corrected"](
-        zreg, zreg, sx, sx,
-        jnp.zeros((b,), jnp.int32),
-        jnp.zeros((b, mmax), jnp.int32),
-        jnp.ones((b, mmax), bool),
-    )
-    kb = jnp.zeros((shapes.coeff_bits, b), jnp.int32)
-    lv = jnp.zeros((b,), bool)
-    jac1 = ops["ladder_g1"](ax, ay, kb, lv)
-    jac2 = ops["ladder_g2"](
-        jnp.zeros((32, 2, b), jnp.int32), jnp.zeros((32, 2, b), jnp.int32),
-        kb, lv,
-    )
-    px, py, qx, qy, mask = ops["prep"](
-        jac1, jac2,
-        jnp.zeros((shapes.checks, m1, s), jnp.int32),
-        jnp.zeros((shapes.checks, e), jnp.int32),
-        jnp.zeros((32, 2, shapes.checks, m1), jnp.int32),
-        jnp.zeros((32, 2, shapes.checks, m1), jnp.int32),
-        jnp.zeros((shapes.checks, m1 + 1), bool),
-    )
-    f = ops["miller"](px, py, qx, qy)
-    np.asarray(ops["check_tail"](f, mask))  # pull: blocks until loaded
     return time.perf_counter() - t0
 
 

@@ -29,15 +29,22 @@ this cache is the TPU build's equivalent for XLA programs.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
 import pickle
+import sys
 import threading
+import time
+
+from ..telemetry import inc, observe
 
 __all__ = [
     "aot_jit",
     "aot_dir",
     "aot_stats",
+    "compile_context",
+    "compile_profile",
     "register_shape_bucket",
     "shape_buckets",
 ]
@@ -45,8 +52,143 @@ __all__ = [
 _LOCK = threading.Lock()
 # "retraces": how often a batch-verify entry point had to LOWER (trace) a
 # program for a new argument-shape signature — the per-tick jit-retrace
-# gauge; disk loads deliberately skip tracing and don't count
+# gauge; disk loads deliberately skip tracing and don't count.
+# Kept as a plain dict for aot_stats() consumers (bench_chain's summary);
+# the process-wide telemetry counters (aot_retraces_total & co, emitted at
+# the increment sites below) are the durable copies — they live on the
+# default registry, so retrace/compile counts survive and scrape without
+# a running node tick loop.
 _STATS = {"loads": 0, "compiles": 0, "saves": 0, "errors": 0, "retraces": 0}
+
+# The compile/retrace attribution table, keyed (entry point, argument
+# signature): one row per program the cache has ever resolved, carrying
+# who caused it (call site), under which context (live drain vs warmup),
+# what it cost (lower/compile/load seconds) and how the cache behaved
+# (hit/miss/load/compile counts, last use).  Served at /debug/compile.
+_PROFILE: dict[tuple[str, str], dict] = {}
+
+# Compile-context label (thread-local: the warmer runs on its own daemon
+# thread while live traffic may compile concurrently on another).
+_CTX = threading.local()
+
+
+@contextlib.contextmanager
+def compile_context(label: str):
+    """Tag compiles/retraces performed inside the block with ``label``
+    (e.g. ``"warmup:drain"``) so the attribution table can tell a
+    planned warmup compile from a mid-drain retrace — the latter is the
+    10-80 s dead-air failure mode the shape-bucket discipline exists to
+    prevent."""
+    prev = getattr(_CTX, "label", None)
+    _CTX.label = label
+    try:
+        yield
+    finally:
+        _CTX.label = prev
+
+
+def _ctx_label() -> str:
+    return getattr(_CTX, "label", None) or "live"
+
+
+def _caller_site(depth: int = 2) -> str:
+    """``pkg-relative/file.py:line`` of the nearest frame outside this
+    module — the call site charged with a retrace/compile.  Only runs on
+    the cache-miss path (misses cost seconds; a stack probe costs ns)."""
+    try:
+        f = sys._getframe(depth)
+    except ValueError:
+        return "?"
+    here = _caller_site.__code__.co_filename
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "?"
+    fname = f.f_code.co_filename.replace(os.sep, "/")
+    marker = "lambda_ethereum_consensus_tpu/"
+    idx = fname.rfind(marker)
+    tail = fname[idx:] if idx >= 0 else "/".join(fname.rsplit("/", 2)[-2:])
+    return f"{tail}:{f.f_lineno}"
+
+
+def _profile_entry(name: str, sig: str, caller: str) -> dict:
+    with _LOCK:
+        entry = _PROFILE.get((name, sig))
+        if entry is None:
+            entry = _PROFILE[(name, sig)] = {
+                "entry": name,
+                "signature": sig,
+                "caller": caller,
+                "context": _ctx_label(),
+                "source": None,  # disk | compile | uncached
+                "hits": 0,
+                "misses": 0,
+                "loads": 0,
+                "compiles": 0,
+                "saves": 0,
+                "errors": 0,
+                "lower_seconds": 0.0,
+                "compile_seconds": 0.0,
+                "load_seconds": 0.0,
+                "created": time.time(),
+                "last_use": 0.0,
+            }
+        return entry
+
+
+def compile_profile() -> list[dict]:
+    """Snapshot of the attribution table, most-recently-used first (the
+    ``/debug/compile`` payload).  Rows are copies — callers may mutate."""
+    with _LOCK:
+        entries = [dict(e) for e in _PROFILE.values()]
+    entries.sort(key=lambda e: (e["last_use"], e["created"]), reverse=True)
+    return entries
+
+
+def _note_retrace(name: str, sig: str, caller: str, lower_s: float) -> None:
+    """One program TRACE (lower) for a new shape signature: the event the
+    shape-bucket discipline tries to keep off the live drain path.  Emits
+    the process-wide counter plus a flight-recorder instant so retraces
+    land on the /debug/trace Perfetto timeline next to the batches they
+    stalled."""
+    inc("aot_retraces_total")
+    from ..tracing import get_recorder
+
+    get_recorder().record(
+        "inst", 0, "retrace",
+        {
+            "entry": name,
+            "caller": caller,
+            "context": _ctx_label(),
+            "lower_s": round(lower_s, 3),
+            "signature": sig,
+        },
+    )
+
+
+def _note_compile(name: str, compile_s: float) -> None:
+    inc("aot_compiles_total")
+    observe("aot_compile_seconds", compile_s, entry=name)
+    from ..tracing import get_recorder
+
+    get_recorder().record(
+        "inst", 0, "xla_compile",
+        {"entry": name, "context": _ctx_label(),
+         "compile_s": round(compile_s, 3)},
+    )
+
+
+def _note_load(name: str, load_s: float) -> None:
+    inc("aot_loads_total")
+    observe("aot_load_seconds", load_s, entry=name)
+
+
+def _note_save() -> None:
+    inc("aot_saves_total")
+
+
+def _note_error(stage: str) -> None:
+    inc("aot_errors_total", stage=stage)
 
 # Warmed batch-shape buckets, by kind (e.g. "attestation_entries"):
 # node/warmup.py advertises the shapes its dummy drain loads, and the
@@ -147,6 +289,7 @@ def aot_jit(fn, name: str):
     memory and one pickle per signature on disk.
     """
     compiled_by_sig: dict = {}
+    profile_by_sig: dict = {}  # sig -> its _PROFILE row (hit-path handle)
 
     def _log(msg: str) -> None:
         if os.environ.get("BLS_AOT_LOG"):
@@ -159,9 +302,21 @@ def aot_jit(fn, name: str):
         sig = _sig(args)
         hit = compiled_by_sig.get(sig)
         if hit is not None:
+            prof_hit = profile_by_sig.get(sig)
+            if prof_hit is not None:
+                # two dict ops against a ms-scale device dispatch.
+                # Deliberately lock-free: `+=` is a read-modify-write, so
+                # concurrent hits (warmer thread + live drain) can lose an
+                # increment — acceptable for a diagnostic attribution
+                # count, not worth a lock on the dispatch hot path
+                prof_hit["hits"] += 1
+                prof_hit["last_use"] = time.time()
             return hit(*args)
 
-        import time as _t
+        prof = _profile_entry(name, sig, _caller_site())
+        prof["misses"] += 1
+        prof["last_use"] = time.time()
+        profile_by_sig[sig] = prof
 
         base = aot_dir()
         path = None
@@ -179,18 +334,25 @@ def aot_jit(fn, name: str):
                     deserialize_and_load,
                 )
 
-                t1 = _t.perf_counter()
+                t1 = time.perf_counter()
                 with open(path, "rb") as fh:
                     payload, in_tree, out_tree = pickle.load(fh)
                 loaded = deserialize_and_load(payload, in_tree, out_tree)
-                _log(f"{name}: AOT loaded in {_t.perf_counter() - t1:.1f}s")
+                load_s = time.perf_counter() - t1
+                _log(f"{name}: AOT loaded in {load_s:.1f}s")
                 with _LOCK:
                     _STATS["loads"] += 1
+                prof["loads"] += 1
+                prof["load_seconds"] += load_s
+                prof["source"] = "disk"
+                _note_load(name, load_s)
                 compiled_by_sig[sig] = loaded
             except Exception as e:
                 _log(f"{name}: AOT load FAILED ({type(e).__name__}: {e})")
                 with _LOCK:
                     _STATS["errors"] += 1
+                prof["errors"] += 1
+                _note_error("load")
                 loaded = None  # fall through to a fresh compile
             if loaded is not None:
                 # invoke OUTSIDE the try: a genuine runtime error from the
@@ -198,17 +360,21 @@ def aot_jit(fn, name: str):
                 # and trigger a silent recompile + second execution
                 return loaded(*args)
 
-        t0 = _t.perf_counter()
+        t0 = time.perf_counter()
         try:
             lowered = fn.lower(*args)
         except Exception:
             # functions the lowering path can't handle (e.g. non-jitted
             # callables slipped in) just run directly, uncached
+            prof["source"] = "uncached"
             compiled_by_sig[sig] = fn
             return fn(*args)
-        _log(f"{name}: lowered in {_t.perf_counter() - t0:.1f}s")
+        lower_s = time.perf_counter() - t0
+        _log(f"{name}: lowered in {lower_s:.1f}s")
         with _LOCK:
             _STATS["retraces"] += 1
+        prof["lower_seconds"] += lower_s
+        _note_retrace(name, sig, prof["caller"], lower_s)
 
         # 2) compile (and best-effort persist).  The axon tunnel's
         # remote_compile endpoint occasionally drops the connection
@@ -216,11 +382,18 @@ def aot_jit(fn, name: str):
         # read") — a transient infra fault, not a program error — so
         # retry a couple of times before giving up.
         compiled = None
-        t2 = _t.perf_counter()
         for attempt in range(3):
+            # per-attempt clock: a successful retry must not charge the
+            # failed attempt's wall time + backoff sleep to compile cost
+            t2 = time.perf_counter()
             try:
                 compiled = lowered.compile()
-                _log(f"{name}: COMPILED in {_t.perf_counter() - t2:.1f}s")
+                compile_s = time.perf_counter() - t2
+                _log(f"{name}: COMPILED in {compile_s:.1f}s")
+                prof["compiles"] += 1
+                prof["compile_seconds"] += compile_s
+                prof["source"] = "compile"
+                _note_compile(name, compile_s)
                 break
             except Exception as e:
                 # only the tunnel's transport faults are retryable —
@@ -237,8 +410,8 @@ def aot_jit(fn, name: str):
                     raise
                 with _LOCK:
                     _STATS["errors"] += 1
-                import time
-
+                prof["errors"] += 1
+                _note_error("compile_retry")
                 time.sleep(2.0 * (attempt + 1))
         with _LOCK:
             _STATS["compiles"] += 1
@@ -255,9 +428,13 @@ def aot_jit(fn, name: str):
                 os.replace(tmp, path)
                 with _LOCK:
                     _STATS["saves"] += 1
+                prof["saves"] += 1
+                _note_save()
             except Exception:
                 with _LOCK:
                     _STATS["errors"] += 1
+                prof["errors"] += 1
+                _note_error("save")
         return compiled(*args)
 
     call.__name__ = f"aot_{name}"

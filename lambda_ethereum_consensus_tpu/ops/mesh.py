@@ -44,6 +44,16 @@ def default_mesh():
         from jax.sharding import Mesh
 
         _DEFAULT_MESH = Mesh(np.array(jax.devices()), axis_names=("dp",))
+        # one timeline instant on the flight recorder: the mesh coming up
+        # is the moment the sharded plane's program identities are fixed,
+        # so every later retrace/compile instant reads against it
+        from ..tracing import get_recorder
+
+        get_recorder().record(
+            "inst", 0, "mesh_init",
+            {"devices": int(_DEFAULT_MESH.devices.size),
+             "backend": jax.default_backend()},
+        )
     return _DEFAULT_MESH
 
 

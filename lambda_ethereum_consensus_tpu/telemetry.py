@@ -99,12 +99,24 @@ _HELP = {
     "state_attestation_context_count": "live state-keyed epoch attestation contexts",
     "attestation_context_evictions_count": "epoch-LRU context evictions",
     "checkpoint_cache_pruned_count": "checkpoint states/contexts pruned on finality",
-    "bls_aot_retraces": "jit retraces of the batch-verify device programs",
     "ops_shard_devices": "devices in the sharded crypto plane's dp mesh",
     "ops_shard_batch_per_device": "padded verify entries per device shard",
     "ops_shard_combine_seconds": "sharded Miller + Fq12 partial-product combine dispatch",
-    "bls_aot_compiles": "XLA compiles of the batch-verify device programs",
-    "bls_aot_loads": "AOT executable cache loads",
+    "aot_retraces_total": "program traces (lowers) for a new argument-shape signature",
+    "aot_compiles_total": "XLA compiles of device programs (per shape signature)",
+    "aot_loads_total": "AOT executable cache disk loads",
+    "aot_saves_total": "compiled executables serialized to the AOT cache",
+    "aot_errors_total": "AOT cache faults by stage (load|compile_retry|save)",
+    "aot_compile_seconds": "XLA compile wall time per entry point",
+    "aot_load_seconds": "AOT executable deserialize wall time per entry point",
+    "warmup_phase_seconds": "background warmer phase wall time by phase",
+    "api_request_seconds": "beacon API handler latency by route",
+    "slo_quantile_seconds": "observed quantile per SLO (log-bucket estimate)",
+    "slo_budget_seconds": "configured budget per SLO",
+    "slo_ok": "1 while the SLO's observed quantile is within budget",
+    "slo_burn_rate": "error-budget burn rate per SLO and window",
+    "slo_evaluations_total": "SLO engine evaluation passes",
+    "slo_violations_total": "budget violations observed at evaluation, by SLO",
     "ingest_degraded_transitions_total": "degraded-latch activations (0->1 flips)",
     "pipeline_drain_restarts_total": "supervised ingest drain-loop restarts",
     "slot_block_arrival_offset_seconds": "gossip block arrival offset into its slot",
@@ -401,6 +413,22 @@ class Metrics:
             if hist is None:
                 return None
             return (self._buckets[name], list(hist.counts), hist.sum, hist.count)
+
+    def histogram_series(self, name: str):
+        """Every recorded series of one histogram family:
+        ``[(labels, bounds, bucket_counts, sum, count), ...]`` with the
+        counts copied under the lock (the SLO engine merges them into one
+        family-level distribution; a torn read would break cumulative
+        bucket monotonicity the same way it would break a scrape)."""
+        with self._lock:
+            bounds = self._buckets.get(name)
+            if bounds is None:
+                return []
+            return [
+                (key[1], bounds, list(h.counts), h.sum, h.count)
+                for key, h in self._hists.items()
+                if key[0] == name
+            ]
 
     def key_count(self) -> int:
         """Total metric keys across all families (0 in no-op mode)."""

@@ -1,0 +1,430 @@
+"""SLO budget gate: drive a recorded load profile through the real
+pipeline, then evaluate the declarative budget set and exit nonzero on
+violation.
+
+This is the CI-runnable half of the round-12 performance observatory
+(the live half is the node tick loop evaluating the same engine and
+``/debug/slo`` serving it).  The gate exercises REAL components, not
+synthetic metric injection:
+
+1. **Ingest pipeline**: a deterministic paced feed (block / aggregate /
+   subnet lanes at mainnet-shaped ratios) through the real
+   ``IngestScheduler`` — fills ``ingest_sched_seconds``,
+   ``ingest_flush_wait_seconds`` et al — with one ``ItemTrace`` minted
+   per item at admission and terminated through the real
+   ``record_verify_batch`` fan-in, which is what fills
+   ``attestation_admit_apply_seconds``.
+2. **Slot-phase clock**: a recorded arrival schedule (seeded RNG —
+   identical every run) replayed through ``observe_block_arrival`` /
+   ``observe_head_update`` with explicit instants, so the slot-phase
+   quantiles are wall-clock independent.
+3. **Beacon API**: a real ``BeaconApiServer`` answering a burst of GETs
+   (health/identity/metrics/debug routes) — fills
+   ``api_request_seconds`` through the same dispatch the node serves.
+
+The gate never lets no_data read as green silently: every SLO the
+profile is declared to exercise (:data:`EXERCISED`) must produce
+observations — an empty exercised family is itself a violation (the
+profile broke), and SLOs the profile cannot drive (the gossip drain
+span needs a live Port/subscription stack) are listed on stderr as
+UNCHECKED so the gap is loud; their budgets are enforced on a live
+node via the tick-loop engine and ``/debug/slo``.
+
+Exit codes: 0 = every budget met, 1 = at least one violation (each
+printed as a structured line naming the series, window and
+observed-vs-budget quantile) or an exercised SLO with no data,
+2 = usage error.
+
+Usage:
+  python scripts/slo_check.py --smoke                  # CI gate (~2 s)
+  python scripts/slo_check.py --budget ingest_lane_wait_p95=0.0001
+                                                       # deliberate fail
+  python scripts/slo_check.py --list                   # show budget set
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.api.beacon_api import BeaconApiServer  # noqa: E402
+from lambda_ethereum_consensus_tpu.pipeline import (  # noqa: E402
+    IngestScheduler,
+    LaneConfig,
+)
+from lambda_ethereum_consensus_tpu.slo import (  # noqa: E402
+    DEFAULT_SLOS,
+    SloEngine,
+)
+from lambda_ethereum_consensus_tpu.telemetry import get_metrics  # noqa: E402
+from lambda_ethereum_consensus_tpu.tracing import (  # noqa: E402
+    SlotClock,
+    get_recorder,
+    new_trace,
+    observe_block_arrival,
+    observe_head_update,
+    record_verify_batch,
+)
+
+
+# SLOs this script's load profile actually drives.  An SLO listed here
+# that ends the run with zero observations means the PROFILE broke — the
+# gate fails rather than reading an accidental no_data as green.
+EXERCISED = frozenset({
+    "attestation_admit_apply_p95",   # trace fan-in in VerifySink.process
+    "block_arrival_offset_p95",      # replay_slot_phases
+    "head_update_delay_p95",         # replay_slot_phases
+    "ingest_lane_wait_p95",          # scheduler lane flushes
+    "ingest_sched_p99",              # scheduler drain rounds
+    "api_request_p99",               # drive_api GET burst
+})
+
+
+class VerifySink:
+    """Lane source terminating item traces through the real batch fan-in
+    (the thing that fills ``attestation_admit_apply_seconds``)."""
+
+    def __init__(self, name: str, per_batch_s: float = 0.0005,
+                 per_item_s: float = 5e-6):
+        self.name = name
+        self.per_batch_s = per_batch_s
+        self.per_item_s = per_item_s
+        self.processed = 0
+        self.sheds = 0
+
+    async def process(self, items):
+        self.processed += len(items)
+        traces = [trace for trace, _seq in items]
+        t0 = time.monotonic()
+        cost = self.per_batch_s + self.per_item_s * len(items)
+        if cost > 0:
+            await asyncio.sleep(cost)
+        record_verify_batch(
+            traces, [None] * len(items), "slo_check", t0,
+            time.monotonic() - t0,
+        )
+        for trace in traces:
+            if trace is not None:
+                trace.end("done")
+
+    async def shed(self, item, reason: str = "overload"):
+        self.sheds += 1
+        trace = item[0]
+        if trace is not None:
+            trace.end("shed", {"reason": reason})
+
+
+async def _paced(submit_one, rate_hz: float, duration_s: float):
+    """Credit-paced submission in 10 ms ticks (bench_pipeline's pacing —
+    sub-ms sleeps would measure the event loop, not the pipeline)."""
+    tick = 0.01
+    per_tick = rate_hz * tick
+    t0 = time.monotonic()
+    seq = 0
+    credit = 0.0
+    while (now := time.monotonic()) - t0 < duration_s:
+        credit += per_tick
+        n, credit = int(credit), credit - int(credit)
+        for _ in range(n):
+            await submit_one(seq)
+            seq += 1
+        await asyncio.sleep(max(0.0, tick - (time.monotonic() - now)))
+
+
+async def _feed(sched, lane: str, source: VerifySink, rate_hz: float,
+                duration_s: float):
+    async def submit_one(seq):
+        trace = new_trace(f"slo:{lane}")
+        # trace rides both as the kwarg (scheduler notes enqueue/dequeue,
+        # ends sheds) and inside the item (the sink's fan-in needs it)
+        for src, item, reason in sched.submit(
+            lane, (trace, seq), source, trace=trace
+        ):
+            await src.shed(item, reason)
+
+    await _paced(submit_one, rate_hz, duration_s)
+
+
+async def drive_pipeline(engine: SloEngine, duration_s: float,
+                         rates: dict) -> dict:
+    """The scheduler phase: three lanes, mainnet-shaped rates, engine
+    burn-rate snapshots every 250 ms."""
+    sched = IngestScheduler(metrics=get_metrics())
+    sched.add_lane(LaneConfig(
+        name="block", priority=0, weight=64, max_batch=64, max_queue=1024,
+        deadline_s=0.025, coalesce_target=1, shed_newest=True,
+    ))
+    sched.add_lane(LaneConfig(
+        name="aggregate", priority=1, weight=512, max_batch=512,
+        max_queue=8192, deadline_s=0.1, coalesce_target=64,
+    ))
+    sched.add_lane(LaneConfig(
+        name="subnet", priority=2, weight=512, max_batch=512,
+        max_queue=8192, deadline_s=0.1, coalesce_target=64,
+    ))
+    blocks = VerifySink("block")
+    aggs = VerifySink("aggregate")
+    votes = VerifySink("subnet")
+
+    async def snapshotter():
+        while True:
+            await asyncio.sleep(0.25)
+            engine.tick()
+
+    snap = asyncio.ensure_future(snapshotter())
+    sched.start()
+    try:
+        await asyncio.gather(
+            _feed(sched, "block", blocks, rates["block"], duration_s),
+            _feed(sched, "aggregate", aggs, rates["aggregate"], duration_s),
+            _feed(sched, "subnet", votes, rates["subnet"], duration_s),
+        )
+        await asyncio.sleep(0.3)  # let the deadline flush drain the tail
+    finally:
+        snap.cancel()
+        await sched.stop()
+    return {
+        "processed": blocks.processed + aggs.processed + votes.processed,
+        "sheds": blocks.sheds + aggs.sheds + votes.sheds,
+    }
+
+
+def replay_slot_phases(n_slots: int, seed: int) -> int:
+    """The recorded arrival schedule: blocks landing a deterministic
+    offset into their slot, head updates a bit later — replayed with
+    explicit instants so the quantiles never depend on wall clock."""
+    rng = random.Random(seed)
+    sps = 12
+    genesis = 1_700_000_000
+    clock = SlotClock(genesis, sps)
+    for slot in range(n_slots):
+        arrival = clock.slot_start(slot) + rng.uniform(0.3, 2.5)
+        observe_block_arrival(clock, slot, now=arrival)
+        observe_head_update(clock, slot, now=arrival + rng.uniform(0.4, 1.2))
+    return n_slots
+
+
+async def drive_api(n_requests: int) -> tuple[int, list[str]]:
+    """A burst of real HTTP GETs against a live BeaconApiServer (no
+    store attached: the health/identity/metrics/debug routes are the
+    targets — the dispatch and worker-thread offload are the real
+    thing being timed into api_request_seconds).  Returns the 200 count
+    plus the paths that answered anything else: a broken debug route
+    answers its 500 in sub-ms, which would keep the latency SLO green
+    while the route is dead — availability is checked separately."""
+    api = BeaconApiServer(store=None, spec=None)
+    await api.start()
+    paths = (
+        "/eth/v1/node/health",
+        "/eth/v1/node/identity",
+        "/metrics",
+        "/debug/compile",
+        "/debug/slo",
+    )
+    async def one(path: str) -> bool:
+        reader, writer = await asyncio.open_connection("127.0.0.1", api.port)
+        try:
+            writer.write(
+                f"GET {path} HTTP/1.1\r\nHost: gate\r\n\r\n".encode()
+            )
+            await writer.drain()
+            body = await reader.read()
+            return body.startswith(b"HTTP/1.1 200")
+        finally:
+            writer.close()
+
+    served = 0
+    failed: list[str] = []
+    try:
+        for i in range(n_requests):
+            path = paths[i % len(paths)]
+            try:
+                # a wedged route must become a structured violation, not
+                # an indefinite CI hang with zero diagnostics
+                ok = await asyncio.wait_for(one(path), timeout=10.0)
+            except (asyncio.TimeoutError, OSError):
+                ok = False
+            if ok:
+                served += 1
+            else:
+                failed.append(path)
+    finally:
+        await api.stop()
+    return served, failed
+
+
+def _usage_error(message: str):
+    print(f"slo_check: {message}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def parse_budget_overrides(pairs: list[str]) -> dict[str, float]:
+    overrides = {}
+    for pair in pairs:
+        name, _, value = pair.partition("=")
+        if not value:
+            _usage_error(f"--budget wants name=value, got {pair!r}")
+        try:
+            overrides[name] = float(value)
+        except ValueError:
+            _usage_error(f"--budget value not a number: {pair!r}")
+    return overrides
+
+
+def build_slos(overrides: dict[str, float]):
+    known = {s.name for s in DEFAULT_SLOS}
+    unknown = sorted(set(overrides) - known)
+    if unknown:
+        _usage_error(
+            f"unknown SLO name(s): {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(known))})"
+        )
+    try:
+        return tuple(
+            dataclasses.replace(s, budget=overrides[s.name])
+            if s.name in overrides else s
+            for s in DEFAULT_SLOS
+        )
+    except ValueError as e:
+        # SloDef.__post_init__ rejects e.g. --budget x=0: that's a usage
+        # error (exit 2), not an SLO violation (exit 1)
+        _usage_error(str(e))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="short CI profile (~2 s of load)")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="pipeline phase seconds (default: 1.5 smoke, 6 full)")
+    ap.add_argument("--budget", action="append", default=[],
+                    metavar="NAME=SECONDS",
+                    help="override one SLO's budget (repeatable)")
+    ap.add_argument("--seed", type=int, default=12,
+                    help="recorded-profile RNG seed")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the report to PATH")
+    ap.add_argument("--list", action="store_true",
+                    help="print the budget set and exit")
+    args = ap.parse_args()
+
+    slos = build_slos(parse_budget_overrides(args.budget))
+    if args.list:
+        for s in slos:
+            print(f"{s.name}: p{int(s.quantile * 100)}({s.family}) "
+                  f"<= {s.budget}s — {s.description}")
+        return 0
+
+    # the gate measures; it must not be silently disabled by the env
+    get_metrics().set_enabled(True)
+    get_recorder().set_enabled(True)
+
+    engine = SloEngine(slos=slos)
+    duration = args.duration if args.duration is not None else (
+        1.5 if args.smoke else 6.0
+    )
+    rates = (
+        {"block": 8, "aggregate": 300, "subnet": 800}
+        if args.smoke else
+        {"block": 16, "aggregate": 1000, "subnet": 3000}
+    )
+
+    t0 = time.monotonic()
+    load = asyncio.run(drive_pipeline(engine, duration, rates))
+    slots = replay_slot_phases(8 if args.smoke else 64, args.seed)
+    n_api = 25 if args.smoke else 100
+    served, api_failed = asyncio.run(drive_api(n_api))
+
+    report = engine.evaluate()
+    if api_failed:
+        # a dead route answers its 500 fast — latency green, route
+        # broken; availability failures are first-class violations
+        report["violations"].append({
+            "slo": "api_gate_availability",
+            "series": "api_request_seconds",
+            "window": "cumulative",
+            "quantile": 1.0,
+            "observed": None,
+            "budget": 1.0,
+            "count": n_api,
+            "reason": (
+                f"only {served}/{n_api} gate API requests returned 200 "
+                f"(non-200 paths: {sorted(set(api_failed))})"
+            ),
+        })
+        report["ok"] = False
+    # the anti-silent-green pass: exercised SLOs must have data, and
+    # undriveable ones are surfaced as unchecked rather than omitted
+    report["unchecked"] = []
+    for row in report["slos"]:
+        if row["count"] > 0:
+            continue
+        if row["slo"] in EXERCISED:
+            report["violations"].append({
+                "slo": row["slo"],
+                "series": row["series"],
+                "window": "cumulative",
+                "quantile": row["quantile"],
+                "observed": None,
+                "budget": row["budget"],
+                "count": 0,
+                "reason": "no_data from an exercised profile stage",
+            })
+            report["ok"] = False
+        else:
+            report["unchecked"].append(row["slo"])
+    report["profile"] = {
+        "mode": "smoke" if args.smoke else "full",
+        "duration_s": round(time.monotonic() - t0, 3),
+        "pipeline_items": load["processed"],
+        "pipeline_sheds": load["sheds"],
+        "slots_replayed": slots,
+        "api_requests_ok": served,
+        "api_requests_expected": n_api,
+        "seed": args.seed,
+    }
+    print(json.dumps(report, indent=2))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2)
+
+    for v in report["violations"]:
+        observed = (
+            f"{v['observed']:.6f}s" if v["observed"] is not None
+            else "no_data"
+        )
+        reason = f" reason={v['reason']!r}" if v.get("reason") else ""
+        print(
+            "SLO VIOLATION "
+            f"slo={v['slo']} series={v['series']} window={v['window']} "
+            f"p{int(v['quantile'] * 100)} observed={observed} "
+            f"budget={v['budget']:.6f}s count={v['count']}{reason}",
+            file=sys.stderr,
+        )
+    for name in report["unchecked"]:
+        print(
+            f"slo_check: UNCHECKED {name} — not exercised by this "
+            "profile; budget enforced on a live node via /debug/slo",
+            file=sys.stderr,
+        )
+    if report["violations"]:
+        return 1
+    checked = len(report["slos"]) - len(report["unchecked"])
+    print(
+        f"slo_check: {checked} SLOs within budget "
+        f"({len(report['unchecked'])} unchecked by this profile)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

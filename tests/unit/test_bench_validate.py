@@ -1,0 +1,165 @@
+"""bench.py artifact self-check (round 12 satellite): required-metric
+coverage, truncated-absence acceptance, artifact parsing of all three
+on-disk shapes, and the --validate CLI exit codes."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO_ROOT)
+
+import bench  # noqa: E402
+
+
+def test_required_metrics_honors_env_gates():
+    everything = bench.required_metrics(env={})
+    assert "ssz_merkle_node_hashes_per_sec" in everything
+    assert "aggregate_bls_verifications_per_sec" in everything
+    assert "pipeline_overload_block_p95_ms" in everything
+    gated = bench.required_metrics(env={
+        "BENCH_NO_MAINNET": "1", "BENCH_NO_INGEST": "1",
+        "BENCH_NO_PLANES": "1", "BENCH_NO_PIPELINE": "1",
+        "BENCH_NO_TELEMETRY": "1", "BENCH_NO_TRACE": "1",
+        "BENCH_NO_SHARD": "1",
+    })
+    # the ungated headline pair survives every knob
+    assert set(gated) == {
+        "ssz_merkle_node_hashes_per_sec",
+        "aggregate_bls_verifications_per_sec",
+    }
+
+
+def test_validate_records_result_or_truncated():
+    required = ("a", "b", "c", "d")
+    records = [
+        {"metric": "a", "value": 1.0},                       # result
+        {"metric": "b", "value": None, "truncated": True},   # honest clip
+        {"metric": "c", "value": None, "note": "crashed: x"},  # crash
+        # d missing entirely
+    ]
+    problems = bench.validate_records(records, required)
+    assert len(problems) == 2
+    assert any("'c'" in p and "neither a result nor" in p for p in problems)
+    assert any("'d'" in p and "missing" in p for p in problems)
+    # a crash note is surfaced in the problem text
+    assert any("crashed: x" in p for p in problems)
+
+
+def test_validation_prefers_the_producing_runs_recorded_knobs(tmp_path):
+    """An artifact recording disabled_stages is judged by THOSE knobs,
+    not the validating shell's env (which may differ)."""
+    artifact = tmp_path / "BENCH_knobs.json"
+    lines = [
+        {"metric": "bench_total_budget_s", "value": 7000, "unit": "s",
+         # the producing run disabled everything but the two headliners
+         "disabled_stages": [g for g, _m in bench._STAGE_METRICS if g]},
+        {"metric": "ssz_merkle_node_hashes_per_sec", "value": 5e9},
+        {"metric": "aggregate_bls_verifications_per_sec", "value": 6710.0},
+    ]
+    artifact.write_text("\n".join(json.dumps(r) for r in lines) + "\n")
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--validate", str(artifact)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=60,
+        env=dict(os.environ),  # validator shell has NO BENCH_NO_* set
+    )
+    assert out.returncode == 0, out.stderr
+    assert bench._artifact_env(lines) == {
+        g: "1" for g, _m in bench._STAGE_METRICS if g
+    }
+    assert bench._artifact_env([{"metric": "x"}]) is None  # old artifacts
+
+
+def test_validate_records_trusts_surviving_selfcheck():
+    """The driver wrapper keeps a bounded stdout tail: a long healthy
+    run's early records scroll out.  A surviving in-run selfcheck with
+    ok:true vouches for the full stream; a failed one does not."""
+    required = ("a", "b")
+    tail_only = [
+        {"metric": "bench_artifact_selfcheck", "value": 0, "ok": True},
+        {"metric": "b", "value": 2.0},
+        # "a" scrolled out of the tail
+    ]
+    assert bench.validate_records(tail_only, required) == []
+    failed = [
+        {"metric": "bench_artifact_selfcheck", "value": 1, "ok": False},
+        {"metric": "b", "value": 2.0},
+    ]
+    problems = bench.validate_records(failed, required)
+    assert any("'a'" in p for p in problems)
+    # the vouch does NOT cover records the selfcheck only PROMISED: a
+    # run killed between the selfcheck flush and the pending headline
+    # flush must still fail on the missing headline
+    truncated_after_selfcheck = [
+        {"metric": "bench_artifact_selfcheck", "value": 0, "ok": True,
+         "pending": ["b"]},
+        {"metric": "a", "value": 1.0},
+        # "b" (the headline) never made it to disk
+    ]
+    problems = bench.validate_records(truncated_after_selfcheck, required)
+    assert any("'b'" in p and "missing" in p for p in problems)
+
+
+def test_validate_records_empty_artifact_is_one_loud_problem():
+    assert bench.validate_records([], ("a",)) == [
+        "artifact contains no metric records at all"
+    ]
+    assert bench.validate_records([{"rc": 124}], ("a",)) == [
+        "artifact contains no metric records at all"
+    ]
+
+
+def test_artifact_records_parses_driver_wrapper_and_json_lines(tmp_path):
+    rec = {"metric": "x", "value": 1}
+    wrapper = tmp_path / "wrapper.json"
+    wrapper.write_text(json.dumps({
+        "rc": 0,
+        "tail": "noise line\n" + json.dumps(rec) + "\n",
+        "parsed": {"metric": "y", "value": 2},
+    }))
+    got = bench._artifact_records(str(wrapper))
+    assert {r.get("metric") for r in got} == {"x", "y"}
+
+    lines = tmp_path / "lines.json"
+    lines.write_text(json.dumps(rec) + "\nnot json\n" + json.dumps({"metric": "z", "value": None}) + "\n")
+    got = bench._artifact_records(str(lines))
+    assert {r.get("metric") for r in got} == {"x", "z"}
+
+
+def test_validate_cli_fails_on_empty_rc124_artifact(tmp_path):
+    artifact = tmp_path / "BENCH_empty.json"
+    artifact.write_text(json.dumps(
+        {"n": 5, "rc": 124, "tail": "", "parsed": None}
+    ))
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--validate", str(artifact)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=60,
+    )
+    assert out.returncode == 1
+    assert "no metric records at all" in out.stderr
+    summary = json.loads(out.stdout.splitlines()[0])
+    assert summary["ok"] is False and summary["records"] == 0
+
+
+def test_validate_cli_passes_on_covered_artifact(tmp_path):
+    env = dict(os.environ)
+    # narrow the required set to the two ungated metrics
+    for knob in ("BENCH_NO_MAINNET", "BENCH_NO_INGEST", "BENCH_NO_PLANES",
+                 "BENCH_NO_PIPELINE", "BENCH_NO_TELEMETRY", "BENCH_NO_TRACE",
+                 "BENCH_NO_SHARD"):
+        env[knob] = "1"
+    artifact = tmp_path / "BENCH_ok.json"
+    artifact.write_text(
+        json.dumps({"metric": "ssz_merkle_node_hashes_per_sec", "value": 5e9})
+        + "\n"
+        + json.dumps({"metric": "aggregate_bls_verifications_per_sec",
+                      "value": None, "truncated": True})
+        + "\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--validate", str(artifact)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=60, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.splitlines()[0])["ok"] is True

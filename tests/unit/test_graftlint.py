@@ -561,6 +561,86 @@ def test_metric_contract_passes_when_consistent(tmp_path):
     assert findings == []
 
 
+def test_metric_contract_slo_over_ghost_family_fires(tmp_path):
+    """An SLO over a never-emitted series is a lint error (round 12):
+    the gate would evaluate to permanent no_data green."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "slo.py": """
+            class SloDef:
+                def __init__(self, *a, **k):
+                    pass
+
+            DEFAULT_SLOS = (
+                SloDef("ghost_p95", "ghost_seconds", 0.95, 1.0),
+            )
+            """
+        },
+        rules=["metric-contract"],
+    )
+    assert len(findings) == 1
+    assert "SLO definition references family 'ghost_seconds'" in findings[0].message
+    assert "never fires" in findings[0].message
+
+
+def test_metric_contract_slo_over_counter_family_fires(tmp_path):
+    """A budget needs a distribution: an SLO over a counter-only family
+    is flagged even though the family IS emitted."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "telemetry.py": """
+            _HELP = {"requests_total": "requests"}
+            """,
+            "slo.py": """
+            class SloDef:
+                def __init__(self, *a, **k):
+                    pass
+
+            DEFAULT_SLOS = (
+                SloDef("req_p95", family="requests_total",
+                       quantile=0.95, budget=1.0),
+            )
+            """,
+            "app.py": """
+            def handle(m):
+                m.inc("requests_total", route="/x")
+            """,
+        },
+        rules=["metric-contract"],
+    )
+    assert len(findings) == 1
+    assert "not as a histogram" in findings[0].message
+
+
+def test_metric_contract_slo_passes_over_emitted_histogram(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "telemetry.py": """
+            _HELP = {"drain_seconds": "drain latency"}
+            """,
+            "slo.py": """
+            class SloDef:
+                def __init__(self, *a, **k):
+                    pass
+
+            DEFAULT_SLOS = (
+                SloDef("drain_p95", "drain_seconds", 0.95, 1.0),
+            )
+            """,
+            "app.py": """
+            def handle(m):
+                with m.span("drain", topic="blocks"):
+                    pass
+            """,
+        },
+        rules=["metric-contract"],
+    )
+    assert findings == []
+
+
 # ------------------------------------------------- suppression and baseline
 
 

@@ -14,16 +14,23 @@ Three artifacts must agree on every metric family:
 3. the dashboards — every series a Grafana panel references
    (``metrics/grafana/**/*.json`` expr strings, with ``_bucket``/
    ``_sum``/``_count`` folded onto their histogram family, plus the
-   labels its ``by (...)`` clauses and ``{{legend}}`` templates assume).
+   labels its ``by (...)`` clauses and ``{{legend}}`` templates assume);
+4. the SLO definitions — every ``SloDef(...)`` call site's ``family``
+   (slo.py's DEFAULT_SLOS and any ad-hoc definition in the package): a
+   budget over a series no call site emits as a histogram is a gate that
+   can never fire — it evaluates to permanent ``no_data`` green, the
+   silent-dashboard failure mode wearing a pass/fail costume.
 
 Findings: a family emitted but missing from the inventory; a family
 declared but never emitted (dead HELP text — or a typo'd emitter); a
 dashboard series that no code emits (the silent-dashboard failure mode:
 panels render empty and nobody notices); a dashboard label no emitter
-ever attaches.  Span families are checked with their ``_seconds``
-suffix.  Label semantics are union-based: a label is satisfied if ANY
-call site of the family attaches it (per-site label variance is a
-legitimate pattern here — drain-level vs item-level error counts).
+ever attaches; an SLO definition over a never-emitted (or
+non-histogram) family.  Span families are checked with their
+``_seconds`` suffix.  Label semantics are union-based: a label is
+satisfied if ANY call site of the family attaches it (per-site label
+variance is a legitimate pattern here — drain-level vs item-level error
+counts).
 """
 
 from __future__ import annotations
@@ -105,7 +112,63 @@ class MetricContractRule:
                         ),
                     )
                 )
-        findings.extend(self._check_dashboards(project, emitted))
+        hist_families = {f for f, i in emitted.items() if "histogram" in i["kinds"]}
+        findings.extend(self._check_dashboards(project, emitted, hist_families))
+        findings.extend(
+            self._check_slo_definitions(project, emitted, hist_families)
+        )
+        return findings
+
+    # -------------------------------------------------------- SLO contract
+
+    def _check_slo_definitions(
+        self, project: Project, emitted: dict, hist_families: set
+    ) -> list[Finding]:
+        """Every ``SloDef(...)`` family literal must be an emitted
+        HISTOGRAM family — an SLO over a never-emitted series evaluates
+        to permanent no_data and the gate silently never fires."""
+        findings: list[Finding] = []
+        for module in project.modules:
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Call) and call_name(node) == "SloDef"):
+                    continue
+                family = None
+                for kw in node.keywords:
+                    if kw.arg == "family" and isinstance(kw.value, ast.Constant):
+                        family = kw.value.value
+                if family is None and len(node.args) >= 2:
+                    arg = node.args[1]  # SloDef(name, family, quantile, budget)
+                    if isinstance(arg, ast.Constant):
+                        family = arg.value
+                if not isinstance(family, str):
+                    continue
+                if family not in emitted:
+                    findings.append(
+                        Finding(
+                            rule=self.name,
+                            path=module.rel,
+                            line=node.lineno,
+                            message=(
+                                f"SLO definition references family {family!r} "
+                                "but no call site emits it — the budget "
+                                "evaluates to permanent no_data and the gate "
+                                "never fires"
+                            ),
+                        )
+                    )
+                elif family not in hist_families:
+                    findings.append(
+                        Finding(
+                            rule=self.name,
+                            path=module.rel,
+                            line=node.lineno,
+                            message=(
+                                f"SLO definition references {family!r}, which "
+                                "is emitted but not as a histogram — quantile "
+                                "budgets need a distribution"
+                            ),
+                        )
+                    )
         return findings
 
     # -------------------------------------------------------------- sources
@@ -225,9 +288,10 @@ class MetricContractRule:
 
     # ----------------------------------------------------------- dashboards
 
-    def _check_dashboards(self, project: Project, emitted: dict) -> list[Finding]:
+    def _check_dashboards(
+        self, project: Project, emitted: dict, hist_families: set
+    ) -> list[Finding]:
         findings: list[Finding] = []
-        hist_families = {f for f, i in emitted.items() if "histogram" in i["kinds"]}
         for path in sorted(project.root.glob(self.dashboards_glob)):
             try:
                 text = path.read_text()
