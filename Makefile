@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench bench-validate slo-smoke lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate slo-smoke replay-smoke lint clean
 
 all: native
 
@@ -41,6 +41,13 @@ test: native
 # exits nonzero with a structured violation report on any budget miss.
 slo-smoke:
 	python scripts/slo_check.py --smoke
+
+# Quick pipelined-replay proof (round 13): mint a small devnet chain and
+# replay it with full validation, decode prefetch and per-block progress
+# lines — seconds on CPU, no TPU needed.  The mainnet-scale number comes
+# from bench.py's guarded bench_mainnet stage.
+replay-smoke:
+	python scripts/bench_replay.py 64 8
 
 # Device-kernel lane: plane/einsum stacks on the CPU backend.  The
 # multi-minute compile units (sharded mesh verify, bisection chain, the

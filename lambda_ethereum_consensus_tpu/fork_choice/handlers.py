@@ -112,12 +112,13 @@ def on_block(
         "block does not descend from finalized checkpoint",
     )
 
-    # The real compute: full state transition with validation on.
-    with span("block_transition"):
-        state = state_transition(
-            pre_state, signed_block, validate_result=True,
-            execution_engine=execution_engine, spec=spec,
-        )
+    # The real compute: full state transition with validation on (the
+    # block_transition span now lives inside state_transition itself, so
+    # the replay drivers time the same region as the live on_block path).
+    state = state_transition(
+        pre_state, signed_block, validate_result=True,
+        execution_engine=execution_engine, spec=spec,
+    )
     root = block.hash_tree_root(spec)
     store.add_block(root, block, state)
 

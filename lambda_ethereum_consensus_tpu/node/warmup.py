@@ -28,6 +28,7 @@ __all__ = [
     "DrainShapes",
     "warm_drain_programs",
     "warm_sharded_programs",
+    "warm_transition",
     "start_warmer",
 ]
 
@@ -156,10 +157,27 @@ def warm_drain_programs(shapes: DrainShapes) -> float:
     return time.perf_counter() - t0
 
 
-def start_warmer(shapes: DrainShapes, stats: dict | None = None) -> threading.Thread:
-    """Run :func:`warm_drain_programs` on a daemon thread; failures land
-    in ``stats['error']`` (a silent cold start would corrupt the boot
-    timeline's meaning)."""
+def warm_transition(n_validators: int) -> float:
+    """Load/compile the resident-transition kernel set at the registry's
+    padded shape (state_transition/resident.py) so a cold process's first
+    epoch boundary — and the replay drivers' first block — dispatch
+    resident programs instead of tracing mid-transition.  No-op seconds
+    when the resident path is size/env-disabled for this registry."""
+    from ..state_transition.resident import resident_enabled, warm_transition_programs
+
+    if not resident_enabled(n_validators):
+        return 0.0
+    return warm_transition_programs(n_validators)
+
+
+def start_warmer(
+    shapes: DrainShapes, stats: dict | None = None,
+    n_validators: int | None = None,
+) -> threading.Thread:
+    """Run :func:`warm_drain_programs` (and, when the resident transition
+    is enabled for this registry size, :func:`warm_transition`) on a
+    daemon thread; failures land in ``stats['error']`` (a silent cold
+    start would corrupt the boot timeline's meaning)."""
     stats = stats if stats is not None else {}
     # advertise the warmed batch shape BEFORE the dispatch: the ingest
     # scheduler starts snapping flush sizes to this bucket immediately,
@@ -172,6 +190,12 @@ def start_warmer(shapes: DrainShapes, stats: dict | None = None) -> threading.Th
     def run():
         try:
             stats["overlap_s"] = round(warm_drain_programs(shapes), 1)
+            stats["transition_s"] = round(
+                warm_transition(
+                    shapes.n_validators if n_validators is None else n_validators
+                ),
+                1,
+            )
         except Exception as e:  # visible, never fatal to boot
             stats["error"] = f"{type(e).__name__}: {e}"
 

@@ -281,12 +281,22 @@ def _sig(args) -> str:
     return "|".join(parts)
 
 
-def aot_jit(fn, name: str):
+def aot_jit(fn, name: str, disk: bool = True):
     """Wrap a ``jax.jit``-ed callable with a per-shape AOT executable cache.
 
     ``fn`` must support ``.lower(*args)`` (any jitted function does).  The
     wrapper keeps one loaded/compiled executable per argument signature in
     memory and one pickle per signature on disk.
+
+    ``disk=False`` keeps only the in-memory tier.  REQUIRED for programs
+    jitted with ``donate_argnums``: a ``deserialize_and_load``-ed
+    executable's input-output aliasing is unsound (measured on this jax:
+    donated buffers intermittently read garbage after a disk round-trip
+    — the round-13 resident sweep corrupted balance hi-limbs by exactly
+    the aliased carry words), while the same executable used straight
+    from ``lowered.compile()`` is correct.  Donated kernels therefore
+    recompile once per process — they are small element-wise programs,
+    and the boot warmer compiles them off the critical path.
     """
     compiled_by_sig: dict = {}
     profile_by_sig: dict = {}  # sig -> its _PROFILE row (hit-path handle)
@@ -318,7 +328,7 @@ def aot_jit(fn, name: str):
         prof["last_use"] = time.time()
         profile_by_sig[sig] = prof
 
-        base = aot_dir()
+        base = aot_dir() if disk else None
         path = None
         if base is not None:
             key = hashlib.sha256(

@@ -133,6 +133,15 @@ DEFAULT_SLOS = (
         "beacon API handler latency (route-aggregated)",
     ),
     SloDef(
+        "block_transition_p95", "block_transition_seconds",
+        0.95, 12.0,
+        # the mainnet slot budget: a node whose p95 block transition
+        # exceeds one slot can never stay synced, whatever else is fast.
+        # The replay bench pushes the ACTUAL target (>= 1 block/s at 1M
+        # validators); this gate is the node-health floor
+        "full block transition within one mainnet slot",
+    ),
+    SloDef(
         "gossip_drain_p95", "gossip_drain_seconds",
         0.95, 1.0,
         "one gossip batch decode+verify+verdict round",

@@ -1,4 +1,4 @@
-"""Incremental container Merkleization: dirty-subtree reuse across slots.
+"""Incremental container Merkleization: delta-driven subtree reuse.
 
 ``process_slot`` needs ``hash_tree_root(BeaconState)`` every slot; a full
 rehash of a 1M-validator state costs tens of seconds even with the device
@@ -10,18 +10,33 @@ natively per slot (ref: native/ssz_nif/src/lib.rs:26-153); the TPU build
 gets there by not recomputing at all.
 
 ``IncrementalStateRoot`` keeps, per big field, the packed chunk array and
-every Merkle level of its populated subtree.  Each call diffs the current
-value against the cached chunks (value diff for packed uint columns,
-identity diff for lists of immutable containers — every mutation path
-replaces elements, ``Container.__setattr__`` raises) and rehashes only
-the paths from dirty leaves to the root: O(k log N) host hashes instead
-of O(N).  Wholesale changes (epoch balance sweeps) fall back to a full
-field rebuild through the configured backend — the device path for big
-arrays — chosen automatically when a quarter of the chunks moved.
+every Merkle level of its populated subtree.  Deltas arrive two ways:
 
-The engine is exact, not approximate: a false-positive diff only costs
-extra hashes, and every strategy's output is pinned against the plain
-``hash_tree_root`` oracle in tests/unit/test_incremental.py.
+- **Pushed** (round 13): the big list fields ride in
+  ``state_transition.mutable.TrackedList`` objects, each logging its own
+  touched indices and pointing at the list it was adopt-copied from.
+  The engine stamps the exact instance its cache last matched; a later
+  root walks the adopt chain back to the stamp and applies the unioned
+  index logs — no comparison pass at all, and an untouched field
+  returns its cached root in O(1).
+- **Diffed** (fallback): fields whose chain can't vouch (foreign lists,
+  branched lineages, slice/structural mutations, a second engine) are
+  compared against the cached chunks exactly as before — value diff for
+  packed uint columns, identity diff for lists of immutable containers.
+
+Either way only the paths from dirty leaves to the root are rehashed:
+O(k log N) host hashes instead of O(N).  Wholesale changes (epoch
+balance sweeps) fall back to a full field rebuild through the configured
+backend — the device path for big arrays — chosen automatically when a
+quarter of the chunks moved.  The epoch boundary's two structural moves
+are cheaper still: :meth:`IncrementalStateRoot.rotate_participation`
+adopts the current-participation subtree as previous's and installs a
+zero subtree (pure ``ZERO_HASHES`` rows, no hashing) for current.
+
+The engine is exact, not approximate: tracking degrades to ``full`` on
+any mutation it cannot describe per-index, a false-positive delta only
+costs extra hashes, and every strategy's output is pinned against the
+plain ``hash_tree_root`` oracle in tests/unit/test_incremental.py.
 """
 
 from __future__ import annotations
@@ -74,6 +89,20 @@ def _build_levels(chunks: np.ndarray, backend) -> list[np.ndarray]:
     return levels
 
 
+def _zero_levels(m: int) -> list[np.ndarray]:
+    """The populated-subtree levels of ``m`` all-zero chunks — every row
+    of level ``d`` is ``ZERO_HASHES[d]``, so no hashing happens at all
+    (the epoch participation reset installs this in O(m) memset)."""
+    levels = [np.zeros((max(m, 0), 32), np.uint8)]
+    rows, d = m, 0
+    while rows > 1:
+        rows = (rows + 1) // 2
+        d += 1
+        row = np.frombuffer(ZERO_HASHES[d], np.uint8).reshape(1, 32)
+        levels.append(np.repeat(row, rows, axis=0))
+    return levels
+
+
 def _update_paths(levels: list[np.ndarray], dirty: np.ndarray) -> None:
     """Rehash the root paths of ``dirty`` leaf indices in place (host)."""
     for d in range(len(levels) - 1):
@@ -101,15 +130,22 @@ def _cap_root(levels: list[np.ndarray], limit_chunks: int) -> bytes:
 
 
 class _FieldCache:
-    __slots__ = ("strategy", "prev", "chunks", "levels", "count", "root")
+    __slots__ = (
+        "strategy", "prev", "chunks", "levels", "count", "root",
+        "last_list", "stamp_gen",
+    )
 
     def __init__(self, strategy: str):
         self.strategy = strategy
         self.prev = None  # identity snapshot (object-element strategies)
-        self.chunks = None  # packed (m, 32) leaf chunks
+        self.chunks = None  # packed (m, 32) leaf chunks — ALWAYS levels[0]
         self.levels = None
         self.count = -1
         self.root = None
+        # pushed-delta snapshot point: the exact TrackedList instance the
+        # cache last matched, and its mutation generation at that instant
+        self.last_list = None
+        self.stamp_gen = -1
 
 
 def _uint_dtype(t: Uint) -> str | None:
@@ -155,6 +191,42 @@ class IncrementalStateRoot:
         levels = _build_levels(roots, self._host)
         return _cap_root(levels, len(schema))
 
+    def rotate_participation(self, new_current, spec=None) -> bool:
+        """Epoch participation reset as two structural moves: the cached
+        current-participation subtree becomes previous's (the lists were
+        just aliased by ``process_participation_flag_updates``, so the
+        moved cache's snapshot point travels with it), and a zero subtree
+        — no hashing — is installed for current, stamped against the
+        brand-new all-zero list so the very next root is an O(1) cache
+        hit.  Returns False (caller falls back to ordinary diffing) when
+        the current cache isn't in a movable state."""
+        cur = self._fields.get("current_epoch_participation")
+        if cur is None or cur.strategy != "uint" or cur.chunks is None:
+            # no movable subtree: drop both caches, let diffing rebuild
+            self._fields.pop("current_epoch_participation", None)
+            self._fields.pop("previous_epoch_participation", None)
+            return False
+        self._fields["previous_epoch_participation"] = cur
+        fresh = _FieldCache("uint")
+        n = len(new_current)
+        m = (n + 31) // 32  # participation elements are uint8
+        fresh.levels = _zero_levels(m)
+        fresh.chunks, fresh.count = fresh.levels[0], m
+        self._fields["current_epoch_participation"] = fresh
+        self._stamp(fresh, new_current)
+        return True
+
+    @staticmethod
+    def _stamp(cache: _FieldCache, value) -> None:
+        """Record that ``cache`` matches ``value`` at this instant; later
+        mutations logged on the instance (or its adopt-copies) are the
+        exact superset of what can differ."""
+        gen = getattr(value, "gen", None)
+        if gen is None:
+            cache.last_list, cache.stamp_gen = None, -1
+        else:
+            cache.last_list, cache.stamp_gen = value, gen
+
     # ------------------------------------------------------------ fields
     def _field_root(self, fname, ftype, value, spec, backend) -> bytes:
         strategy = self._classify(ftype, spec)
@@ -184,6 +256,34 @@ class IncrementalStateRoot:
                 return "object"
         return "small"
 
+    def _consume_delta(self, cache: _FieldCache, value) -> frozenset | None:
+        """The pushed-delta channel: a superset of the indices at which
+        ``value`` may differ from the cached snapshot, by walking the
+        adopt chain from ``value`` back to the stamped instance and
+        unioning the per-instance mutation logs.  ``None`` means the
+        chain can't vouch (unstamped, branched lineage, a structural op
+        anywhere along the walk, or a structural op on the stamped
+        instance after the stamp) — the caller then value-diffs, which
+        is always exact."""
+        target = cache.last_list
+        if target is None or getattr(value, "gen", None) is None:
+            return None
+        delta: set[int] = set()
+        node = value
+        for _ in range(8):
+            if node is target:
+                if node.full_gen > cache.stamp_gen:
+                    return None  # structural op since the stamp
+                delta.update(node.dirty)  # over-approx: pre-stamp too
+                return frozenset(delta)
+            if node.full_gen > 0:
+                return None  # structural op in an intermediate copy
+            delta.update(node.dirty)
+            node = node.parent
+            if node is None:
+                return None
+        return None
+
     # ---- packed basic columns: balances, participation, inactivity, slashings
     def _uint_field(self, cache, ftype, value, spec, backend) -> bytes:
         elem = _typ(ftype.elem)
@@ -199,6 +299,37 @@ class IncrementalStateRoot:
             if n != _resolve(ftype.length, spec):
                 raise SSZError(f"{ftype!r} length mismatch: {n}")
             limit_chunks = (n * elem.size + 31) // 32
+        m = (n * elem.size + 31) // 32
+        per_chunk = 32 // elem.size
+
+        delta = self._consume_delta(cache, value)
+        if delta is not None and cache.chunks is not None and cache.count == m:
+            if len(delta) > max((m * per_chunk) // _REBUILD_FRACTION, 8):
+                delta = None  # wholesale change: one vector rebuild wins
+            else:
+                if delta:
+                    view = cache.chunks.reshape(-1).view(dtype)
+                    lim = 1 << (8 * elem.size)
+                    dirty_chunks: set[int] = set()
+                    for i in delta:
+                        if i >= n:
+                            continue  # shrink paths mark full; guard anyway
+                        v = int(value[i])
+                        if not 0 <= v < lim:
+                            raise SSZError(
+                                f"{ftype!r}: element {v} out of uint{elem.size * 8} range"
+                            )
+                        view[i] = v
+                        dirty_chunks.add(i // per_chunk)
+                    if dirty_chunks:
+                        _update_paths(
+                            cache.levels,
+                            np.fromiter(dirty_chunks, np.int64, len(dirty_chunks)),
+                        )
+                self._stamp(cache, value)
+                root = _cap_root(cache.levels, limit_chunks)
+                return mix_in_length(root, n) if is_list else root
+
         try:
             # numpy >= 2 raises on out-of-range Python ints instead of
             # silently wrapping, so this conversion doubles as validation
@@ -208,23 +339,25 @@ class IncrementalStateRoot:
         raw = arr.tobytes()
         pad = (-len(raw)) % 32
         chunks = np.frombuffer(raw + b"\x00" * pad, np.uint8).reshape(-1, 32)
-        m = chunks.shape[0]
         if cache.chunks is None or cache.count != m:
+            cw = chunks.copy()  # writable: the pushed-delta path edits in place
             cache.levels = _build_levels(
-                chunks, backend if m > _DEVICE_CHUNKS else self._host
+                cw, backend if m > _DEVICE_CHUNKS else self._host
             )
-            cache.chunks, cache.count = chunks, m
+            cache.chunks, cache.count = cw, m
         else:
             dirty = np.nonzero(np.any(cache.chunks != chunks, axis=1))[0]
             if dirty.size:
                 if dirty.size > m // _REBUILD_FRACTION:
+                    cw = chunks.copy()
                     cache.levels = _build_levels(
-                        chunks, backend if m > _DEVICE_CHUNKS else self._host
+                        cw, backend if m > _DEVICE_CHUNKS else self._host
                     )
+                    cache.chunks = cw
                 else:
-                    cache.levels[0] = chunks.copy()
+                    cache.chunks[dirty] = chunks[dirty]
                     _update_paths(cache.levels, dirty)
-                cache.chunks = chunks
+        self._stamp(cache, value)
         root = _cap_root(cache.levels, limit_chunks)
         return mix_in_length(root, n) if is_list else root
 
@@ -243,6 +376,27 @@ class IncrementalStateRoot:
             if n != _resolve(ftype.length, spec):
                 raise SSZError(f"{ftype!r} length mismatch: {n}")
             limit_chunks = n
+
+        delta = self._consume_delta(cache, value)
+        if delta is not None and cache.prev is not None and cache.count == n:
+            dirty = sorted(
+                i for i in delta if i < n and value[i] is not cache.prev[i]
+            )
+            if len(dirty) > max(n // _REBUILD_FRACTION, 8):
+                delta = None  # wholesale: rebuild through the backend below
+            else:
+                if dirty:
+                    sub = self._element_leaves(
+                        elem, [value[i] for i in dirty], spec, self._host
+                    )
+                    cache.levels[0][dirty] = sub
+                    _update_paths(cache.levels, np.asarray(dirty, np.int64))
+                    for i in dirty:
+                        cache.prev[i] = value[i]
+                self._stamp(cache, value)
+                root = _cap_root(cache.levels, limit_chunks)
+                return mix_in_length(root, n) if is_list else root
+
         if cache.prev is None or cache.count != n:
             leaves = self._element_leaves(elem, value, spec, backend)
             cache.levels = _build_levels(
@@ -265,6 +419,7 @@ class IncrementalStateRoot:
                     cache.levels[0][dirty] = sub
                     _update_paths(cache.levels, np.asarray(dirty, np.int64))
                 cache.prev = list(value)
+        self._stamp(cache, value)
         root = _cap_root(cache.levels, limit_chunks)
         return mix_in_length(root, n) if is_list else root
 

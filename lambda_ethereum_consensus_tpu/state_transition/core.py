@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from ..config import ChainSpec, constants, get_chain_spec
 from ..crypto import bls
+from ..telemetry import span
 from ..types.beacon import BeaconState, SignedBeaconBlock
 from . import accessors, misc, operations
 from .epoch import process_epoch
@@ -59,6 +60,11 @@ def _process_slots_mut(
     while state.slot < slot:
         process_slot(state, spec)
         if (state.slot + 1) % spec.SLOTS_PER_EPOCH == 0:
+            # attach the resident plane at the first boundary this
+            # lineage crosses (size-gated; rides freeze/thaw from then on)
+            from .resident import ensure_plane
+
+            ensure_plane(state, spec)
             process_epoch(state, spec)
         state.slot += 1
 
@@ -113,20 +119,21 @@ def state_transition(
     """Apply a signed block: slots, signature, block, state-root check."""
     spec = spec or get_chain_spec()
     block = signed_block.message
-    ws = BeaconStateMut(state)
-    _process_slots_mut(ws, block.slot, spec)
-    if validate_result and not verify_block_signature(ws, signed_block, spec):
-        raise StateTransitionError("invalid block signature")
-    try:
-        process_block(ws, block, execution_engine, spec)
-    except OperationError as e:
-        raise StateTransitionError(str(e)) from None
-    out = ws.freeze()
-    if validate_result:
-        expect_root = state_root(out, spec)
-        if bytes(block.state_root) != expect_root:
-            raise StateTransitionError(
-                f"state root mismatch: block {bytes(block.state_root).hex()} "
-                f"!= computed {expect_root.hex()}"
-            )
+    with span("block_transition"):
+        ws = BeaconStateMut(state)
+        _process_slots_mut(ws, block.slot, spec)
+        if validate_result and not verify_block_signature(ws, signed_block, spec):
+            raise StateTransitionError("invalid block signature")
+        try:
+            process_block(ws, block, execution_engine, spec)
+        except OperationError as e:
+            raise StateTransitionError(str(e)) from None
+        out = ws.freeze()
+        if validate_result:
+            expect_root = state_root(out, spec)
+            if bytes(block.state_root) != expect_root:
+                raise StateTransitionError(
+                    f"state root mismatch: block {bytes(block.state_root).hex()} "
+                    f"!= computed {expect_root.hex()}"
+                )
     return out

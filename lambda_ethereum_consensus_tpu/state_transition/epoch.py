@@ -14,16 +14,33 @@ from __future__ import annotations
 import numpy as np
 
 from ..config import ChainSpec, constants, get_chain_spec
+from ..telemetry import span
 from ..types.beacon import Checkpoint, HistoricalSummary
 from . import accessors, misc
 from .math import integer_squareroot
-from .mutable import BeaconStateMut
+from .mutable import BeaconStateMut, TrackedList
 from .mutators import initiate_validator_exit
 from .predicates import is_eligible_for_activation
 
 
 def process_epoch(state: BeaconStateMut, spec: ChainSpec | None = None) -> None:
+    """One epoch boundary.  When a resident plane rides the lineage
+    (state_transition/resident), the O(n) sweeps run as device kernels on
+    the persistent columns; any representability guard failing falls back
+    to the bit-exact host path below — same results either way, pinned by
+    tests/unit/test_resident_transition.py."""
     spec = spec or get_chain_spec()
+    with span("epoch_transition"):
+        plane = getattr(state, "_resident_plane", None)
+        if plane is not None:
+            from .resident import process_epoch_resident
+
+            if process_epoch_resident(state, plane, spec):
+                return
+        _process_epoch_host(state, spec)
+
+
+def _process_epoch_host(state: BeaconStateMut, spec: ChainSpec) -> None:
     process_justification_and_finalization(state, spec)
     process_inactivity_updates(state, spec)
     process_rewards_and_penalties(state, spec)
@@ -369,8 +386,16 @@ def process_historical_summaries_update(
 def process_participation_flag_updates(
     state: BeaconStateMut, spec: ChainSpec | None = None
 ) -> None:
+    """Participation rotation as a structural delta: previous aliases
+    current's list (its incremental subtree moves with it), and the new
+    current gets a claimed zero subtree — the root engine hashes nothing
+    at all for either field at the boundary."""
     state.previous_epoch_participation = state.current_epoch_participation
-    state.current_epoch_participation = [0] * len(state.validators)
+    new_current = TrackedList([0] * len(state.validators))
+    engine = getattr(state, "_root_engine", None)
+    if engine is not None and hasattr(engine, "rotate_participation"):
+        engine.rotate_participation(new_current)
+    state.current_epoch_participation = new_current
 
 
 def process_sync_committee_updates(

@@ -9,6 +9,21 @@ slashed flags) so epoch passes run vectorized instead of per-validator Python
 loops — the reference walks Elixir lists per validator (ref:
 state_transition/epoch_processing.ex:11-378); here the registry is the
 data-parallel axis.
+
+Round 13 makes the big list fields *delta-observable*: each rides in a
+:class:`TrackedList` that logs its own touched indices and, when
+adopt-copied across freeze/thaw, points at the list it was copied from.
+A consumer that snapshotted an earlier instance — the incremental root
+engine (ssz/incremental.py) — walks that parent chain and unions the
+per-instance logs to get a provable superset of the changed leaves,
+instead of diffing a million elements per slot.  Tracking is exact by
+construction: every mutation path goes through the list object itself
+(``balances[i] += delta``, ``participation[i] |= flag``, ``append``),
+and anything per-index logging can't describe (slices, deletions,
+wholesale replacement via attribute assignment, unknown provenance)
+bumps a structural marker that makes consumers refuse the chain and
+fall back to exact value-diffing — the conservative direction, never a
+wrong root.
 """
 
 from __future__ import annotations
@@ -35,6 +50,123 @@ _LIST_FIELDS = (
 )
 
 
+# ancestors older than this many copies are unreachable to consumers (a
+# consumer that roots every slot is at most one copy behind), so the
+# adopt path cuts the parent chain here — otherwise every block's lists
+# would pin every predecessor's lists alive back to genesis
+_MAX_CHAIN = 4
+
+
+class TrackedList(list):
+    """A list that logs its own mutations and remembers which list it was
+    copied from.
+
+    A consumer (the incremental root engine) snapshots an instance and
+    later asks: "which indices might differ from my snapshot?"  The
+    answer is the union of ``dirty`` sets along the ``parent`` chain from
+    the current instance back to the snapshotted one — an
+    over-approximation (safe: extra indices only cost extra hashes),
+    never an under-approximation: every point write and append logs its
+    index, and anything per-index logging can't describe (slices,
+    deletions, wholesale replacement, unknown provenance) bumps
+    ``full_gen`` so the chain walk refuses and the consumer falls back
+    to a value diff.  Branched lineages (two mutated copies of one
+    state) are inherently safe: a branch the consumer didn't snapshot
+    can never reach the snapshot instance through ``parent``.
+    """
+
+    __slots__ = ("dirty", "gen", "full_gen", "parent")
+
+    def __init__(self, iterable=()):
+        super().__init__(iterable)
+        self.dirty: set[int] = set()
+        # unknown provenance counts as one structural event: consumers
+        # must full-diff once before the per-index log means anything
+        self.gen = 1
+        self.full_gen = 1
+        self.parent = None
+
+    @classmethod
+    def adopt(cls, value) -> "TrackedList":
+        """Shallow-copy ``value`` keeping the delta chain connected: the
+        copy starts clean and points at its source, so a consumer that
+        snapshotted the source reads (source.dirty | copy.dirty) as the
+        exact superset of changed indices."""
+        out = cls(value)
+        if isinstance(value, TrackedList):
+            out.gen = 0
+            out.full_gen = 0
+            out.parent = value
+            node, depth = value, 1
+            while node.parent is not None:
+                if depth >= _MAX_CHAIN:
+                    node.parent = None  # release ancient ancestors
+                    break
+                node, depth = node.parent, depth + 1
+        return out
+
+    # -- exact per-index logging
+    def _point(self, index: int) -> None:
+        self.gen += 1
+        self.dirty.add(index)
+
+    def _structural(self) -> None:
+        self.gen += 1
+        self.full_gen = self.gen
+
+    def __setitem__(self, index, value):
+        if isinstance(index, slice):
+            self._structural()
+        else:
+            self._point(index if index >= 0 else len(self) + index)
+        super().__setitem__(index, value)
+
+    def append(self, value):
+        self._point(len(self))
+        super().append(value)
+
+    # -- structural mutations: per-index deltas can't describe them
+    def __delitem__(self, index):
+        self._structural()
+        list.__delitem__(self, index)
+
+    def __iadd__(self, other):
+        self._structural()
+        return list.__iadd__(self, other)
+
+    def __imul__(self, other):
+        self._structural()
+        return list.__imul__(self, other)
+
+    def extend(self, other):
+        self._structural()
+        list.extend(self, other)
+
+    def insert(self, index, value):
+        self._structural()
+        list.insert(self, index, value)
+
+    def pop(self, index=-1):
+        self._structural()
+        return list.pop(self, index)
+
+    def remove(self, value):
+        self._structural()
+        list.remove(self, value)
+
+    def clear(self):
+        self._structural()
+        list.clear(self)
+
+    def sort(self, **kwargs):
+        self._structural()
+        list.sort(self, **kwargs)
+
+    def reverse(self):
+        self._structural()
+        list.reverse(self)
+
+
 class BeaconStateMut:
     """Working copy of a BeaconState; mutate freely, then :meth:`freeze`."""
 
@@ -42,13 +174,25 @@ class BeaconStateMut:
         for name in BeaconState.fields():
             value = getattr(state, name)
             if name in _LIST_FIELDS:
-                value = list(value)
+                value = TrackedList.adopt(value)
             object.__setattr__(self, name, value)
-        self._registry_cache: dict | None = None
-        self._pubkey_index: dict[bytes, int] | None = None
+        object.__setattr__(self, "_registry_cache", None)
+        object.__setattr__(self, "_pubkey_index", None)
         # incremental-root engine rides the state lineage (ssz/incremental):
         # process_slot reuses it across slots AND across freeze/thaw cycles
-        self._root_engine = getattr(state, "_root_engine", None)
+        object.__setattr__(self, "_root_engine", getattr(state, "_root_engine", None))
+        # resident transition plane (state_transition/resident): same ride
+        object.__setattr__(
+            self, "_resident_plane", getattr(state, "_resident_plane", None)
+        )
+
+    def __setattr__(self, name, value):
+        # wholesale field replacement (epoch resets, set_balances): keep
+        # the field observable but degrade its log to full — the one
+        # mutation class per-index tracking cannot describe
+        if name in _LIST_FIELDS and not isinstance(value, TrackedList):
+            value = TrackedList(value)
+        object.__setattr__(self, name, value)
 
     # -- freeze back to the immutable container
     def freeze(self) -> BeaconState:
@@ -58,6 +202,8 @@ class BeaconStateMut:
             object.__setattr__(out, k, v)
         if self._root_engine is not None:
             object.__setattr__(out, "_root_engine", self._root_engine)
+        if self._resident_plane is not None:
+            object.__setattr__(out, "_resident_plane", self._resident_plane)
         return out
 
     # -- registry columns (numpy views over the validators list)

@@ -86,7 +86,10 @@ _HELP = {
     "ingest_sched_seconds": "one scheduling round's bookkeeping (no handler time)",
     "ingest_degraded": "1 while the load-shedding latch is active",
     "attestation_batch_verify_seconds": "one batched attestation signature check",
-    "block_transition_seconds": "full state transition of one block",
+    "block_transition_seconds": "full state transition of one block (slots + block + state-root check)",
+    "epoch_transition_seconds": "one epoch-boundary processing pass (resident or host path)",
+    "resident_plane_validators": "validators held as resident device columns by the transition plane",
+    "resident_plane_sync_elems": "cumulative per-epoch delta elements scattered to the resident columns",
     "fork_choice_head_recompute_seconds": "uncached LMD-GHOST head walk",
     "ssz_hash_tree_root_seconds": "top-level SSZ Merkleization root",
     "sidecar_roundtrip_seconds": "one sidecar command round-trip",

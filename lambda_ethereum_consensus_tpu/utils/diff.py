@@ -12,10 +12,21 @@ from typing import Any
 UNCHANGED = "unchanged"
 
 
+def _kind(value: Any) -> str:
+    """Type label for the mismatch check, folding list/tuple/dict
+    subclasses onto their base — a ``TrackedList`` (the delta-logging
+    list the transition's working state uses, round 13) IS a list for
+    structural-equality purposes."""
+    for base in (list, tuple, dict):
+        if isinstance(value, base) and type(value) is not base:
+            return base.__name__
+    return type(value).__name__
+
+
 def diff(left: Any, right: Any) -> Any:
     """``UNCHANGED`` or a nested description of what differs."""
-    if type(left).__name__ != type(right).__name__:
-        return {"type_changed": (type(left).__name__, type(right).__name__)}
+    if _kind(left) != _kind(right):
+        return {"type_changed": (_kind(left), _kind(right))}
     schema = getattr(type(left), "__ssz_schema__", None)
     if schema is not None:  # SSZ containers: field-by-field
         fields = {}

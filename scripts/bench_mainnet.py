@@ -308,25 +308,80 @@ def main() -> None:
                 ),
                 flush=True,
             )
+            from lambda_ethereum_consensus_tpu.node.replay import (
+                decode_signed_blocks,
+            )
+            from lambda_ethereum_consensus_tpu.node.warmup import warm_transition
             from lambda_ethereum_consensus_tpu.state_transition.core import (
                 state_root,
             )
 
-            replay_state = state
+            # state-load prep, not per-block cost: transition kernels from
+            # the AOT cache + one engine prime on the anchor state.  A cold
+            # process pays seconds here instead of tens of seconds inside
+            # first_block_s (ROADMAP item 2's cold≈warm contract).
+            t0 = time.perf_counter()
+            warm_transition(n)
+            from lambda_ethereum_consensus_tpu.ssz.incremental import (
+                IncrementalStateRoot as _Engine,
+            )
+
+            replay_eng = _Engine(
+                type(state), backend=backend if use_device else None
+            )
+            ws0 = BeaconStateMut(state)
+            ws0._root_engine = replay_eng
+            replay_eng.root(ws0, spec)
+            replay_state = ws0.freeze()
+            raws = [signed.encode(spec) for signed in blocks]
+            prep_s = time.perf_counter() - t0
+            print(
+                json.dumps(
+                    {
+                        "metric": "replay_prep_s",
+                        "value": round(prep_s, 2),
+                        "unit": "s",
+                        "note": "transition warmup + engine prime + segment encode",
+                    }
+                ),
+                flush=True,
+            )
+
+            # pipelined replay: the host decode of block N+1 overlaps the
+            # device transition of block N; one JSON progress line per
+            # block so a driver timeout still leaves partial evidence
             times = []
-            for signed in blocks:
+            t_replay0 = time.perf_counter()
+            for signed in decode_signed_blocks(raws, spec=spec, depth=2):
                 t0 = time.perf_counter()
                 replay_state = state_transition(
                     replay_state, signed, validate_result=True, spec=spec
                 )
                 times.append(time.perf_counter() - t0)
+                done = len(times)
+                print(
+                    json.dumps(
+                        {
+                            "metric": "capella_replay_progress",
+                            "block": done,
+                            "n_blocks": n_blocks,
+                            "value": round(times[-1], 3),
+                            "unit": "s",
+                            "cum_blocks_per_sec": round(
+                                done / (time.perf_counter() - t_replay0), 3
+                            ),
+                        }
+                    ),
+                    flush=True,
+                )
             # exact-root anchor through the engines (a full double rehash
             # at 1M on device would cost more than the replay itself)
             assert state_root(replay_state, spec) == state_root(cur, spec)
-            # block 1 includes the incremental engine's one-time full
-            # build; steady state is what the 12 s budget bites on
+            # block 1 includes any residual one-time costs the prep phase
+            # missed; steady state is what the 12 s budget bites on
             steady = times[1:] or times
             per_block = sum(steady) / len(steady)
+            resident = getattr(replay_state, "_resident_plane", None)
             print(
                 json.dumps(
                     {
@@ -339,6 +394,10 @@ def main() -> None:
                         "sync_aggregate": "full participation",
                         "seconds_per_block": round(per_block, 3),
                         "first_block_s": round(times[0], 3),
+                        "replay_prep_s": round(prep_s, 2),
+                        "pipelined_decode": True,
+                        "resident_epoch": resident is not None
+                        and resident.stats["sweeps"] > 0,
                         "slot_budget_frac": round(per_block / 12.0, 3),
                     }
                 ),

@@ -85,6 +85,7 @@ EXERCISED = frozenset({
     "ingest_lane_wait_p95",          # scheduler lane flushes
     "ingest_sched_p99",              # scheduler drain rounds
     "api_request_p99",               # drive_api GET burst
+    "block_transition_p95",          # drive_transitions mini-replay
 })
 
 
@@ -195,6 +196,35 @@ async def drive_pipeline(engine: SloEngine, duration_s: float,
         "processed": blocks.processed + aggs.processed + votes.processed,
         "sheds": blocks.sheds + aggs.sheds + votes.sheds,
     }
+
+
+def drive_transitions(n_blocks: int) -> int:
+    """A real minimal-spec replay through ``state_transition`` — signed
+    blocks, validation on, one epoch boundary crossed — so the
+    ``block_transition_seconds`` / ``epoch_transition_seconds``
+    histograms (round 13) are filled by the same spans the live
+    ``on_block`` path records into, not synthetic observations."""
+    from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+    from lambda_ethereum_consensus_tpu.crypto import bls
+    from lambda_ethereum_consensus_tpu.state_transition.core import (
+        state_transition,
+    )
+    from lambda_ethereum_consensus_tpu.state_transition.genesis import (
+        build_genesis_state,
+    )
+    from lambda_ethereum_consensus_tpu.validator import build_signed_block
+
+    sks = [(i + 1).to_bytes(32, "big") for i in range(16)]
+    with use_chain_spec(minimal_spec()) as spec:
+        n_blocks = max(n_blocks, spec.SLOTS_PER_EPOCH + 1)  # cross a boundary
+        state = build_genesis_state(
+            [bls.sk_to_pk(sk) for sk in sks], spec=spec
+        )
+        cur = state
+        for slot in range(1, n_blocks + 1):
+            signed, _post = build_signed_block(cur, slot, sks, spec=spec)
+            cur = state_transition(cur, signed, validate_result=True, spec=spec)
+    return n_blocks
 
 
 def replay_slot_phases(n_slots: int, seed: int) -> int:
@@ -340,6 +370,7 @@ def main() -> int:
     t0 = time.monotonic()
     load = asyncio.run(drive_pipeline(engine, duration, rates))
     slots = replay_slot_phases(8 if args.smoke else 64, args.seed)
+    blocks = drive_transitions(9 if args.smoke else 17)
     n_api = 25 if args.smoke else 100
     served, api_failed = asyncio.run(drive_api(n_api))
 
@@ -387,6 +418,7 @@ def main() -> int:
         "pipeline_items": load["processed"],
         "pipeline_sheds": load["sheds"],
         "slots_replayed": slots,
+        "blocks_transitioned": blocks,
         "api_requests_ok": served,
         "api_requests_expected": n_api,
         "seed": args.seed,

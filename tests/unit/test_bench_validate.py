@@ -138,8 +138,72 @@ def test_validate_cli_fails_on_empty_rc124_artifact(tmp_path):
     )
     assert out.returncode == 1
     assert "no metric records at all" in out.stderr
+    assert "parsed: null" in out.stderr
     summary = json.loads(out.stdout.splitlines()[0])
     assert summary["ok"] is False and summary["records"] == 0
+
+
+def test_validate_rejects_parsed_null_even_with_tail_records(tmp_path):
+    """Round-13 satellite: ``parsed: null`` is the rc-124 signature and
+    must fail validation on its own — even when stray JSON lines in the
+    bounded tail would otherwise let the record audit pass."""
+    artifact = tmp_path / "BENCH_null_parsed.json"
+    tail = (
+        json.dumps({"metric": "bench_artifact_selfcheck", "value": 0,
+                    "ok": True, "pending": []})
+        + "\n"
+    )
+    artifact.write_text(json.dumps(
+        {"n": 6, "rc": 124, "tail": tail, "parsed": None}
+    ))
+    assert bench._wrapper_problems(str(artifact)) != []
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--validate", str(artifact)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=60,
+    )
+    assert out.returncode == 1
+    assert "parsed: null" in out.stderr
+    # a healthy wrapper with a parsed record carries no wrapper problem
+    ok_artifact = tmp_path / "BENCH_ok.json"
+    ok_artifact.write_text(json.dumps(
+        {"n": 6, "rc": 0, "tail": tail,
+         "parsed": {"metric": "aggregate_bls_verifications_per_sec",
+                    "value": 1.0}}
+    ))
+    assert bench._wrapper_problems(str(ok_artifact)) == []
+
+
+def test_replay_progress_promotes_partial_headline():
+    """A mainnet stage killed mid-replay must surface the per-block
+    progress stream as a PARTIAL capella_replay_blocks_per_sec record
+    (the round-13 anti-rc-124 contract for the replay stage)."""
+    progress = [
+        {"metric": "capella_replay_progress", "block": b, "n_blocks": 8,
+         "value": 0.9, "cum_blocks_per_sec": 1.1}
+        for b in (1, 2, 3)
+    ]
+    absence = {"metric": "capella_replay_blocks_per_sec", "value": None,
+               "note": "bench_mainnet.py: exceeded its 1500s budget"}
+
+    def fake_bench_script(name, metrics, budget_s, **kwargs):
+        return progress + [absence]
+
+    orig = bench._bench_script
+    bench._bench_script = fake_bench_script
+    try:
+        recs = bench._bench_mainnet_root(budget_s=10)
+    finally:
+        bench._bench_script = orig
+    headline = [r for r in recs
+                if r["metric"] == "capella_replay_blocks_per_sec"]
+    assert len(headline) == 1
+    assert headline[0]["partial"] is True
+    assert headline[0]["value"] == 1.1
+    assert headline[0]["blocks_completed"] == 3
+    # the validator accepts the partial record as a result
+    assert bench.validate_records(
+        recs, ("capella_replay_blocks_per_sec",)
+    ) == []
 
 
 def test_validate_cli_passes_on_covered_artifact(tmp_path):

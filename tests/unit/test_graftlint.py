@@ -471,6 +471,112 @@ def test_retrace_hazard_passes_with_shape_discipline(tmp_path):
     assert findings == []
 
 
+def test_retrace_hazard_fires_on_use_after_donate(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+
+            def _sweep(lo, hi, deltas):
+                return lo + deltas, hi
+
+            sweep = jax.jit(_sweep, donate_argnums=(0, 1))
+
+            def apply(lo, hi, deltas):
+                new_lo, new_hi = sweep(lo, hi, deltas)
+                return new_lo, new_hi, lo.sum()
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert len(findings) == 1
+    assert "donated" in findings[0].message and "'lo'" in findings[0].message
+
+
+def test_retrace_hazard_fires_on_use_after_donate_via_aot_jit(tmp_path):
+    """The project idiom: donation declared on the inner jax.jit, the
+    callable bound through the aot_jit wrapper."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+
+            def aot_jit(fn, name):
+                return fn
+
+            def _scatter(buf, idx, vals):
+                return buf.at[idx].set(vals)
+
+            scatter = aot_jit(jax.jit(_scatter, donate_argnums=(0,)), "scatter")
+
+            def update(buf, idx, vals):
+                out = scatter(buf, idx, vals)
+                check = buf[0]
+                return out, check
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert len(findings) == 1 and "'buf'" in findings[0].message
+
+
+def test_retrace_hazard_donate_ignores_multiline_call_arguments(tmp_path):
+    """Arguments on a donated call's continuation lines are part of the
+    call, not uses after it."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+
+            def _sweep(lo, hi, deltas):
+                return lo + deltas, hi
+
+            sweep = jax.jit(_sweep, donate_argnums=(0, 1))
+
+            def apply(lo, hi, deltas):
+                out_lo, out_hi = sweep(
+                    lo,
+                    hi,
+                    deltas,
+                )
+                return out_lo, out_hi
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert findings == []
+
+
+def test_retrace_hazard_passes_when_donated_args_rebound(tmp_path):
+    """Rebinding the donated names to the call's outputs — the correct
+    discipline — must not fire, including later reads of the rebound
+    names and a second donated call in the same function."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+
+            def _sweep(lo, hi, deltas):
+                return lo + deltas, hi
+
+            sweep = jax.jit(_sweep, donate_argnums=(0, 1))
+
+            def apply(lo, hi, deltas):
+                lo, hi = sweep(lo, hi, deltas)
+                total = lo.sum() + hi.sum()
+                lo, hi = sweep(lo, hi, deltas)
+                return lo, hi, total
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert findings == []
+
+
 # ---------------------------------------------------------- metric-contract
 
 

@@ -92,6 +92,74 @@ def test_incremental_rejects_out_of_range(state, spec):
             eng.root(ws, spec)
 
 
+def test_incremental_pushed_deltas_survive_branched_lineage(state, spec):
+    """Two divergent BeaconStateMut copies of one state, rooted
+    alternately through ONE engine: the adopt-chain trust must refuse
+    the branch it didn't stamp and fall back to exact diffing."""
+    with use_chain_spec(spec):
+        eng = IncrementalStateRoot(BeaconState)
+        ws_a = BeaconStateMut(state)
+        ws_a.balances[3] = 77 * 10**7
+        assert eng.root(ws_a, spec) == ws_a.freeze().hash_tree_root(spec)
+        # branch B diverges from the ORIGINAL state, not from A
+        ws_b = BeaconStateMut(state)
+        ws_b.balances[5] = 55 * 10**7
+        ws_b.update_validator(9, effective_balance=9 * 10**9)
+        assert eng.root(ws_b, spec) == ws_b.freeze().hash_tree_root(spec)
+        # and back to A's lineage again
+        ws_a.balances[7] += 1
+        assert eng.root(ws_a, spec) == ws_a.freeze().hash_tree_root(spec)
+
+
+def test_incremental_structural_mutations_degrade_safely(state, spec):
+    """Slice assignment / wholesale replacement can't be expressed as
+    per-index deltas: the chain must refuse and the value diff keep the
+    root exact."""
+    with use_chain_spec(spec):
+        eng = IncrementalStateRoot(BeaconState)
+        ws = BeaconStateMut(state)
+        eng.root(ws, spec)
+        ws.balances[0:4] = [1, 2, 3, 4]  # slice: structural
+        assert eng.root(ws, spec) == ws.freeze().hash_tree_root(spec)
+        ws.inactivity_scores = [11] * len(ws.validators)  # replacement
+        assert eng.root(ws, spec) == ws.freeze().hash_tree_root(spec)
+        # after the degradations, point tracking resumes exactly
+        ws.balances[2] = 999
+        assert eng.root(ws, spec) == ws.freeze().hash_tree_root(spec)
+
+
+def test_incremental_participation_rotation_is_structural(state, spec):
+    """The epoch participation reset must cost no hashing: previous
+    adopts current's cached subtree and current gets the zero subtree —
+    and the very next roots are exact."""
+    from lambda_ethereum_consensus_tpu.state_transition.epoch import (
+        process_participation_flag_updates,
+    )
+
+    with use_chain_spec(spec):
+        eng = IncrementalStateRoot(BeaconState)
+        ws = BeaconStateMut(state)
+        ws._root_engine = eng
+        for i in range(0, len(ws.validators), 3):
+            ws.current_epoch_participation[i] = 7
+        eng.root(ws, spec)
+        process_participation_flag_updates(ws, spec)
+        assert eng.root(ws, spec) == ws.freeze().hash_tree_root(spec)
+        # mutations after the rotation keep flowing as deltas
+        ws.current_epoch_participation[1] = 3
+        ws.previous_epoch_participation[2] |= 4
+        assert eng.root(ws, spec) == ws.freeze().hash_tree_root(spec)
+
+
+def test_incremental_rotation_without_movable_cache_falls_back(state, spec):
+    with use_chain_spec(spec):
+        eng = IncrementalStateRoot(BeaconState)
+        # never rooted: nothing movable — must refuse, then diff cleanly
+        assert eng.rotate_participation([0] * len(state.validators)) is False
+        ws = BeaconStateMut(state)
+        assert eng.root(ws, spec) == ws.freeze().hash_tree_root(spec)
+
+
 def test_process_slots_uses_engine_and_matches(state, spec):
     """process_slots with the wired engine produces the same state root
     trajectory as a hand-rolled full-rehash walk."""

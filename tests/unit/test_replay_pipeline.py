@@ -1,0 +1,139 @@
+"""Pipelined replay prefetcher (node/replay.py): ordering, bounded
+depth, exception delivery, actual overlap, and the SSZ decode helper."""
+
+import threading
+import time
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.node.replay import decode_signed_blocks, prefetched
+
+
+def test_prefetched_preserves_order_and_results():
+    items = list(range(50))
+    assert list(prefetched(items, lambda x: x * x, depth=3)) == [
+        x * x for x in items
+    ]
+
+
+def test_prefetched_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        list(prefetched([1], lambda x: x, depth=0))
+
+
+def test_prefetched_delivers_prep_exception_in_order():
+    def prep(x):
+        if x == 3:
+            raise RuntimeError("boom at 3")
+        return x
+
+    out = []
+    with pytest.raises(RuntimeError, match="boom at 3"):
+        for v in prefetched(range(10), prep, depth=2):
+            out.append(v)
+    assert out == [0, 1, 2]  # everything before the failure, in order
+
+
+def test_prefetched_overlaps_prep_with_consumption():
+    """While the consumer 'executes' item N, the worker must already be
+    prepping ahead — observable as prep starting for item N+1 before the
+    consumer finishes N."""
+    started = []
+    lock = threading.Lock()
+
+    def prep(x):
+        with lock:
+            started.append(x)
+        return x
+
+    gen = prefetched(range(4), prep, depth=2)
+    first = next(gen)
+    assert first == 0
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        with lock:
+            if len(started) >= 2:  # item 1 prepped while 0 is "executing"
+                break
+        time.sleep(0.005)
+    with lock:
+        assert len(started) >= 2
+    assert list(gen) == [1, 2, 3]
+
+
+def test_prefetched_bounds_lookahead():
+    """The worker may run at most depth+1 preps beyond what was consumed
+    (depth queued + one in flight) — the memory bound the replay driver
+    relies on at 1M-validator block sizes."""
+    started = []
+    lock = threading.Lock()
+
+    def prep(x):
+        with lock:
+            started.append(x)
+        return x
+
+    gen = prefetched(range(100), prep, depth=2)
+    next(gen)
+    time.sleep(0.2)  # give the worker every chance to run ahead
+    with lock:
+        ahead = len(started)
+    assert ahead <= 1 + 2 + 1  # consumed + queue depth + in-flight
+    assert list(gen) == list(range(1, 100))
+
+
+def test_prefetched_delivers_source_iterable_exception():
+    """A failing SOURCE (a network-backed block stream dying mid-fetch)
+    must surface at the consumer, never read as clean end-of-stream."""
+    def broken_source():
+        yield 10
+        yield 20
+        raise RuntimeError("stream died")
+
+    out = []
+    with pytest.raises(RuntimeError, match="stream died"):
+        for v in prefetched(broken_source(), lambda x: x, depth=2):
+            out.append(v)
+    assert out == [10, 20]
+
+
+def test_prefetched_worker_exits_when_consumer_abandons():
+    """A replay that raises mid-stream closes the generator without
+    draining it; the worker must notice and exit instead of parking on
+    the full queue forever (one leaked thread per aborted replay)."""
+    before = {t.name for t in threading.enumerate()}
+    gen = prefetched(range(1000), lambda x: x, depth=2)
+    assert next(gen) == 0
+    gen.close()  # the abandon path (GeneratorExit -> finally -> stop)
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        alive = [
+            t for t in threading.enumerate()
+            if t.name == "replay-prefetch" and t.name not in before
+        ]
+        if not alive:
+            break
+        time.sleep(0.02)
+    assert not [
+        t for t in threading.enumerate() if t.name == "replay-prefetch"
+    ]
+
+
+def test_decode_signed_blocks_round_trips(minimal):
+    from lambda_ethereum_consensus_tpu.config import use_chain_spec
+    from lambda_ethereum_consensus_tpu.crypto import bls
+    from lambda_ethereum_consensus_tpu.state_transition.genesis import (
+        build_genesis_state,
+    )
+    from lambda_ethereum_consensus_tpu.validator import build_signed_block
+
+    sks = [(i + 1).to_bytes(32, "big") for i in range(16)]
+    with use_chain_spec(minimal) as spec:
+        genesis = build_genesis_state(
+            [bls.sk_to_pk(sk) for sk in sks], spec=spec
+        )
+        signed, _post = build_signed_block(genesis, 1, sks, spec=spec)
+        raws = [signed.encode(spec)] * 3
+        decoded = list(decode_signed_blocks(raws, spec=spec, depth=2))
+        assert len(decoded) == 3
+        for block in decoded:
+            assert block.hash_tree_root(spec) == signed.hash_tree_root(spec)

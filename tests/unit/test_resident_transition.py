@@ -1,0 +1,242 @@
+"""Delta-driven transition vs the host oracle (round 13).
+
+The resident epoch plane (state_transition/resident.py) must be
+bit-exact: every test replays the SAME inputs through the resident
+device path and the pure-host path and pins full ``hash_tree_root``
+equality — per block, across epoch boundaries, with slashings, registry
+churn and an inactivity leak in play.  ``validate_result=True`` replays
+double as oracles: the minted blocks' state roots were computed by the
+host path, so a resident replay that diverges anywhere raises instead
+of finishing.
+"""
+
+import numpy as np
+import pytest
+
+from lambda_ethereum_consensus_tpu.config import constants, minimal_spec, use_chain_spec
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.state_transition import accessors, process_slots
+from lambda_ethereum_consensus_tpu.state_transition.core import state_transition
+from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
+from lambda_ethereum_consensus_tpu.state_transition.mutable import BeaconStateMut
+from lambda_ethereum_consensus_tpu.state_transition.resident import (
+    ResidentEpochPlane,
+    resident_enabled,
+)
+from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint
+from lambda_ethereum_consensus_tpu.validator import build_signed_block, make_attestation
+
+N = 32
+SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return minimal_spec()
+
+
+@pytest.fixture(scope="module")
+def genesis(spec):
+    with use_chain_spec(spec):
+        return build_genesis_state([bls.sk_to_pk(sk) for sk in SKS], spec=spec)
+
+
+def _oracle_root(state, spec):
+    """Full-rehash root with no engine/plane in the loop."""
+    w = BeaconStateMut(state)
+    w._root_engine = None
+    w._resident_plane = None
+    return w.freeze().hash_tree_root(spec)
+
+
+def _walk(state, slot, spec, resident: bool, monkeypatch):
+    monkeypatch.setenv("GRAFT_RESIDENT_EPOCH", "1" if resident else "0")
+    w = BeaconStateMut(state)
+    w._root_engine = None
+    w._resident_plane = None
+    out = process_slots(w.freeze(), slot, spec)
+    if resident:
+        assert getattr(out, "_resident_plane", None) is not None
+    return out
+
+
+def _mint_attested_chain(genesis, spec, n_blocks):
+    """Signed blocks with full committee attestations for every prior
+    slot — enough participation to justify/finalize and pay rewards."""
+    blocks, cur = [], genesis
+    for slot in range(1, n_blocks + 1):
+        pre = process_slots(cur, slot, spec) if cur.slot < slot else cur
+        atts = []
+        att_slot = slot - 1
+        if att_slot >= 1:
+            ws = BeaconStateMut(pre)
+            epoch = att_slot // spec.SLOTS_PER_EPOCH
+            per_slot = accessors.get_committee_count_per_slot(ws, epoch, spec)
+            src = (
+                pre.current_justified_checkpoint
+                if epoch == accessors.get_current_epoch(ws, spec)
+                else pre.previous_justified_checkpoint
+            )
+            for index in range(per_slot):
+                atts.append(
+                    make_attestation(
+                        ws,
+                        slot=att_slot,
+                        committee_index=index,
+                        head_root=accessors.get_block_root_at_slot(
+                            ws, att_slot, spec
+                        ),
+                        target=Checkpoint(
+                            epoch=epoch,
+                            root=accessors.get_block_root(ws, epoch, spec),
+                        ),
+                        source=Checkpoint(
+                            epoch=src.epoch, root=bytes(src.root)
+                        ),
+                        secret_keys=SKS,
+                        spec=spec,
+                    )
+                )
+        signed, cur = build_signed_block(
+            pre, slot, SKS, attestations=atts, spec=spec
+        )
+        blocks.append(signed)
+    return blocks, cur
+
+
+def test_resident_replay_is_bit_exact_across_epochs(genesis, spec, monkeypatch):
+    """Multi-epoch attested replay: the resident path must reproduce the
+    host-minted state roots at EVERY block (validate_result checks each)
+    and land on the identical final root."""
+    with use_chain_spec(spec):
+        # three boundaries: the third is the first at which justification
+        # may move (current_epoch > GENESIS + 1), so the kernel's target
+        # sums are load-bearing, not just computed
+        n_blocks = 3 * spec.SLOTS_PER_EPOCH + 2
+        monkeypatch.setenv("GRAFT_RESIDENT_EPOCH", "0")
+        blocks, host_final = _mint_attested_chain(genesis, spec, n_blocks)
+
+        monkeypatch.setenv("GRAFT_RESIDENT_EPOCH", "1")
+        cur = genesis
+        for signed in blocks:
+            cur = state_transition(cur, signed, validate_result=True, spec=spec)
+        plane = getattr(cur, "_resident_plane", None)
+        assert plane is not None and plane.stats["sweeps"] >= 3
+        assert plane.stats["fallbacks"] == 0
+        assert _oracle_root(cur, spec) == _oracle_root(host_final, spec)
+        # participation actually flowed: justification moved off genesis
+        assert cur.current_justified_checkpoint.epoch >= 1
+
+
+def test_resident_epoch_with_slashings_and_registry_churn(genesis, spec, monkeypatch):
+    """One boundary exercising every registry-coupled pass at once: a
+    slashing-penalty target, an ejection, a new activation-eligibility
+    mark, a churn-queue activation and both hysteresis directions."""
+    with use_chain_spec(spec):
+        epv = spec.EPOCHS_PER_SLASHINGS_VECTOR
+        ws = BeaconStateMut(process_slots(genesis, 2, spec))
+        ws._root_engine = None
+        ws._resident_plane = None
+        # slashing-penalty target at the next boundary (current epoch 0)
+        ws.update_validator(
+            1, slashed=True, exit_epoch=1, withdrawable_epoch=epv // 2
+        )
+        ws.slashings[0] = 64 * 10**9
+        # ejection candidate: active with efb at the ejection floor
+        ws.update_validator(2, effective_balance=spec.EJECTION_BALANCE)
+        # fresh eligibility mark: max efb, eligibility still unset
+        ws.update_validator(
+            3,
+            effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+            activation_eligibility_epoch=constants.FAR_FUTURE_EPOCH,
+            activation_epoch=constants.FAR_FUTURE_EPOCH,
+        )
+        # churn-queue activation: eligible at finalized epoch 0
+        ws.update_validator(
+            4,
+            activation_eligibility_epoch=0,
+            activation_epoch=constants.FAR_FUTURE_EPOCH,
+        )
+        # hysteresis both ways
+        ws.balances[5] = 15 * 10**9          # downward: efb drops
+        ws.balances[6] = 40 * 10**9          # upward: efb capped at MAX
+        ws.update_validator(6, effective_balance=31 * 10**9)
+        # nonzero inactivity scores so the 57-bit penalty product runs
+        for i in range(8):
+            ws.inactivity_scores[i] = 7 + i
+        staged = ws.freeze()
+
+        target = 2 * spec.SLOTS_PER_EPOCH + 1  # two boundaries away
+        res = _walk(staged, target, spec, True, monkeypatch)
+        host = _walk(staged, target, spec, False, monkeypatch)
+        # the resident path really handled these boundaries (no fallback)
+        assert res._resident_plane.stats["sweeps"] >= 2
+        assert res._resident_plane.stats["fallbacks"] == 0
+        assert _oracle_root(res, spec) == _oracle_root(host, spec)
+        # the staged events actually happened (on both paths identically)
+        assert res.validators[2].exit_epoch != constants.FAR_FUTURE_EPOCH
+        assert res.validators[3].activation_eligibility_epoch != constants.FAR_FUTURE_EPOCH
+        assert res.validators[5].effective_balance == 15 * 10**9
+        assert res.balances[1] < staged.balances[1]  # slashing penalty landed
+
+
+def test_resident_inactivity_leak_walk(genesis, spec, monkeypatch):
+    """Seven empty epochs: finality stalls, the leak engages, scores grow
+    and the score-scaled penalties (the in-kernel 64-bit product) drain
+    balances — identically on both paths."""
+    with use_chain_spec(spec):
+        target = 7 * spec.SLOTS_PER_EPOCH + 1
+        res = _walk(genesis, target, spec, True, monkeypatch)
+        host = _walk(genesis, target, spec, False, monkeypatch)
+        assert _oracle_root(res, spec) == _oracle_root(host, spec)
+        assert max(res.inactivity_scores) > 0  # the leak actually engaged
+        assert sum(res.balances) < sum(genesis.balances)
+
+
+def test_resident_guard_falls_back_on_unrepresentable(genesis, spec, monkeypatch):
+    """A score outside the int32 window must route the whole epoch to the
+    host path (counted as a fallback) and still produce the exact root."""
+    with use_chain_spec(spec):
+        ws = BeaconStateMut(genesis)
+        ws._root_engine = None
+        ws._resident_plane = None
+        ws.inactivity_scores[0] = 1 << 40
+        staged = ws.freeze()
+        target = spec.SLOTS_PER_EPOCH + 1
+        res = _walk(staged, target, spec, True, monkeypatch)
+        host = _walk(staged, target, spec, False, monkeypatch)
+        assert res._resident_plane.stats["fallbacks"] >= 1
+        assert _oracle_root(res, spec) == _oracle_root(host, spec)
+
+
+def test_resident_routing_polarity(monkeypatch):
+    monkeypatch.setenv("GRAFT_RESIDENT_EPOCH", "0")
+    assert not resident_enabled(1 << 20)
+    monkeypatch.setenv("GRAFT_RESIDENT_EPOCH", "1")
+    assert resident_enabled(4)
+    monkeypatch.delenv("GRAFT_RESIDENT_EPOCH")
+    assert not resident_enabled(64)           # below the auto threshold
+    assert resident_enabled(1 << 20)          # above it
+
+
+def test_plane_donation_rebinds_buffers(genesis, spec, monkeypatch):
+    """The donated sweep must hand back NEW buffer objects (in-place on
+    device) and the plane must rebind — holding the old reference would
+    be the use-after-donate bug the lint rule exists to catch."""
+    monkeypatch.setenv("GRAFT_RESIDENT_EPOCH", "1")
+    with use_chain_spec(spec):
+        plane = ResidentEpochPlane(N)
+        ws = BeaconStateMut(process_slots(genesis, 1, spec))
+        assert plane.sync(ws, spec)
+        before = plane.bal_lo
+        reg = ws.registry()
+        efb_incr = (
+            reg["effective_balance"] // np.uint64(spec.EFFECTIVE_BALANCE_INCREMENT)
+        ).astype(np.int32)
+        active_prev, active_cur, eligible, slashed = plane.masks(reg, 0, 0)
+        plane.sweep(
+            efb_incr, eligible, active_prev, slashed,
+            [0, 1, 1, 4, 16, 1953125, 17],
+            [[0] * 33] * 5,
+        )
+        assert plane.bal_lo is not before

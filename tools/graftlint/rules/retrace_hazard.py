@@ -20,6 +20,14 @@ and flags their call sites when:
   shape discipline — no call to ``snap_batch``/``shape_buckets``/
   ``register_shape_bucket``, no pad/bucket helper, no
   ``.bit_length()`` pow2 rounding.
+
+Round 13 adds the **donated-buffer check**: when a callable is jitted
+with ``donate_argnums`` (directly or through ``aot_jit(jax.jit(...))``),
+the arrays passed in donated positions are invalidated in place by XLA
+— reading them after the call returns garbage SILENTLY (no exception;
+the resident-sweep bug class).  The rule flags any later load of a name
+that was passed in a donated position, unless the name was rebound first
+(typically to the call's own result, the correct discipline).
 """
 
 from __future__ import annotations
@@ -35,11 +43,22 @@ _SNAP_EVIDENCE = {"snap_batch", "shape_buckets", "register_shape_bucket", "bit_l
 _SNAP_NAME_HINTS = ("pad", "bucket", "snap")
 
 
-def _jit_call_statics(call: ast.Call) -> tuple[set[int], set[str]] | None:
-    """If ``call`` constructs a jitted callable, its static argnums/names."""
+def _jit_call_statics(
+    call: ast.Call,
+) -> tuple[set[int], set[str], set[int]] | None:
+    """If ``call`` constructs a jitted callable: its static argnums/names
+    plus its DONATED argnums.  ``aot_jit(jax.jit(f, donate_argnums=...),
+    name)`` resolves through the wrapper to the inner jit's donation."""
     cname = call_name(call)
     if cname in _JIT_FACTORIES:
-        return _statics_from(call)
+        nums, names, donated = _statics_from(call)
+        if call.args and isinstance(call.args[0], ast.Call):
+            inner = _jit_call_statics(call.args[0])
+            if inner is not None:
+                nums |= inner[0]
+                names |= inner[1]
+                donated |= inner[2]
+        return nums, names, donated
     if cname == "partial":
         # functools.partial(jax.jit, static_argnames=...)
         if call.args and isinstance(call.args[0], (ast.Name, ast.Attribute)):
@@ -49,9 +68,10 @@ def _jit_call_statics(call: ast.Call) -> tuple[set[int], set[str]] | None:
     return None
 
 
-def _statics_from(call: ast.Call) -> tuple[set[int], set[str]]:
+def _statics_from(call: ast.Call) -> tuple[set[int], set[str], set[int]]:
     nums: set[int] = set()
     names: set[str] = set()
+    donated: set[int] = set()
     for kw in call.keywords:
         if kw.arg == "static_argnums":
             for n in _const_ints(kw.value):
@@ -59,7 +79,10 @@ def _statics_from(call: ast.Call) -> tuple[set[int], set[str]]:
         elif kw.arg == "static_argnames":
             for s in _const_strs(kw.value):
                 names.add(s)
-    return nums, names
+        elif kw.arg == "donate_argnums":
+            for n in _const_ints(kw.value):
+                donated.add(n)
+    return nums, names, donated
 
 
 def _const_ints(node: ast.AST) -> list[int]:
@@ -90,7 +113,7 @@ class RetraceHazardRule:
 
     def _check_module(self, module: Module) -> list[Finding]:
         # jitted callables visible by name in this module
-        jitted: dict[str, tuple[set[int], set[str]]] = {}
+        jitted: dict[str, tuple[set[int], set[str], set[int]]] = {}
         for node in ast.walk(module.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
@@ -98,7 +121,7 @@ class RetraceHazardRule:
                     if isinstance(dec, ast.Call):
                         statics = _jit_call_statics(dec)
                     elif (dotted(dec) or "").split(".")[-1] in _JIT_FACTORIES:
-                        statics = (set(), set())
+                        statics = (set(), set(), set())
                     if statics is not None:
                         jitted[node.name] = statics
             elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
@@ -128,7 +151,7 @@ class RetraceHazardRule:
                 cname = call_name(node)
                 if cname not in jitted:
                     continue
-                nums, names = jitted[cname]
+                nums, names, donated = jitted[cname]
                 for pos, arg in enumerate(node.args):
                     if pos in nums:
                         continue
@@ -141,6 +164,82 @@ class RetraceHazardRule:
                     findings.extend(
                         self._check_arg(kw.value, cname, module, fi, snapped, len_locals, params)
                     )
+                if donated:
+                    findings.extend(
+                        self._check_use_after_donate(
+                            node, donated, cname, module, fi, nodes
+                        )
+                    )
+        return findings
+
+    # ------------------------------------------------- donated buffers
+
+    def _check_use_after_donate(
+        self, call: ast.Call, donated: set[int], cname: str, module, fi, nodes
+    ) -> list[Finding]:
+        """Flag loads of names passed in donated positions after the call
+        — unless the name was rebound first (normally to the call's own
+        result).  Use-after-donate reads an XLA-invalidated buffer and
+        returns garbage with no exception."""
+        donated_names = {
+            arg.id
+            for pos, arg in enumerate(call.args)
+            if pos in donated and isinstance(arg, ast.Name)
+        }
+        if not donated_names:
+            return []
+        # a multi-line call puts its own arguments past call.lineno —
+        # "after the call" means after its LAST line
+        call_end = getattr(call, "end_lineno", None) or call.lineno
+        # a rebinding shields every later use of that name: record the
+        # first assignment line per name at/after the call line (the
+        # `lo, hi = k(lo, hi, ...)` rebind shares the call's own line)
+        rebound_at: dict[str, int] = {}
+        for node in nodes:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.For):
+                targets = [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name) and sub.id in donated_names:
+                        if node.lineno >= call.lineno:
+                            rebound_at[sub.id] = min(
+                                rebound_at.get(sub.id, node.lineno), node.lineno
+                            )
+        findings = []
+        flagged: set[str] = set()
+        for node in nodes:
+            if not (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in donated_names
+                and node.id not in flagged
+                and node.lineno > call_end
+            ):
+                continue
+            shield = rebound_at.get(node.id)
+            if shield is not None and shield <= node.lineno:
+                continue
+            flagged.add(node.id)
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=module.rel,
+                    line=node.lineno,
+                    symbol=fi.qualname,
+                    message=(
+                        f"{node.id!r} was passed in a donated position "
+                        f"(donate_argnums) of jitted {cname}() and is used "
+                        "after the call: XLA invalidated that buffer in "
+                        "place, so this read returns garbage silently — "
+                        "rebind the name to the call's result instead"
+                    ),
+                )
+            )
         return findings
 
     def _check_arg(self, arg, cname, module, fi, snapped, len_locals, params):
