@@ -19,8 +19,9 @@ tunnel hung the whole run at rc=124 with zero evidence):
   launch to what remains of the driver-level total budget
   (``BENCH_TOTAL_BUDGET_S``, default 7000 s): nominal budgets are SSZ
   600 + mainnet 1500 + ingest 1500 + boot 600 + registry-planes 300 +
-  telemetry 120 + pipeline 120 + trace 60 + sharded mesh 900 + BLS
-  2x1200, and when elapsed time eats a later stage's slice the stage
+  telemetry 120 + pipeline 120 + trace 60 + sharded mesh 900 +
+  witness 300 + BLS 2x1200, and when elapsed time eats a later stage's
+  slice the stage
   shrinks (or is skipped with a ``truncated: true`` absence record)
   instead of letting the SUM blow past the outer timeout — the
   BENCH_r05 zero-record failure mode;
@@ -111,6 +112,7 @@ _STAGE_METRICS: tuple[tuple[str | None, tuple[str, ...]], ...] = (
         "trace_noop_overhead_pct",
     )),
     ("BENCH_NO_SHARD", ("sharded_verify_entries_per_sec",)),
+    ("BENCH_NO_WITNESS", ("witness_verifications_per_sec",)),
     (None, ("aggregate_bls_verifications_per_sec",)),
 )
 
@@ -759,6 +761,25 @@ def main() -> None:
         # sharded crypto plane on the 8-way mesh (probe-guarded; falls
         # back to the virtual CPU mesh when no live multichip backend)
         for rec in _bench_sharded_stage():
+            _emit(rec)
+
+    if not os.environ.get("BENCH_NO_WITNESS"):
+        # stateless witness plane (round 15): batched multiproof
+        # verification at the witness_verify buckets; on CPU this
+        # certifies the >= 10k proofs/s host-fallback floor, and the
+        # VC prototype + proof-generation rates ride along
+        for rec in _bench_script(
+            "bench_witness.py",
+            ("witness_verifications_per_sec",
+             "witness_proof_generate_per_sec",
+             "witness_proof_bytes",
+             "witness_vc_verifications_per_sec"),
+            float(os.environ.get("BENCH_WITNESS_BUDGET_S", "300")),
+            units={"witness_verifications_per_sec": "proofs/s",
+                   "witness_proof_generate_per_sec": "proofs/s",
+                   "witness_proof_bytes": "bytes",
+                   "witness_vc_verifications_per_sec": "openings/s"},
+        ):
             _emit(rec)
 
     bls_recs, err = _bench_bls()

@@ -6,6 +6,23 @@
 - ``GET /eth/v2/beacon/blocks/{block_id}``
 - plus ``/eth/v1/node/health``, ``/eth/v1/node/identity`` and ``/metrics``
 
+The stateless-witness surface (this client's addition — ROADMAP item 4,
+round 15):
+
+- ``GET /eth/v0/witness/{state_id}?indices=balances:0,validators:3``
+  serves a deduplicated binary-Merkle multiproof for arbitrary element
+  indices into the big BeaconState lists, generated from the incremental
+  root engine's retained levels (``&format=ssz`` for the compact binary
+  encoding, JSON default);
+- ``POST /eth/v0/witness/verify`` checks proofs (JSON body — a single
+  proof object or ``{"proofs": [...]}`` — or one binary proof as
+  ``application/octet-stream``) through the batched verification plane;
+  ``state_id`` in the JSON body anchors the expected root to the chain
+  instead of trusting the proof's own claim.
+
+Both witness routes dispatch off the event loop like every other heavy
+route and record ``witness_request_seconds{route=...}``.
+
 Implemented as a dependency-free asyncio HTTP/1.1 server; the reference's
 v1 state-root route is mostly hardcoded TODOs (v1/beacon_controller.ex:7-60)
 — here every route answers from live chain data.
@@ -77,8 +94,15 @@ class BeaconApiServer:
         # ("/eth/v1/beacon/states/([^/]+)/root" -> ".../{id}/root")
         self._route_labels = {
             pattern: pattern.replace("([^/]+)", "{id}")
-            for pattern, _ in self._routes()
+            for pattern, _ in self._routes() + self._post_routes()
         }
+        # routes whose handler takes the raw query string as its last arg
+        self._query_patterns = frozenset(
+            p for p, _ in self._routes() if "witness" in p
+        )
+        # per-state multiproof planners (lambda_ethereum_consensus_tpu.
+        # witness), created lazily on the first witness request
+        self._witness = None
 
     # Routes answered ON the event loop (derived from _inline_routes in
     # __init__ — the patterns are literal paths): trivially cheap, and
@@ -105,6 +129,10 @@ class BeaconApiServer:
 
     # ------------------------------------------------------------ plumbing
 
+    # bound on POST bodies (witness verify batches): past this the route
+    # answers 413 instead of buffering an unbounded upload on the loop
+    _MAX_BODY = 4 << 20
+
     async def _handle(self, reader, writer) -> None:
         try:
             request_line = await asyncio.wait_for(reader.readline(), 10)
@@ -112,45 +140,101 @@ class BeaconApiServer:
             if len(parts) < 2:
                 return
             method, path = parts[0], parts[1]
-            while True:  # drain headers
+            content_length = 0
+            content_type = ""
+            while True:  # drain headers, keeping the two the body needs
                 line = await asyncio.wait_for(reader.readline(), 10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            if path.split("?", 1)[0] in self._inline_paths:
-                status, content_type, body = self._route_inline(method, path)
+                key, _, value = line.decode("latin1").partition(":")
+                key = key.strip().lower()
+                if key == "content-length":
+                    try:
+                        content_length = int(value.strip())
+                    except ValueError:
+                        content_length = 0
+                elif key == "content-type":
+                    content_type = value.strip()
+            body = b""
+            if method == "POST" and content_length > 0:
+                if content_length > self._MAX_BODY:
+                    status, ctype, payload = self._error(413, "body too large")
+                    writer.write(
+                        (
+                            f"HTTP/1.1 {status}\r\n"
+                            f"Content-Type: {ctype}\r\n"
+                            f"Content-Length: {len(payload)}\r\n"
+                            "Connection: close\r\n\r\n"
+                        ).encode()
+                        + payload
+                    )
+                    await writer.drain()
+                    return
+                body = await asyncio.wait_for(
+                    reader.readexactly(content_length), 10
+                )
+            if method == "GET" and path.split("?", 1)[0] in self._inline_paths:
+                status, ctype, payload = self._route_inline(method, path)
             else:
-                status, content_type, body = (
+                status, ctype, payload = (
                     await asyncio.get_running_loop().run_in_executor(
-                        None, self._route, method, path
+                        None, self._route, method, path, body, content_type
                     )
                 )
             head = (
                 f"HTTP/1.1 {status}\r\n"
-                f"Content-Type: {content_type}\r\n"
-                f"Content-Length: {len(body)}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
                 "Connection: close\r\n\r\n"
             )
-            writer.write(head.encode() + body)
+            writer.write(head.encode() + payload)
             await writer.drain()
-        except (asyncio.TimeoutError, ConnectionError, OSError):
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionError, OSError):
             pass
         finally:
             writer.close()
 
-    def _route(self, method: str, path: str) -> tuple[str, str, bytes]:
+    def _route(
+        self, method: str, path: str, body: bytes = b"", ctype: str = ""
+    ) -> tuple[str, str, bytes]:
         """Worker-thread dispatch over the FULL route table.  The handler
         call stays lexically in this loop (not a shared helper) so the
         graftlint async-blocking rule can resolve the dispatch table it
         iterates and prove which handlers each dispatcher reaches."""
+        path, _, query = path.partition("?")
+        if method == "POST":
+            for pattern, handler in self._post_routes():
+                m = re.fullmatch(pattern, path)
+                if m:
+                    t0 = time.perf_counter()
+                    try:
+                        return handler(body, ctype, *m.groups())
+                    except KeyError:
+                        return self._error(404, "not found")
+                    except ValueError as e:
+                        return self._error(400, str(e))
+                    except Exception:
+                        log.exception("beacon api handler failed on %s", path)
+                        return self._error(500, "internal error")
+                    finally:
+                        get_metrics().observe(
+                            "api_request_seconds",
+                            time.perf_counter() - t0,
+                            route=self._route_labels[pattern],
+                        )
+            return self._error(404, "unknown route")
         if method != "GET":
             return self._error(405, "method not allowed")
-        path = path.split("?", 1)[0]
         for pattern, handler in self._routes():
             m = re.fullmatch(pattern, path)
             if m:
+                # witness routes take the raw query string as a trailing
+                # argument (index list + format live there)
+                extra = (query,) if pattern in self._query_patterns else ()
                 t0 = time.perf_counter()
                 try:
-                    return handler(*m.groups())
+                    return handler(*m.groups(), *extra)
                 except KeyError:
                     return self._error(404, "not found")
                 except ValueError as e:
@@ -206,11 +290,21 @@ class BeaconApiServer:
             # SSZ state download — what checkpoint sync fetches
             # (ref: checkpoint_sync.ex:14 GET /eth/v2/debug/beacon/states/...)
             (r"/eth/v2/debug/beacon/states/([^/]+)", self._debug_state),
+            # stateless witness plane (round 15): multiproofs for
+            # arbitrary indices into the big BeaconState lists
+            (r"/eth/v0/witness/([^/]+)", self._witness_proof),
             (r"/metrics", self._metrics),
             (r"/debug/trace", self._debug_trace),
             (r"/debug/compile", self._debug_compile),
             (r"/debug/slo", self._debug_slo),
         ] + self._inline_routes()
+
+    def _post_routes(self) -> list[tuple[str, Callable]]:
+        """POST routes (worker-thread only; handlers take (body, ctype,
+        *groups))."""
+        return [
+            (r"/eth/v0/witness/verify", self._witness_verify),
+        ]
 
     def _inline_routes(self) -> list[tuple[str, Callable]]:
         """Handlers cheap enough for the event loop (see _inline_paths)."""
@@ -227,7 +321,12 @@ class BeaconApiServer:
 
     @staticmethod
     def _error(code: int, message: str) -> tuple[str, str, bytes]:
-        reasons = {400: "Bad Request", 404: "Not Found", 405: "Method Not Allowed"}
+        reasons = {
+            400: "Bad Request",
+            404: "Not Found",
+            405: "Method Not Allowed",
+            413: "Payload Too Large",
+        }
         return (
             f"{code} {reasons.get(code, 'Error')}",
             "application/json",
@@ -302,6 +401,110 @@ class BeaconApiServer:
         root = self._resolve_block_root(state_id)
         state = self.store.block_states[root]
         return "200 OK", "application/octet-stream", state.encode(self.spec)
+
+    # ------------------------------------------------------ witness plane
+
+    def _witness_service(self):
+        """Lazy per-server witness service (bounded per-state planners);
+        created on first use so the API server stays importable without
+        the witness subsystem's dependencies loaded."""
+        if self._witness is None:
+            from ..witness.service import WitnessService
+
+            self._witness = WitnessService()
+        return self._witness
+
+    def _witness_proof(self, state_id: str, query: str = "") -> tuple[str, str, bytes]:
+        """``GET /eth/v0/witness/{state_id}?indices=field:idx,...`` —
+        a deduplicated binary-Merkle multiproof for arbitrary element
+        indices into the big BeaconState lists, served from the
+        incremental engine's retained levels.  ``format=ssz`` selects the
+        compact binary encoding (JSON default)."""
+        t0 = time.perf_counter()
+        params = dict(
+            kv.split("=", 1) for kv in query.split("&") if "=" in kv
+        )
+        requests = []
+        for item in params.get("indices", "").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            field, _, idx = item.partition(":")
+            if not idx or not idx.lstrip("-").isdigit():
+                raise ValueError(
+                    f"bad index spec {item!r} (want field:element_index)"
+                )
+            requests.append((field, int(idx)))
+        if not requests:
+            raise ValueError("indices query parameter is required")
+        root = self._resolve_block_root(state_id)
+        state = self.store.block_states[root]
+        proof = self._witness_service().prove(root, state, requests, self.spec)
+        fmt = params.get("format", "json")
+        if fmt == "ssz":
+            payload = proof.encode()
+            answer = ("200 OK", "application/octet-stream", payload)
+        elif fmt == "json":
+            payload = json.dumps({"data": proof.to_json()}).encode()
+            answer = ("200 OK", "application/json", payload)
+        else:
+            raise ValueError(f"unknown format {fmt!r} (json|ssz)")
+        m = get_metrics()
+        m.observe(
+            "witness_request_seconds", time.perf_counter() - t0, route="proof"
+        )
+        m.inc("witness_proof_bytes_total", len(payload))
+        return answer
+
+    def _witness_verify(self, body: bytes, ctype: str) -> tuple[str, str, bytes]:
+        """``POST /eth/v0/witness/verify`` — batched proof verification.
+        JSON body: one proof object or ``{"proofs": [...], "state_id":
+        optional}``; ``application/octet-stream``: one binary-encoded
+        proof.  With ``state_id`` the expected root is anchored to the
+        chain's block header (the trustworthy direction); without it the
+        check is purely cryptographic against each proof's claimed root."""
+        from ..witness.multiproof import WitnessProof
+        from ..witness.verify import verify_batch
+
+        t0 = time.perf_counter()
+        state_id = None
+        if ctype.split(";", 1)[0].strip() == "application/octet-stream":
+            proofs = [WitnessProof.decode(body)]
+        else:
+            try:
+                obj = json.loads(body.decode() or "{}")
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise ValueError(f"malformed JSON body: {e}") from None
+            if not isinstance(obj, dict):
+                raise ValueError("body must be a JSON object")
+            state_id = obj.get("state_id")
+            raw = obj.get("proofs", obj if "leaves" in obj else None)
+            if raw is None:
+                raise ValueError("body carries neither 'proofs' nor a proof")
+            if isinstance(raw, dict):
+                raw = [raw]
+            proofs = [WitnessProof.from_json(p) for p in raw]
+        if state_id is not None:
+            if self.store is None:
+                raise ValueError("state_id anchoring needs a chain store")
+            root = self._resolve_block_root(str(state_id))
+            expected = [bytes(self.store.blocks[root].state_root)] * len(proofs)
+            anchored = True
+        else:
+            expected = [p.state_root for p in proofs]
+            anchored = False
+        results = verify_batch(proofs, expected)
+        get_metrics().observe(
+            "witness_request_seconds", time.perf_counter() - t0, route="verify"
+        )
+        return self._json({
+            "data": {
+                "valid": all(results),
+                "results": results,
+                "batch": len(results),
+                "anchored": anchored,
+            }
+        })
 
     def _health(self) -> tuple[str, str, bytes]:
         return "200 OK", "application/json", b"{}"
@@ -379,6 +582,7 @@ class BeaconApiServer:
                     "attestation_entries": list(
                         shape_buckets("attestation_entries")
                     ),
+                    "witness_verify": list(shape_buckets("witness_verify")),
                 },
                 "executables": compile_profile(),
             }

@@ -29,6 +29,7 @@ __all__ = [
     "warm_drain_programs",
     "warm_sharded_programs",
     "warm_transition",
+    "warm_witness",
     "start_warmer",
 ]
 
@@ -170,22 +171,40 @@ def warm_transition(n_validators: int) -> float:
     return warm_transition_programs(n_validators)
 
 
+def warm_witness() -> float:
+    """Load/compile the batched witness-verification plane at its
+    canonical serving shape (witness/verify.py) so the first real
+    light-client batch dispatches a resident program.  Registers the
+    ``witness_verify`` shape buckets as a side effect — the API's verify
+    route snaps batch sizes onto them."""
+    from ..witness.verify import warm_witness_programs
+
+    dt = warm_witness_programs()
+    observe("warmup_phase_seconds", dt, phase="witness")
+    return dt
+
+
 def start_warmer(
     shapes: DrainShapes, stats: dict | None = None,
     n_validators: int | None = None,
 ) -> threading.Thread:
     """Run :func:`warm_drain_programs` (and, when the resident transition
-    is enabled for this registry size, :func:`warm_transition`) on a
-    daemon thread; failures land in ``stats['error']`` (a silent cold
-    start would corrupt the boot timeline's meaning)."""
+    is enabled for this registry size, :func:`warm_transition`, plus the
+    witness-verification plane) on a daemon thread; failures land in
+    ``stats['error']`` (a silent cold start would corrupt the boot
+    timeline's meaning)."""
     stats = stats if stats is not None else {}
-    # advertise the warmed batch shape BEFORE the dispatch: the ingest
+    # advertise the warmed batch shapes BEFORE the dispatch: the ingest
     # scheduler starts snapping flush sizes to this bucket immediately,
     # so the first real drain lands on the program the warmer is loading
-    # rather than tracing a near-miss shape of its own
+    # rather than tracing a near-miss shape of its own; same contract for
+    # the witness plane's verify-batch buckets
     from ..ops.aot import register_shape_bucket
+    from ..witness.verify import DEFAULT_BATCH_BUCKETS
 
     register_shape_bucket("attestation_entries", shapes.entries)
+    for bucket in DEFAULT_BATCH_BUCKETS:
+        register_shape_bucket("witness_verify", bucket)
 
     def run():
         try:
@@ -196,6 +215,7 @@ def start_warmer(
                 ),
                 1,
             )
+            stats["witness_s"] = round(warm_witness(), 1)
         except Exception as e:  # visible, never fatal to boot
             stats["error"] = f"{type(e).__name__}: {e}"
 

@@ -146,6 +146,16 @@ DEFAULT_SLOS = (
         0.95, 1.0,
         "one gossip batch decode+verify+verdict round",
     ),
+    SloDef(
+        "witness_verify_p95", "witness_verify_seconds",
+        0.95, 1.0,
+        # one batched multiproof check (up to a 256-proof bucket): the
+        # stateless-serving floor — a node past this cannot answer light
+        # clients at line rate whatever its gossip health.  The witness
+        # bench pushes the ACTUAL throughput target (>= 10k proofs/s on
+        # the CPU fallback); this gate is the health bound
+        "one batched stateless-witness multiproof verification",
+    ),
 )
 
 

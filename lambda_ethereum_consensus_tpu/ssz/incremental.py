@@ -169,6 +169,10 @@ class IncrementalStateRoot:
         self._host = HashlibBackend()
         self._fields: dict[str, _FieldCache] = {}
         self._spec_name = None
+        # container-level rows retained by the last root() call: the
+        # witness plane reads top-level multiproof siblings from here
+        # instead of re-deriving every field root
+        self._top_levels: list[np.ndarray] | None = None
 
     # ------------------------------------------------------------- public
     def root(self, state, spec=None) -> bytes:
@@ -178,6 +182,7 @@ class IncrementalStateRoot:
         if self._spec_name != spec.name:
             # config swap invalidates every cached limit/shape
             self._fields.clear()
+            self._top_levels = None
             self._spec_name = spec.name
         backend = self.backend or get_hash_backend()
         schema = self.cls.__ssz_schema__
@@ -189,7 +194,27 @@ class IncrementalStateRoot:
             )
         # top-level container tree: ~32 leaves, host hashing
         levels = _build_levels(roots, self._host)
+        self._top_levels = levels
         return _cap_root(levels, len(schema))
+
+    # ---- witness-plane accessors (lambda_ethereum_consensus_tpu.witness):
+    # every Merkle level is already resident per big field, so a
+    # multiproof planner can read arbitrary interior nodes without
+    # rebuilding any part of the tree.
+
+    def top_levels(self) -> list[np.ndarray] | None:
+        """Container-level rows (field roots upward) as of the last
+        :meth:`root` call, or ``None`` before any root was computed."""
+        return self._top_levels
+
+    def field_levels(self, fname: str) -> list[np.ndarray] | None:
+        """The retained populated-subtree levels of one big field
+        (bottom = packed chunks / element roots), or ``None`` when the
+        field is uncached (small-field strategy, or no root yet)."""
+        cache = self._fields.get(fname)
+        if cache is None or cache.levels is None:
+            return None
+        return cache.levels
 
     def rotate_participation(self, new_current, spec=None) -> bool:
         """Epoch participation reset as two structural moves: the cached

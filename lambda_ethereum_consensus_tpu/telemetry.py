@@ -114,6 +114,10 @@ _HELP = {
     "aot_load_seconds": "AOT executable deserialize wall time per entry point",
     "warmup_phase_seconds": "background warmer phase wall time by phase",
     "api_request_seconds": "beacon API handler latency by route",
+    "witness_request_seconds": "witness API handler latency by route (proof|verify)",
+    "witness_verify_seconds": "one batched multiproof verification (host or device plane)",
+    "witness_verified_total": "multiproofs verified by the witness plane, by result",
+    "witness_proof_bytes_total": "witness proof bytes served by the proof route",
     "slo_quantile_seconds": "observed quantile per SLO (log-bucket estimate)",
     "slo_budget_seconds": "configured budget per SLO",
     "slo_ok": "1 while the SLO's observed quantile is within budget",

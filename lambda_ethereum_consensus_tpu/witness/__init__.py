@@ -1,0 +1,48 @@
+"""Stateless witness plane: binary-Merkle multiproofs + a vector-commitment
+prototype (ROADMAP item 4).
+
+A node serving millions of *stateless* light clients answers "what is
+balance[i] / validator[j] under state root R?" with a **witness**: the
+leaf chunks plus the minimal deduplicated sibling set that rehashes to R.
+The stateless-client benchmarking work (arXiv:2504.14069) frames the two
+proof families that matter — binary Merkle multiproofs (this module's
+production path, generated straight from the incremental root engine's
+retained tree levels) and Verkle-style vector commitments (TS-Verkle,
+arXiv:2605.08682; prototyped here on the existing BLS12-381 G1 stack,
+clearly flagged experimental).
+
+Submodules:
+
+- :mod:`.multiproof` — gindex math, proof planning/generation against
+  :class:`~lambda_ethereum_consensus_tpu.ssz.incremental.IncrementalStateRoot`
+  retained levels, SSZ/JSON proof encodings, and the bit-exact pure-host
+  verification oracle.  numpy + hashlib only — importable without JAX.
+- :mod:`.verify` — batched verification: B independent multiproofs as one
+  data-parallel SHA-256 plane (``witness_verify`` shape buckets, warmed by
+  node/warmup.py), with the host oracle as the routing fallback.
+- :mod:`.vector_commitment` — width-256 Pedersen vector commitment on the
+  G1/MSM machinery (EXPERIMENTAL — see its module docstring).
+
+Serving surface: ``GET /eth/v0/witness/{state_id}?indices=...`` and
+``POST /eth/v0/witness/verify`` on the beacon API (api/beacon_api.py).
+"""
+
+from .multiproof import (  # noqa: F401
+    WitnessError,
+    WitnessProof,
+    WitnessPlanner,
+    helper_gindices,
+    plan_rounds,
+    verify_host,
+    witness_fields,
+)
+
+__all__ = [
+    "WitnessError",
+    "WitnessProof",
+    "WitnessPlanner",
+    "helper_gindices",
+    "plan_rounds",
+    "verify_host",
+    "witness_fields",
+]

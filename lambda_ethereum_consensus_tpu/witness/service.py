@@ -1,0 +1,70 @@
+"""Witness serving state: bounded per-state multiproof planners.
+
+The beacon API serves witnesses for a handful of recent states (head,
+justified, finalized); each :class:`~.multiproof.WitnessPlanner` retains
+a full set of tree levels for its state (tens of MB at 1M validators),
+so the service keeps a small LRU of planners keyed by block root — the
+first request against a state pays one engine build, every later
+request for the same state reads retained levels in O(proof) time.
+
+Why a DEDICATED engine per served state rather than the state's own
+``_root_engine``: the lineage engine (state_transition/core.py) is
+lock-free single-threaded consensus state — ONE object rides the whole
+advancing chain, re-stamped by every block's transition.  Witness
+requests run on API worker threads concurrently with block application;
+sharing that engine would both race its level arrays mid-rebuild
+(torn proofs — or worse, torn roots fed back into consensus) and
+re-sync its caches BACKWARD to whatever historical state a client asks
+about, degrading the hot transition path's pushed-delta stamps.  The
+service pays one isolated build per state (off the event loop, under
+the planner's lock) and keeps consensus state untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..types.beacon import BeaconState
+from .multiproof import WitnessPlanner, WitnessProof
+
+__all__ = ["WitnessService"]
+
+
+class WitnessService:
+    """Thread-safe planner cache (witness requests run on API worker
+    threads; two concurrent first-requests for one state would otherwise
+    both build engines)."""
+
+    def __init__(self, cls: type = BeaconState, capacity: int = 4):
+        # capacity covers the states the API actually serves hot (head,
+        # justified, finalized) plus one historical straggler — at 2 the
+        # head/justified/finalized rotation would evict the planner it
+        # is about to need on every third request
+        self.cls = cls
+        self.capacity = max(1, int(capacity))
+        # root -> (planner, its lock): the registry lock only guards the
+        # LRU map; each planner serializes its own engine (concurrent
+        # proofs against one state would race the field caches
+        # mid-rebuild), so two different states prove concurrently
+        self._planners: OrderedDict[bytes, tuple] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def planner(self, anchor_root: bytes) -> tuple:
+        """``(planner, lock)`` for one state root, LRU-bounded."""
+        with self._lock:
+            entry = self._planners.get(anchor_root)
+            if entry is None:
+                entry = self._planners[anchor_root] = (
+                    WitnessPlanner(self.cls),
+                    threading.Lock(),
+                )
+            self._planners.move_to_end(anchor_root)
+            while len(self._planners) > self.capacity:
+                self._planners.popitem(last=False)
+        return entry
+
+    def prove(self, anchor_root: bytes, state, requests, spec=None) -> WitnessProof:
+        planner, lock = self.planner(bytes(anchor_root))
+        with lock:
+            return planner.prove(state, requests, spec)

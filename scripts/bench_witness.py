@@ -1,0 +1,159 @@
+"""Stateless-witness bench: batched multiproof verification throughput.
+
+One JSON metric line per measurement (bench.py's guarded subprocess
+contract); the headline is ``witness_verifications_per_sec`` — complete
+multiproofs (mainnet-shape, ~45 Merkle levels each) checked per second
+through the batched plane at the registered ``witness_verify`` buckets.
+On a CPU backend the measured path is the vectorized host fallback
+(witness/verify.py ``_verify_plane_host`` — the 10k proofs/s floor the
+round-15 acceptance demands); on a TPU backend the jitted plane.
+
+Riders (informational, not inventory-gated):
+
+- ``witness_proof_generate_per_sec`` — multiproof generation off the
+  incremental engine's retained levels (zero tree rebuilds);
+- ``witness_proof_bytes`` — encoded single-index proof size;
+- ``witness_vc_verifications_per_sec`` — the EXPERIMENTAL width-256
+  Pedersen vector-commitment prototype's folded-MSM opening check
+  (witness/vector_commitment.py; see its caveats).
+
+Usage: python scripts/bench_witness.py [--proofs N] [--batch B] [--no-vc]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.config import (  # noqa: E402
+    minimal_spec,
+    use_chain_spec,
+)
+from lambda_ethereum_consensus_tpu.crypto import bls  # noqa: E402
+from lambda_ethereum_consensus_tpu.state_transition.genesis import (  # noqa: E402
+    build_genesis_state,
+)
+from lambda_ethereum_consensus_tpu.witness import WitnessPlanner  # noqa: E402
+from lambda_ethereum_consensus_tpu.witness.verify import (  # noqa: E402
+    verify_batch,
+)
+
+N_VALIDATORS = 64
+
+
+def _emit(rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+
+
+def bench_verify(proofs, root, n_total: int, batch: int) -> float:
+    # warm once (plan templates, and the jitted plane's compile when the
+    # backend routes there), then measure steady-state batches
+    verify_batch(proofs[:batch], root)
+    done = 0
+    t0 = time.perf_counter()
+    while done < n_total:
+        res = verify_batch(proofs[:batch], root)
+        assert all(res), "bench proofs must verify"
+        done += batch
+    return done / (time.perf_counter() - t0)
+
+
+def bench_vc() -> float:
+    from lambda_ethereum_consensus_tpu.witness import vector_commitment as VC
+
+    values = [(i * 2654435761) % (1 << 60) for i in range(VC.WIDTH)]
+    commitment = VC.commit(values)
+    openings = [VC.open_indices(values, [j % VC.WIDTH]) for j in range(4)]
+    commitments = [commitment] * len(openings)
+    assert VC.verify_openings(commitments, openings)  # warm generators
+    n = 0
+    t0 = time.perf_counter()
+    while n < 8 and time.perf_counter() - t0 < 30:
+        assert VC.verify_openings(commitments, openings)
+        n += len(openings)
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--proofs", type=int, default=4096,
+                    help="total proofs to verify (default 4096)")
+    ap.add_argument("--batch", type=int, default=256,
+                    help="proofs per verify_batch call (default 256)")
+    ap.add_argument("--indices", type=int, default=1,
+                    help="element indices per proof (default 1)")
+    ap.add_argument("--no-vc", action="store_true",
+                    help="skip the vector-commitment prototype stage")
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    sks = [(i + 1).to_bytes(32, "big") for i in range(N_VALIDATORS)]
+    with use_chain_spec(minimal_spec()) as spec:
+        state = build_genesis_state(
+            [bls.sk_to_pk(sk) for sk in sks], spec=spec
+        )
+        planner = WitnessPlanner()
+        fields = ("balances", "inactivity_scores", "validators")
+        t0 = time.perf_counter()
+        proofs = [
+            planner.prove(
+                state,
+                [
+                    (fields[(i + j) % len(fields)], (i * 7 + j) % N_VALIDATORS)
+                    for j in range(args.indices)
+                ],
+                spec,
+            )
+            for i in range(args.batch)
+        ]
+        gen_rate = args.batch / (time.perf_counter() - t0)
+        root = proofs[0].state_root
+
+        rate = bench_verify(proofs, root, args.proofs, args.batch)
+        _emit({
+            "metric": "witness_verifications_per_sec",
+            "value": round(rate, 1),
+            "unit": "proofs/s",
+            "backend": backend,
+            "batch": args.batch,
+            "indices_per_proof": args.indices,
+            "proofs": args.proofs,
+            # the acceptance floor this stage certifies on CPU
+            "vs_baseline": round(rate / 10_000.0, 2),
+        })
+        _emit({
+            "metric": "witness_proof_generate_per_sec",
+            "value": round(gen_rate, 1),
+            "unit": "proofs/s",
+            "note": "generation from retained incremental-engine levels",
+        })
+        _emit({
+            "metric": "witness_proof_bytes",
+            "value": len(proofs[0].encode()),
+            "unit": "bytes",
+            "indices_per_proof": args.indices,
+        })
+
+    if not args.no_vc:
+        vc_rate = bench_vc()
+        _emit({
+            "metric": "witness_vc_verifications_per_sec",
+            "value": round(vc_rate, 2),
+            "unit": "openings/s",
+            "note": (
+                "EXPERIMENTAL width-256 Pedersen VC prototype; folded-MSM "
+                "opening check on the host ladder (device MSM on TPU)"
+            ),
+        })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,7 @@ EXERCISED = frozenset({
     "ingest_sched_p99",              # scheduler drain rounds
     "api_request_p99",               # drive_api GET burst
     "block_transition_p95",          # drive_transitions mini-replay
+    "witness_verify_p95",            # drive_witness batched multiproofs
 })
 
 
@@ -225,6 +226,46 @@ def drive_transitions(n_blocks: int) -> int:
             signed, _post = build_signed_block(cur, slot, sks, spec=spec)
             cur = state_transition(cur, signed, validate_result=True, spec=spec)
     return n_blocks
+
+
+def drive_witness(n_batches: int) -> int:
+    """The stateless-witness phase: real multiproofs over a minimal-spec
+    genesis state, verified through the REAL batched plane (witness/
+    verify.py) — the same ``witness_verify`` span the serving route
+    records into.  Mostly host-plane batches (the CPU fallback the
+    throughput bench also measures) with a couple of jitted-plane
+    batches riding along, so a first-call XLA compile lands in the tail
+    above p95 instead of defining it."""
+    from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
+    from lambda_ethereum_consensus_tpu.crypto import bls
+    from lambda_ethereum_consensus_tpu.state_transition.genesis import (
+        build_genesis_state,
+    )
+    from lambda_ethereum_consensus_tpu.witness import WitnessPlanner
+    from lambda_ethereum_consensus_tpu.witness.verify import verify_batch
+
+    sks = [(i + 1).to_bytes(32, "big") for i in range(16)]
+    with use_chain_spec(minimal_spec()) as spec:
+        state = build_genesis_state(
+            [bls.sk_to_pk(sk) for sk in sks], spec=spec
+        )
+        planner = WitnessPlanner()
+        proofs = [
+            planner.prove(
+                state,
+                [("balances", i % 16), ("inactivity_scores", (i * 3) % 16)],
+                spec,
+            )
+            for i in range(32)
+        ]
+        root = proofs[0].state_root
+        done = 0
+        for i in range(n_batches):
+            # every ~12th batch exercises the jitted plane; the rest run
+            # the vectorized host fallback
+            verify_batch(proofs, root, device=(i % 12 == 11))
+            done += 1
+    return done
 
 
 def replay_slot_phases(n_slots: int, seed: int) -> int:
@@ -371,6 +412,7 @@ def main() -> int:
     load = asyncio.run(drive_pipeline(engine, duration, rates))
     slots = replay_slot_phases(8 if args.smoke else 64, args.seed)
     blocks = drive_transitions(9 if args.smoke else 17)
+    witness_batches = drive_witness(24 if args.smoke else 60)
     n_api = 25 if args.smoke else 100
     served, api_failed = asyncio.run(drive_api(n_api))
 
@@ -419,6 +461,7 @@ def main() -> int:
         "pipeline_sheds": load["sheds"],
         "slots_replayed": slots,
         "blocks_transitioned": blocks,
+        "witness_batches": witness_batches,
         "api_requests_ok": served,
         "api_requests_expected": n_api,
         "seed": args.seed,

@@ -25,6 +25,22 @@ N = 64
 SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
 CHAIN_LEN = 5
 
+# NodeConfig defaults to the real libp2p wire, whose sidecar subprocess
+# needs the optional 'cryptography' module (noise/ed25519 identity);
+# without it the sidecar exits at import and every libp2p-wire test dies
+# with an opaque "sidecar exited" — skip with the real reason instead
+try:
+    import cryptography  # noqa: F401
+
+    _LIBP2P_WIRE_OK = True
+except ImportError:
+    _LIBP2P_WIRE_OK = False
+
+needs_libp2p_wire = pytest.mark.skipif(
+    not _LIBP2P_WIRE_OK,
+    reason="libp2p-wire sidecar needs the optional 'cryptography' module",
+)
+
 
 def run(coro):
     return asyncio.run(asyncio.wait_for(coro, timeout=300))
@@ -54,7 +70,11 @@ def chain():
         yield spec, genesis, blocks, state
 
 
-@pytest.mark.parametrize("wire", [None, "libp2p"], ids=["bespoke", "libp2p"])
+@pytest.mark.parametrize(
+    "wire",
+    [None, pytest.param("libp2p", marks=needs_libp2p_wire)],
+    ids=["bespoke", "libp2p"],
+)
 def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
     """wire=None: bespoke frames, host:port bootnode, plus the HTTP API
     checks.  wire="libp2p": the REAL stack — B learns A from a discv5
@@ -241,6 +261,7 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
     run(main())
 
 
+@needs_libp2p_wire  # both nodes boot NodeConfig's default libp2p wire
 def test_checkpoint_sync_from_our_own_api(chain, tmp_path):
     """Node C boots via --checkpoint-sync pointed at node A's Beacon API:
     the full weak-subjectivity flow (ref: checkpoint_sync.ex:14-40) served
@@ -277,6 +298,7 @@ def test_checkpoint_sync_from_our_own_api(chain, tmp_path):
     run(main())
 
 
+@needs_libp2p_wire  # both boots use NodeConfig's default libp2p wire
 def test_node_restart_resumes_from_db(chain, tmp_path):
     spec, genesis, blocks, _ = chain
 

@@ -73,6 +73,11 @@ def test_fixture_is_full_width():
 
 def test_p2p_message_id_domains():
     # ref: docs/specs/p2p-interface.md:148-149
+    # (importing the libp2p package pulls the noise identity stack)
+    pytest.importorskip(
+        "cryptography",
+        reason="libp2p package needs the optional 'cryptography' module",
+    )
     from lambda_ethereum_consensus_tpu.network.libp2p import gossipsub as G
 
     assert G.MESSAGE_DOMAIN_INVALID_SNAPPY == bytes.fromhex("00000000")
@@ -99,6 +104,10 @@ def test_p2p_gossip_message_id_formula():
     reference relies on go-libp2p computing the same)."""
     import hashlib
 
+    pytest.importorskip(
+        "cryptography",
+        reason="libp2p package needs the optional 'cryptography' module",
+    )
     from lambda_ethereum_consensus_tpu.network.libp2p import gossipsub as G
 
     topic = "/eth2/00000000/beacon_block/ssz_snappy"

@@ -13,6 +13,14 @@ import asyncio
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="libp2p identity/noise needs the optional 'cryptography' module",
+)
+
+
+import pytest
+
 from lambda_ethereum_consensus_tpu.network.discovery import discv5, rlp
 from lambda_ethereum_consensus_tpu.network.discovery.enr import ENR, ENRError
 from lambda_ethereum_consensus_tpu.network.discovery.keccak import keccak256

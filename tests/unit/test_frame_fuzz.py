@@ -22,6 +22,12 @@ import struct
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="libp2p identity/noise needs the optional 'cryptography' module",
+)
+
+
 from lambda_ethereum_consensus_tpu.compression import snappy
 from lambda_ethereum_consensus_tpu.network.libp2p import multistream, varint
 from lambda_ethereum_consensus_tpu.network.libp2p.gossipsub import (

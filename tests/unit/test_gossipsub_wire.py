@@ -11,6 +11,14 @@ import asyncio
 import hashlib
 import struct
 
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="libp2p identity/noise needs the optional 'cryptography' module",
+)
+
+
 from lambda_ethereum_consensus_tpu.compression.snappy import compress as raw_compress
 from lambda_ethereum_consensus_tpu.network.libp2p import gossipsub as gs
 from lambda_ethereum_consensus_tpu.network.libp2p.host import Libp2pHost

@@ -471,6 +471,66 @@ def test_retrace_hazard_passes_with_shape_discipline(tmp_path):
     assert findings == []
 
 
+def test_retrace_hazard_fires_on_unsnapped_witness_batch(tmp_path):
+    """The witness_verify bucket discipline (round 15): feeding the
+    batched multiproof plane an array built straight from a
+    variable-length proof batch — no snap/pad in scope — would trace a
+    fresh program per batch size mid-serve."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def _verify_rounds(nodes):
+                return nodes
+
+            verify_kernel = jax.jit(_verify_rounds)
+
+            def verify_batch(proof_nodes):
+                return verify_kernel(jnp.asarray(proof_nodes))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert len(findings) == 1 and "variable-length" in findings[0].message
+
+
+def test_retrace_hazard_passes_with_witness_bucket_snap(tmp_path):
+    """The shipped discipline (witness/verify.py): batch size snapped to
+    the registered witness_verify shape buckets, arrays padded to the
+    snapped shape before the jitted plane sees them."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def shape_buckets(kind):
+                return (64, 256)
+
+            def _verify_rounds(nodes):
+                return nodes
+
+            verify_kernel = jax.jit(_verify_rounds)
+
+            def verify_batch(proof_nodes):
+                batch = None
+                for b in shape_buckets("witness_verify"):
+                    if len(proof_nodes) <= b:
+                        batch = b
+                        break
+                padded = list(proof_nodes) + [0] * (batch - len(proof_nodes))
+                return verify_kernel(jnp.asarray(padded))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert findings == []
+
+
 def test_retrace_hazard_fires_on_use_after_donate(tmp_path):
     findings = lint_sources(
         tmp_path,

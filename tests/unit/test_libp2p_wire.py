@@ -13,6 +13,12 @@ import asyncio
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="libp2p identity/noise needs the optional 'cryptography' module",
+)
+
+
 from lambda_ethereum_consensus_tpu.network.libp2p import identity as ident
 from lambda_ethereum_consensus_tpu.network.libp2p import mplex, multistream
 from lambda_ethereum_consensus_tpu.network.libp2p.host import Libp2pHost

@@ -8,6 +8,12 @@ end to end over real sockets (noise is on by default there).
 """
 
 import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="libp2p identity/noise needs the optional 'cryptography' module",
+)
+
 from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
 
 from lambda_ethereum_consensus_tpu.network.noise import (

@@ -11,6 +11,15 @@ import asyncio
 
 import pytest
 
+# the libp2p-wire sidecar subprocess (network/sidecar_libp2p.py) needs the
+# optional 'cryptography' module for its noise/ed25519 identity; without it
+# the spawned sidecar exits at import and every test here reports "sidecar
+# exited" — skip with the real reason instead
+pytest.importorskip(
+    "cryptography",
+    reason="libp2p-wire sidecar needs the optional 'cryptography' module",
+)
+
 from lambda_ethereum_consensus_tpu.network.port import (
     Port,
     PortError,

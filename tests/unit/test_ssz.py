@@ -6,6 +6,12 @@ import hashlib
 
 import numpy as np
 import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need the optional 'hypothesis' module",
+)
+
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -10,6 +10,12 @@ import asyncio
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="libp2p identity/noise needs the optional 'cryptography' module",
+)
+
+
 from lambda_ethereum_consensus_tpu.network.libp2p import host as host_mod
 from lambda_ethereum_consensus_tpu.network.libp2p import yamux
 from lambda_ethereum_consensus_tpu.network.libp2p.host import Libp2pHost
