@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench bench-validate slo-smoke replay-smoke lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate slo-smoke duties-gate replay-smoke lint clean
 
 all: native
 
@@ -41,6 +41,14 @@ test: native
 # exits nonzero with a structured violation report on any budget miss.
 slo-smoke:
 	python scripts/slo_check.py --smoke
+
+# The 10k-key duty deadline gate (round 16): every attestation duty of
+# a full mainnet-spec epoch (10,240 keys, 32 slots) fired at 1/3 slot
+# and judged against its 2/3-slot broadcast deadline while gossip-shaped
+# load drains concurrently — the CI-scaled stand-in for the
+# 100k-validator operator (~2 min on CPU).
+duties-gate:
+	python scripts/slo_check.py --duties-keys 10240 --duties-slots 32
 
 # Quick pipelined-replay proof (round 13): mint a small devnet chain and
 # replay it with full validation, decode prefetch and per-block progress

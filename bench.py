@@ -20,8 +20,8 @@ tunnel hung the whole run at rc=124 with zero evidence):
   (``BENCH_TOTAL_BUDGET_S``, default 7000 s): nominal budgets are SSZ
   600 + mainnet 1500 + ingest 1500 + boot 600 + registry-planes 300 +
   telemetry 120 + pipeline 120 + trace 60 + sharded mesh 900 +
-  witness 300 + BLS 2x1200, and when elapsed time eats a later stage's
-  slice the stage
+  witness 300 + duties 300 + BLS 2x1200, and when elapsed time eats a
+  later stage's slice the stage
   shrinks (or is skipped with a ``truncated: true`` absence record)
   instead of letting the SUM blow past the outer timeout — the
   BENCH_r05 zero-record failure mode;
@@ -113,6 +113,10 @@ _STAGE_METRICS: tuple[tuple[str | None, tuple[str, ...]], ...] = (
     )),
     ("BENCH_NO_SHARD", ("sharded_verify_entries_per_sec",)),
     ("BENCH_NO_WITNESS", ("witness_verifications_per_sec",)),
+    ("BENCH_NO_DUTIES", (
+        "duty_signatures_per_sec",
+        "duties_met_per_epoch",
+    )),
     (None, ("aggregate_bls_verifications_per_sec",)),
 )
 
@@ -779,6 +783,20 @@ def main() -> None:
                    "witness_proof_generate_per_sec": "proofs/s",
                    "witness_proof_bytes": "bytes",
                    "witness_vc_verifications_per_sec": "openings/s"},
+        ):
+            _emit(rec)
+
+    if not os.environ.get("BENCH_NO_DUTIES"):
+        # validator-duty plane (round 16): batched signing throughput
+        # at the duty_sign buckets + a full mainnet-spec epoch of
+        # attester/aggregator duties judged against slot-phase
+        # deadlines while a gossip-shaped load drains concurrently
+        for rec in _bench_script(
+            "bench_duties.py",
+            ("duty_signatures_per_sec", "duties_met_per_epoch"),
+            float(os.environ.get("BENCH_DUTIES_BUDGET_S", "300")),
+            units={"duty_signatures_per_sec": "signatures/s",
+                   "duties_met_per_epoch": "duties/epoch"},
         ):
             _emit(rec)
 

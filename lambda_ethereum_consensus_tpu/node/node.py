@@ -93,6 +93,11 @@ class NodeConfig:
     # and aggregate lanes still have headroom — at the sum, a lane's own
     # full-check always fires first and the policy would be dead code
     ingest_max_items: int = 24576
+    # validator keys this node operates (validator index -> 32-byte
+    # secret key): a non-empty map arms the duty scheduler (round 16) —
+    # attestations at 1/3 slot, aggregation at 2/3, block proposal at
+    # the boundary, all batch-signed through the duty_sign plane
+    duty_keys: dict | None = None
 
 
 class BeaconNode:
@@ -115,6 +120,8 @@ class BeaconNode:
         self.pending: PendingBlocks | None = None
         self.api: BeaconApiServer | None = None
         self.slot_clock: SlotClock | None = None
+        self.duties = None  # DutyScheduler when config.duty_keys is set
+        self._duty_task: asyncio.Task | None = None
         self._head_root: bytes | None = None  # last head seen by _on_applied
         self._tasks: list[asyncio.Task] = []
         self._subs: list[TopicSubscription] = []
@@ -160,6 +167,15 @@ class BeaconNode:
             int(spec.SECONDS_PER_SLOT),
             constants.INTERVALS_PER_SLOT,
         )
+        if self.config.duty_keys:
+            from ..validator import DutyScheduler
+
+            self.duties = DutyScheduler(
+                self.config.duty_keys, spec, clock=self.slot_clock
+            )
+            log.info(
+                "duty scheduler armed: %d keys", len(self.config.duty_keys)
+            )
         anchor_root = anchor_root or anchor_block.hash_tree_root(spec)
         self.blocks_db.store_block(
             SignedBeaconBlock(message=anchor_block), spec, root=anchor_root
@@ -737,8 +753,70 @@ class BeaconNode:
                     # tick can also flip the head with no apply or
                     # attestation batch in sight
                     self._observe_head_transition()
+                # duty phases fire off the tick but run on an executor
+                # thread (batched signing is CPU-heavy by design); one
+                # in-flight firing at a time — a slow phase must not
+                # pile a new firing onto every tick behind it
+                if self.duties is not None and (
+                    self._duty_task is None or self._duty_task.done()
+                ):
+                    self._duty_task = asyncio.ensure_future(
+                        self._fire_duties()
+                    )
             except Exception:
                 log.exception("tick failed")
+
+    async def _fire_duties(self) -> None:
+        """One duty-scheduler pass: phase production on an executor
+        thread (the batched signing and block assembly are CPU-bound),
+        then publication on the loop — own blocks also enter the local
+        import path so the node's head advances without a gossip echo."""
+        loop = asyncio.get_running_loop()
+        try:
+            produced = await loop.run_in_executor(
+                None, self.duties.on_tick, self.store
+            )
+        except Exception:
+            log.exception("duty firing failed")
+            return
+        if not produced or self.port is None:
+            return
+        from ..network.gossip import publish_ssz
+        from ..state_transition.misc import compute_subnet_for_attestation
+
+        digest = self.chain.fork_digest()
+        try:
+            block = produced.get("block")
+            if block is not None:
+                signed, _post = block
+                if self.pending is not None:
+                    self.pending.add_block(signed)  # self-import, no echo wait
+                await publish_ssz(
+                    self.port, topic_name(digest, "beacon_block"),
+                    signed, self.spec,
+                )
+            subscribed = set(self.config.attnet_subnets)
+            cps = int(produced.get("committees_per_slot") or 1)
+            for att in produced.get("attestations", ()):
+                # votes for unsubscribed subnets stay pooled (the
+                # aggregation duty still covers them); publishing to a
+                # mesh we are not part of would just be dropped
+                subnet = compute_subnet_for_attestation(
+                    cps, int(att.data.slot), int(att.data.index), self.spec
+                )
+                if subnet in subscribed:
+                    await publish_ssz(
+                        self.port,
+                        topic_name(digest, f"beacon_attestation_{subnet}"),
+                        att, self.spec,
+                    )
+            agg_topic = topic_name(digest, "beacon_aggregate_and_proof")
+            for agg in produced.get("aggregates", ()):
+                await publish_ssz(self.port, agg_topic, agg, self.spec)
+        except Exception:
+            # a wedged sidecar must not kill duty production; the next
+            # slot's firing retries against whatever port is live then
+            log.exception("duty publication failed")
 
     def _sample_device_telemetry(self) -> None:
         """Per-tick device/cache gauges (ISSUE 2 tentpole): live device
@@ -863,6 +941,8 @@ class BeaconNode:
             await self.ingest.stop()
         if self.pending is not None:
             self.pending.stop()
+        if self._duty_task is not None:
+            self._duty_task.cancel()
         for t in self._tasks:
             t.cancel()
         if self.api is not None:

@@ -27,6 +27,7 @@ from ..telemetry import observe
 __all__ = [
     "DrainShapes",
     "warm_drain_programs",
+    "warm_duties",
     "warm_sharded_programs",
     "warm_transition",
     "warm_witness",
@@ -171,6 +172,20 @@ def warm_transition(n_validators: int) -> float:
     return warm_transition_programs(n_validators)
 
 
+def warm_duties() -> float:
+    """Register the ``duty_sign`` shape buckets and compile/load the
+    batched signing plane at its first bucket (ops/bls_sign.py) under
+    ``compile_context("warmup:duties")`` — so ``/debug/compile``
+    attributes the planned duty compiles to the warmup phase and a
+    slot's first duty flush never traces mid-slot.  Host backends only
+    register the buckets (the comb path has no program to warm)."""
+    from ..ops.bls_sign import warm_sign_programs
+
+    dt = warm_sign_programs()
+    observe("warmup_phase_seconds", dt, phase="duties")
+    return dt
+
+
 def warm_witness() -> float:
     """Load/compile the batched witness-verification plane at its
     canonical serving shape (witness/verify.py) so the first real
@@ -200,11 +215,14 @@ def start_warmer(
     # rather than tracing a near-miss shape of its own; same contract for
     # the witness plane's verify-batch buckets
     from ..ops.aot import register_shape_bucket
+    from ..ops.bls_sign import DEFAULT_SIGN_BUCKETS
     from ..witness.verify import DEFAULT_BATCH_BUCKETS
 
     register_shape_bucket("attestation_entries", shapes.entries)
     for bucket in DEFAULT_BATCH_BUCKETS:
         register_shape_bucket("witness_verify", bucket)
+    for bucket in DEFAULT_SIGN_BUCKETS:
+        register_shape_bucket("duty_sign", bucket)
 
     def run():
         try:
@@ -216,6 +234,7 @@ def start_warmer(
                 1,
             )
             stats["witness_s"] = round(warm_witness(), 1)
+            stats["duties_s"] = round(warm_duties(), 1)
         except Exception as e:  # visible, never fatal to boot
             stats["error"] = f"{type(e).__name__}: {e}"
 

@@ -147,6 +147,28 @@ DEFAULT_SLOS = (
         "one gossip batch decode+verify+verdict round",
     ),
     SloDef(
+        "duty_sign_p95", "duty_sign_seconds",
+        0.95, 2.0,
+        # one batched signing dispatch for a whole slot's duties (device
+        # G2 plane on TPU, shared-base comb on host): it must fit well
+        # inside the 4 s attest window with room for data assembly and
+        # publication.  The duties bench pushes the ACTUAL signatures/s
+        # target; this gate is the health bound
+        "one batched duty-signing dispatch (a slot's duties in one flush)",
+    ),
+    SloDef(
+        "duty_attest_deadline_p95", "duty_completion_offset_seconds",
+        0.95, 8.0,
+        # the duties-met row: an attestation broadcast after 2/3 of a
+        # mainnet slot (when aggregation opens) misses its inclusion
+        # window however valid it is — the hard per-slot deadline a
+        # 10^4-10^5-key operator must hit.  The offset includes the 1/3
+        # slot the honest timeline waits before attesting, so the
+        # production budget inside it is one interval
+        "attestation duties broadcast before aggregation (2/3 mainnet slot)",
+        labels=(("type", "attest"),),
+    ),
+    SloDef(
         "witness_verify_p95", "witness_verify_seconds",
         0.95, 1.0,
         # one batched multiproof check (up to a 256-proof bucket): the

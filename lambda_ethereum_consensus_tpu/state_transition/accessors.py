@@ -116,12 +116,20 @@ def get_beacon_committee(
     )
 
 
-def get_beacon_proposer_index(state, spec: ChainSpec | None = None) -> int:
+def get_beacon_proposer_index(
+    state, spec: ChainSpec | None = None, slot: int | None = None
+) -> int:
+    """Proposer at ``state.slot`` (the spec accessor), or at an explicit
+    ``slot`` — the proposer seed mixes the epoch seed with the slot
+    bytes, so one state answers a whole epoch's schedule (the duty
+    scheduler's ``proposer_index_at_slot`` delegates here)."""
     spec = spec or get_chain_spec()
-    epoch = get_current_epoch(state, spec)
+    if slot is None:
+        slot = int(state.slot)
+    epoch = misc.compute_epoch_at_slot(int(slot), spec)
     seed = hash_bytes(
         get_seed(state, epoch, constants.DOMAIN_BEACON_PROPOSER, spec)
-        + int(state.slot).to_bytes(8, "little")
+        + int(slot).to_bytes(8, "little")
     )
     indices = get_active_validator_indices(state, epoch)
     if hasattr(state, "registry"):

@@ -118,6 +118,13 @@ _HELP = {
     "witness_verify_seconds": "one batched multiproof verification (host or device plane)",
     "witness_verified_total": "multiproofs verified by the witness plane, by result",
     "witness_proof_bytes_total": "witness proof bytes served by the proof route",
+    "duty_sign_seconds": "one batched duty-signing dispatch (device G2 plane or host comb)",
+    "duty_signatures_total": "signatures produced by the signing plane, by path",
+    "duty_completion_offset_seconds": "duty-phase completion offset into its slot, by type",
+    "duties_produced_total": "validator duties produced, by type (attest|aggregate|propose)",
+    "duty_deadline_miss_total": "duties completed after their slot-phase deadline, by type",
+    "duty_pool_attestations": "attestation-pool cells currently held",
+    "duty_keys_managed": "validator keys the duty scheduler operates",
     "slo_quantile_seconds": "observed quantile per SLO (log-bucket estimate)",
     "slo_budget_seconds": "configured budget per SLO",
     "slo_ok": "1 while the SLO's observed quantile is within budget",

@@ -1,24 +1,39 @@
-"""Validator duties: block production, attestation production, signing.
+"""Validator-duty plane: duty scheduling, batched signing, production.
 
 The reference ships validator *containers* only (lib/ssz_types/validator/);
-a standalone framework also needs the production side — devnets, fixtures and
-integration tests all mint real signed blocks/attestations through here.
+this package carries the whole write side — the single-key helpers devnets
+and fixtures mint chains with (:mod:`.duties`), and the round-16 duty
+engine operating 10^4-10^5 keys from one node: per-epoch assignment
+derivation, batched device/host signing, pooled aggregation and the
+proposer path (:mod:`.scheduler`, :mod:`.pool`).
 """
 
 from .duties import (
+    attestation_data_from_state,
     build_aggregate_and_proof,
     build_signed_block,
     get_slot_signature,
     is_aggregator,
+    is_aggregator_hash,
     make_attestation,
+    proposer_index_at_slot,
     sign_block,
 )
+from .pool import AttestationPool
+from .scheduler import AttesterDuty, DutyScheduler, EpochDuties
 
 __all__ = [
+    "AttestationPool",
+    "AttesterDuty",
+    "DutyScheduler",
+    "EpochDuties",
+    "attestation_data_from_state",
     "build_aggregate_and_proof",
     "build_signed_block",
     "get_slot_signature",
     "is_aggregator",
+    "is_aggregator_hash",
     "make_attestation",
+    "proposer_index_at_slot",
     "sign_block",
 ]

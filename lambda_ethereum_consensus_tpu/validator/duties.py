@@ -161,17 +161,64 @@ def get_slot_signature(state, slot: int, secret_key: bytes, spec: ChainSpec) -> 
     return bls.sign(secret_key, misc.compute_signing_root_epoch(int(slot), domain))
 
 
+def is_aggregator_hash(selection_proof: bytes, committee_len: int) -> bool:
+    """The pure lottery: ``hash(proof)[:8] % max(1, len // TARGET) == 0``
+    (validator spec ``is_aggregator``).  Split out so the boundary cases
+    — modulo-1 committees (every member aggregates), the exact-threshold
+    digest — are testable without minting a committee-shaped state, and
+    so the duty scheduler can run the lottery straight off its derived
+    committee sizes."""
+    modulo = max(
+        1, int(committee_len) // constants.TARGET_AGGREGATORS_PER_COMMITTEE
+    )
+    digest = misc.hash_bytes(selection_proof)
+    return int.from_bytes(digest[:8], "little") % modulo == 0
+
+
 def is_aggregator(
     state, slot: int, committee_index: int, selection_proof: bytes, spec: ChainSpec
 ) -> bool:
     """Hash-of-proof lottery selecting ~TARGET_AGGREGATORS_PER_COMMITTEE
     members (validator spec)."""
     committee = accessors.get_beacon_committee(state, slot, committee_index, spec)
-    modulo = max(
-        1, len(committee) // constants.TARGET_AGGREGATORS_PER_COMMITTEE
+    return is_aggregator_hash(selection_proof, len(committee))
+
+
+def proposer_index_at_slot(state, slot: int, spec: ChainSpec | None = None) -> int:
+    """Proposer for any ``slot`` answerable by ``state`` WITHOUT
+    advancing it — one spec recipe: this simply names the accessor's
+    explicit-slot mode (equal to the plain accessor on a state advanced
+    to ``slot``, pinned in tests).  Mind the epoch-boundary caveat the
+    scheduler handles: effective balances weight the sampling, so
+    cross-boundary schedules want the epoch-advanced state."""
+    return accessors.get_beacon_proposer_index(state, spec, slot=int(slot))
+
+
+def attestation_data_from_state(
+    state,
+    slot: int,
+    committee_index: int,
+    head_root: bytes,
+    spec: ChainSpec | None = None,
+) -> AttestationData:
+    """Spec-correct ``AttestationData`` an honest validator signs at
+    ``slot`` given a head state: source = the state's current justified
+    checkpoint, target = the attestation epoch's boundary block (the
+    head itself when the state has not moved past the boundary)."""
+    spec = spec or get_chain_spec()
+    epoch = misc.compute_epoch_at_slot(int(slot), spec)
+    start = misc.compute_start_slot_at_epoch(epoch, spec)
+    if int(state.slot) <= start:
+        target_root = bytes(head_root)
+    else:
+        target_root = accessors.get_block_root_at_slot(state, start, spec)
+    return AttestationData(
+        slot=int(slot),
+        index=int(committee_index),
+        beacon_block_root=bytes(head_root),
+        source=state.current_justified_checkpoint,
+        target=Checkpoint(epoch=epoch, root=target_root),
     )
-    digest = misc.hash_bytes(selection_proof)
-    return int.from_bytes(digest[:8], "little") % modulo == 0
 
 
 def build_aggregate_and_proof(

@@ -1,0 +1,191 @@
+"""Validator-duty bench: signing throughput + duties met per epoch.
+
+One JSON metric line per measurement (bench.py's guarded subprocess
+contract).  Two inventory-gated metrics:
+
+- ``duty_signatures_per_sec`` — the headline: signatures through the
+  batched signing plane (ops/bls_sign.py) at its registered
+  ``duty_sign`` buckets.  On a TPU backend this is the AOT-cached
+  plane-layout G2 ladder; on CPU the shared-base comb fallback (the
+  committee-duty shape: ~40 signers per distinct message).
+- ``duties_met_per_epoch`` — a DutyScheduler operating ``--keys``
+  validators walks a full mainnet-spec epoch (every key attests once)
+  WHILE a gossip-shaped load drains through a real IngestScheduler on
+  the same process — attestation production, selection lottery, pooled
+  aggregation — and every attestation is judged against its broadcast
+  deadline (fired at 1/3 slot, due before aggregation opens at 2/3).
+  The value is duties that made their deadline; misses and aggregate
+  counts ride along.
+
+Usage: python scripts/bench_duties.py [--keys N] [--slots N]
+       [--sign-batch B] [--sign-total N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.config import (  # noqa: E402
+    mainnet_spec,
+    use_chain_spec,
+)
+from lambda_ethereum_consensus_tpu.crypto import bls  # noqa: E402
+from lambda_ethereum_consensus_tpu.ops.bls_sign import (  # noqa: E402
+    sign_batch,
+    warm_sign_programs,
+)
+from lambda_ethereum_consensus_tpu.telemetry import get_metrics  # noqa: E402
+
+DISTINCT_KEYS = 64  # key material does not change signing cost; minting does
+SIGNERS_PER_MESSAGE = 40  # a mainnet-ish committee share per distinct message
+
+
+def _emit(rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+
+
+def bench_signatures(batch: int, total: int) -> tuple[float, int]:
+    """Steady-state ``sign_batch`` rate at committee-shaped message
+    sharing; asserts one signature against the host oracle per run so a
+    broken plane can never post a throughput number."""
+    sks = [(i + 1).to_bytes(32, "big") for i in range(DISTINCT_KEYS)]
+    keys = [sks[i % DISTINCT_KEYS] for i in range(batch)]
+    msgs = [
+        b"duty-bench-%d" % (i // SIGNERS_PER_MESSAGE) for i in range(batch)
+    ]
+    warm_sign_programs(batch)
+    sigs = sign_batch(keys, msgs)  # warm tables / compile before timing
+    assert sigs[0] == bls.sign(keys[0], msgs[0]), "plane disagrees with oracle"
+    done = 0
+    t0 = time.perf_counter()
+    while done < total:
+        sign_batch(keys, msgs)
+        done += batch
+    return done / (time.perf_counter() - t0), done
+
+
+async def _gossip_load(stop: asyncio.Event) -> int:
+    """A background gossip-shaped feed through a real IngestScheduler —
+    the duty epoch below is measured under live ingest contention, not
+    on an idle process."""
+    from lambda_ethereum_consensus_tpu.pipeline import (
+        IngestScheduler,
+        LaneConfig,
+    )
+
+    sched = IngestScheduler(metrics=get_metrics())
+    sched.add_lane(LaneConfig(
+        name="aggregate", priority=0, weight=512, max_batch=512,
+        max_queue=8192, deadline_s=0.1, coalesce_target=64,
+    ))
+
+    class Sink:
+        processed = 0
+
+        async def process(self, items):
+            Sink.processed += len(items)
+            await asyncio.sleep(0.0005 + 5e-6 * len(items))
+
+        async def shed(self, item, reason: str = "overload"):
+            pass
+
+    sink = Sink()
+    sched.start()
+    seq = 0
+    try:
+        while not stop.is_set():
+            for _ in range(10):
+                for _src, item, reason in sched.submit(
+                    "aggregate", seq, sink
+                ):
+                    await sink.shed(item, reason)
+                seq += 1
+            await asyncio.sleep(0.01)
+    finally:
+        await sched.stop()
+    return Sink.processed
+
+
+def _duty_epoch(n_keys: int, n_slots: int) -> dict:
+    # the SAME walk the SLO gate's duty phase runs (validator/harness.py)
+    # — the bench and the gate cannot desynchronize on the timeline or
+    # the miss accounting
+    from lambda_ethereum_consensus_tpu.validator.harness import (
+        walk_duty_epoch,
+    )
+
+    return walk_duty_epoch(n_keys, n_slots, distinct_keys=DISTINCT_KEYS)
+
+
+async def bench_epoch(n_keys: int, n_slots: int) -> dict:
+    stop = asyncio.Event()
+    load = asyncio.ensure_future(_gossip_load(stop))
+    loop = asyncio.get_running_loop()
+    try:
+        result = await loop.run_in_executor(
+            None, _duty_epoch, n_keys, n_slots
+        )
+    finally:
+        stop.set()
+    result["gossip_items"] = await load
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--keys", type=int, default=4096,
+                    help="validator keys the epoch walk operates")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="slots to walk (default: the spec's full epoch)")
+    ap.add_argument("--sign-batch", type=int, default=1024,
+                    help="signatures per sign_batch call")
+    ap.add_argument("--sign-total", type=int, default=4096,
+                    help="total signatures for the throughput stage")
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    rate, done = bench_signatures(args.sign_batch, args.sign_total)
+    _emit({
+        "metric": "duty_signatures_per_sec",
+        "value": round(rate, 1),
+        "unit": "signatures/s",
+        "backend": backend,
+        "batch": args.sign_batch,
+        "signatures": done,
+        "signers_per_message": SIGNERS_PER_MESSAGE,
+    })
+
+    n_slots = args.slots
+    if n_slots is None:
+        with use_chain_spec(mainnet_spec()) as spec:
+            n_slots = spec.SLOTS_PER_EPOCH
+    result = asyncio.run(bench_epoch(args.keys, n_slots))
+    _emit({
+        "metric": "duties_met_per_epoch",
+        "value": result["attested"] - result["deadline_misses"],
+        "unit": "duties/epoch",
+        "keys": args.keys,
+        "slots": n_slots,
+        "attested": result["attested"],
+        "aggregated": result["aggregated"],
+        "deadline_misses": result["deadline_misses"],
+        "epoch_wall_s": round(result["wall_s"], 2),
+        "gossip_items_ingested": result["gossip_items"],
+        "note": "attestation duties making their 2/3-slot broadcast "
+                "deadline (fired at 1/3) while a gossip-shaped load "
+                "drains through the ingest scheduler",
+    })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,13 @@ synthetic metric injection:
 3. **Beacon API**: a real ``BeaconApiServer`` answering a burst of GETs
    (health/identity/metrics/debug routes) — fills
    ``api_request_seconds`` through the same dispatch the node serves.
+4. **Validator duties** (round 16): a ``DutyScheduler`` operating 10^3
+   (smoke) / 10^4 (full) keys walks epoch-0 slots — batched signing
+   through the real duty_sign plane, pooled aggregation, the proposer
+   path — CONCURRENTLY with phase 1's gossip-shaped ingest, judging
+   every attestation against its broadcast deadline (fired at 1/3
+   slot, due before aggregation opens at 2/3 — production must fit one
+   interval; one miss is a first-class violation, not a quantile blip).
 
 The gate never lets no_data read as green silently: every SLO the
 profile is declared to exercise (:data:`EXERCISED`) must produce
@@ -87,6 +94,8 @@ EXERCISED = frozenset({
     "api_request_p99",               # drive_api GET burst
     "block_transition_p95",          # drive_transitions mini-replay
     "witness_verify_p95",            # drive_witness batched multiproofs
+    "duty_sign_p95",                 # drive_duties batched signing
+    "duty_attest_deadline_p95",      # drive_duties per-slot deadlines
 })
 
 
@@ -268,6 +277,33 @@ def drive_witness(n_batches: int) -> int:
     return done
 
 
+def drive_duties(n_keys: int, n_slots: int) -> dict:
+    """The validator-duty phase (round 16): a DutyScheduler operating
+    ``n_keys`` on a mainnet-spec genesis walks ``n_slots`` of epoch 0 —
+    attestation production (batched signing through the REAL duty_sign
+    plane), selection lottery + pooled aggregation, and (at devnet
+    scale) the proposer path.  Runs CONCURRENTLY with the ingest phase
+    via ``drive_load`` — the acceptance shape is duties met while the
+    node ingests gossip.
+
+    Deadline judgment is virtual-instant (the scheduler's fired-at +
+    measured production elapsed), so the quantiles measure REAL signing
+    wall time against the real per-slot budget without real-time pacing
+    — the same discipline as ``replay_slot_phases``.  The walk itself is
+    ``validator.harness.walk_duty_epoch``, SHARED with
+    ``scripts/bench_duties.py`` so the gate and the bench can never
+    desynchronize on the timeline or the miss accounting."""
+    from lambda_ethereum_consensus_tpu.validator.harness import (
+        walk_duty_epoch,
+    )
+
+    # the proposer path at devnet scale only (a 10^4-registry block
+    # assembly is the replay bench's territory, not the gate's)
+    return walk_duty_epoch(
+        n_keys, n_slots, propose_at=1 if n_keys <= 2048 else None
+    )
+
+
 def replay_slot_phases(n_slots: int, seed: int) -> int:
     """The recorded arrival schedule: blocks landing a deterministic
     offset into their slot, head updates a bit later — replayed with
@@ -381,6 +417,12 @@ def main() -> int:
                     help="override one SLO's budget (repeatable)")
     ap.add_argument("--seed", type=int, default=12,
                     help="recorded-profile RNG seed")
+    ap.add_argument("--duties-keys", type=int, default=None,
+                    help="validator keys for the duty phase "
+                         "(default: 1024 smoke, 10240 full)")
+    ap.add_argument("--duties-slots", type=int, default=None,
+                    help="epoch-0 slots the duty phase walks "
+                         "(default: 4 smoke, 32 full = every key attests)")
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="also write the report to PATH")
     ap.add_argument("--list", action="store_true",
@@ -408,8 +450,27 @@ def main() -> int:
         {"block": 16, "aggregate": 1000, "subnet": 3000}
     )
 
+    duty_keys = args.duties_keys if args.duties_keys is not None else (
+        1024 if args.smoke else 10240
+    )
+    duty_slots = args.duties_slots if args.duties_slots is not None else (
+        4 if args.smoke else 32
+    )
+
+    async def drive_load():
+        """Ingest + duties CONCURRENTLY: the duty phase signs on an
+        executor thread while the scheduler drains gossip-shaped load
+        on the loop — deadline quantiles are measured under the same
+        contention a live attesting node ingests through."""
+        loop = asyncio.get_running_loop()
+        duty_fut = loop.run_in_executor(
+            None, drive_duties, duty_keys, duty_slots
+        )
+        pipe = await drive_pipeline(engine, duration, rates)
+        return pipe, await duty_fut
+
     t0 = time.monotonic()
-    load = asyncio.run(drive_pipeline(engine, duration, rates))
+    load, duties = asyncio.run(drive_load())
     slots = replay_slot_phases(8 if args.smoke else 64, args.seed)
     blocks = drive_transitions(9 if args.smoke else 17)
     witness_batches = drive_witness(24 if args.smoke else 60)
@@ -417,6 +478,25 @@ def main() -> int:
     served, api_failed = asyncio.run(drive_api(n_api))
 
     report = engine.evaluate()
+    if duties["deadline_misses"]:
+        # the duty acceptance is EVERY attestation deadline met, not a
+        # quantile: one missed slot is a first-class violation
+        report["violations"].append({
+            "slo": "duty_gate_deadlines",
+            "series": "duty_completion_offset_seconds",
+            "window": "cumulative",
+            "quantile": 1.0,
+            "observed": None,
+            "budget": 8.0,
+            "count": duties["attested"],
+            "reason": (
+                f"{duties['deadline_misses']} of {duties['attested']} "
+                f"attestation duties missed their broadcast deadline "
+                f"(fired at 1/3 slot, due by 2/3; "
+                f"{duties['keys']} keys, {duties['slots']} slots)"
+            ),
+        })
+        report["ok"] = False
     if api_failed:
         # a dead route answers its 500 fast — latency green, route
         # broken; availability failures are first-class violations
@@ -462,6 +542,7 @@ def main() -> int:
         "slots_replayed": slots,
         "blocks_transitioned": blocks,
         "witness_batches": witness_batches,
+        "duties": duties,
         "api_requests_ok": served,
         "api_requests_expected": n_api,
         "seed": args.seed,

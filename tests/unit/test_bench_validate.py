@@ -18,11 +18,13 @@ def test_required_metrics_honors_env_gates():
     assert "ssz_merkle_node_hashes_per_sec" in everything
     assert "aggregate_bls_verifications_per_sec" in everything
     assert "pipeline_overload_block_p95_ms" in everything
+    assert "duty_signatures_per_sec" in everything
     gated = bench.required_metrics(env={
         "BENCH_NO_MAINNET": "1", "BENCH_NO_INGEST": "1",
         "BENCH_NO_PLANES": "1", "BENCH_NO_PIPELINE": "1",
         "BENCH_NO_TELEMETRY": "1", "BENCH_NO_TRACE": "1",
         "BENCH_NO_SHARD": "1", "BENCH_NO_WITNESS": "1",
+        "BENCH_NO_DUTIES": "1",
     })
     # the ungated headline pair survives every knob
     assert set(gated) == {
@@ -211,7 +213,7 @@ def test_validate_cli_passes_on_covered_artifact(tmp_path):
     # narrow the required set to the two ungated metrics
     for knob in ("BENCH_NO_MAINNET", "BENCH_NO_INGEST", "BENCH_NO_PLANES",
                  "BENCH_NO_PIPELINE", "BENCH_NO_TELEMETRY", "BENCH_NO_TRACE",
-                 "BENCH_NO_SHARD", "BENCH_NO_WITNESS"):
+                 "BENCH_NO_SHARD", "BENCH_NO_WITNESS", "BENCH_NO_DUTIES"):
         env[knob] = "1"
     artifact = tmp_path / "BENCH_ok.json"
     artifact.write_text(

@@ -1,0 +1,361 @@
+"""Validator-duty plane (round 16): scheduler derivation, the pool,
+slot-phase deadline metrics, the proposer path, node-tick firing, and
+the duty SLO rows."""
+
+import time
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.config import (
+    constants,
+    minimal_spec,
+    use_chain_spec,
+)
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.fork_choice import get_forkchoice_store, on_tick
+from lambda_ethereum_consensus_tpu.state_transition import accessors, process_slots
+from lambda_ethereum_consensus_tpu.state_transition.genesis import (
+    build_genesis_state,
+)
+from lambda_ethereum_consensus_tpu.telemetry import get_metrics
+from lambda_ethereum_consensus_tpu.tracing import SlotClock
+from lambda_ethereum_consensus_tpu.types.beacon import (
+    Attestation,
+    AttestationData,
+    BeaconBlock,
+    BeaconBlockBody,
+    Checkpoint,
+)
+from lambda_ethereum_consensus_tpu.validator import (
+    AttestationPool,
+    DutyScheduler,
+    proposer_index_at_slot,
+)
+
+N = 64
+SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
+KEYMAP = {i: SKS[i] for i in range(N)}
+
+
+@pytest.fixture(scope="module")
+def chain():
+    with use_chain_spec(minimal_spec()) as spec:
+        genesis = build_genesis_state(
+            [bls.sk_to_pk(sk) for sk in SKS], spec=spec
+        )
+        header = genesis.latest_block_header.copy(
+            state_root=genesis.hash_tree_root(spec)
+        )
+        anchor = BeaconBlock(
+            slot=int(header.slot),
+            proposer_index=int(header.proposer_index),
+            parent_root=bytes(header.parent_root),
+            state_root=bytes(header.state_root),
+            body=BeaconBlockBody(),
+        )
+        yield genesis, anchor, spec
+
+
+# ------------------------------------------------------------- derivation
+
+
+def test_epoch_duties_cover_every_managed_key_exactly_once(chain):
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        sched = DutyScheduler(KEYMAP, spec)
+        duties = sched.duties_for_epoch(genesis, 0)
+        seen = {}
+        for slot, bucket in duties.attesters_by_slot.items():
+            for duty in bucket:
+                assert duty.slot == slot
+                assert duty.validator_index not in seen
+                seen[duty.validator_index] = duty
+        assert sorted(seen) == list(range(N))
+        # every duty's coordinates agree with the spec committee lookup
+        for duty in list(seen.values())[:8]:
+            committee = accessors.get_beacon_committee(
+                genesis, duty.slot, duty.committee_index, spec
+            )
+            assert committee[duty.committee_position] == duty.validator_index
+            assert len(committee) == duty.committee_size
+        # the proposer schedule covers the whole epoch
+        assert sorted(duties.proposers) == list(range(spec.SLOTS_PER_EPOCH))
+
+
+def test_partial_keymap_restricts_duties(chain):
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        managed = {3: SKS[3], 17: SKS[17], 999: b"\x01" * 32}  # 999 absent
+        sched = DutyScheduler(managed, spec)
+        duties = sched.duties_for_epoch(genesis, 0)
+        got = {
+            d.validator_index
+            for bucket in duties.attesters_by_slot.values()
+            for d in bucket
+        }
+        assert got == {3, 17}
+
+
+def test_proposer_index_at_slot_matches_advanced_state(chain):
+    """The slot-keyed proposer derivation equals the spec accessor on a
+    state actually advanced to that slot."""
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        for slot in (1, 2, 5):
+            advanced = process_slots(genesis, slot, spec)
+            assert proposer_index_at_slot(genesis, slot, spec) == (
+                accessors.get_beacon_proposer_index(advanced, spec)
+            )
+
+
+# -------------------------------------------------------------------- pool
+
+
+def _vote(data, size, pos, sig=None):
+    bits = [False] * size
+    bits[pos] = True
+    return Attestation(
+        aggregation_bits=bits, data=data,
+        # a decodable placeholder (the pool aggregates whatever it holds)
+        signature=bls.G2_POINT_AT_INFINITY if sig is None else sig,
+    )
+
+
+def test_pool_merges_votes_and_serves_committee_aggregate(chain):
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        pool = AttestationPool(spec)
+        committee = accessors.get_beacon_committee(genesis, 1, 0, spec)
+        data = AttestationData(
+            slot=1, index=0, beacon_block_root=b"\x05" * 32,
+            source=Checkpoint(), target=Checkpoint(epoch=0, root=b"\x06" * 32),
+        )
+        domain = accessors.get_domain(
+            genesis, constants.DOMAIN_BEACON_ATTESTER, 0, spec
+        )
+        from lambda_ethereum_consensus_tpu.state_transition import misc
+
+        root = misc.compute_signing_root(data, domain)
+        k = len(committee)
+        for pos in range(k):
+            assert pool.add_vote(_vote(
+                data, k, pos, bls.sign(SKS[committee[pos]], root)
+            ))
+        # duplicate positions are first-seen-wins
+        assert not pool.add_vote(_vote(data, k, 0))
+        agg = pool.aggregate_for(1, 0)
+        assert agg is not None and all(agg.aggregation_bits)
+        pks = [bls.sk_to_pk(SKS[v]) for v in committee]
+        assert bls.fast_aggregate_verify(pks, root, bytes(agg.signature))
+
+
+def test_pool_block_attestations_window_and_ordering(chain):
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        pool = AttestationPool(spec)
+
+        def data_at(slot, root):
+            return AttestationData(
+                slot=slot, index=0, beacon_block_root=root,
+                source=Checkpoint(), target=Checkpoint(epoch=0, root=root),
+            )
+
+        wide = data_at(1, b"\x01" * 32)
+        for pos in range(3):
+            pool.add_vote(_vote(wide, 4, pos))
+        narrow = data_at(1, b"\x02" * 32)
+        pool.add_vote(_vote(narrow, 4, 0))
+        same_slot = data_at(2, b"\x03" * 32)  # not yet includable at 2
+        pool.add_vote(_vote(same_slot, 4, 0))
+        got = pool.block_attestations(2)
+        roots = [bytes(a.data.beacon_block_root) for a in got]
+        assert roots == [b"\x01" * 32, b"\x02" * 32]  # widest first
+        assert pool.block_attestations(2, max_count=1)[0].data == wide
+        # a ready-made wider aggregate beats the vote-built one
+        agg = Attestation(
+            aggregation_bits=[True, True, False, False],
+            data=narrow, signature=b"\x02" * 96,
+        )
+        pool.add_aggregate(agg)
+        got = pool.block_attestations(2)
+        assert sum(got[1].aggregation_bits) == 2
+        # stale cells prune once the window closes
+        assert pool.prune(1 + spec.SLOTS_PER_EPOCH + 2) == 3
+        assert len(pool) == 0
+
+
+# ------------------------------------------------- deadlines and SLO rows
+
+
+def test_deadline_metrics_judge_fired_plus_elapsed(chain):
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        m = get_metrics()
+        clock = SlotClock(0, int(spec.SECONDS_PER_SLOT), 3)
+        sched = DutyScheduler(KEYMAP, spec, clock=clock)
+        head = b"\x08" * 32
+        base_prod = m.get("duties_produced_total", type="attest")
+        base_miss = m.get("duty_deadline_miss_total", type="attest")
+        # fired at the slot start: completion = elapsed, well inside the
+        # 2/3-slot broadcast boundary
+        votes = sched.produce_attestations(
+            genesis, 1, head, now=clock.slot_start(1)
+        )
+        assert votes
+        assert m.get("duties_produced_total", type="attest") - base_prod == len(votes)
+        assert m.get("duty_deadline_miss_total", type="attest") == base_miss
+        # fired PAST the deadline: every duty counts as a miss
+        sched2 = DutyScheduler(KEYMAP, spec, clock=clock)
+        late = clock.slot_start(2) + spec.SECONDS_PER_SLOT  # a full slot late
+        votes2 = sched2.produce_attestations(genesis, 2, head, now=late)
+        assert (
+            m.get("duty_deadline_miss_total", type="attest") - base_miss
+            == len(votes2)
+        )
+
+
+def test_duty_slo_rows_exist_and_are_driven(chain):
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        from lambda_ethereum_consensus_tpu.slo import DEFAULT_SLOS, SloEngine
+
+        names = {s.name for s in DEFAULT_SLOS}
+        assert {"duty_sign_p95", "duty_attest_deadline_p95"} <= names
+        clock = SlotClock(0, int(spec.SECONDS_PER_SLOT), 3)
+        sched = DutyScheduler(KEYMAP, spec, clock=clock)
+        sched.produce_attestations(
+            genesis, 3, b"\x09" * 32, now=clock.slot_start(3)
+        )
+        report = SloEngine().evaluate(emit=False, snapshot=False)
+        rows = {r["slo"]: r for r in report["slos"]}
+        for name in ("duty_sign_p95", "duty_attest_deadline_p95"):
+            assert rows[name]["count"] > 0, f"{name} not driven"
+            assert rows[name]["observed"] is not None
+
+
+def test_warmup_registers_duty_sign_buckets():
+    from lambda_ethereum_consensus_tpu.node.warmup import warm_duties
+    from lambda_ethereum_consensus_tpu.ops.aot import shape_buckets
+    from lambda_ethereum_consensus_tpu.ops.bls_sign import DEFAULT_SIGN_BUCKETS
+
+    dt = warm_duties()
+    assert isinstance(dt, float)
+    assert set(DEFAULT_SIGN_BUCKETS) <= set(shape_buckets("duty_sign"))
+
+
+# --------------------------------------------------------- node-tick firing
+
+
+def test_on_tick_fires_phases_against_store_head(chain):
+    """The node-facing surface: a store at its anchor, a clock deep
+    enough into slot 1 — one tick fires propose + attest + aggregate
+    exactly once, and a second tick at the same slot fires nothing."""
+    genesis, anchor, spec = chain
+    with use_chain_spec(spec):
+        store = get_forkchoice_store(genesis, anchor, spec)
+        # let the store's clock reach slot 1 so produced duties are timely
+        on_tick(store, store.genesis_time + spec.SECONDS_PER_SLOT, spec)
+        clock = SlotClock(int(store.genesis_time), int(spec.SECONDS_PER_SLOT), 3)
+        sched = DutyScheduler(KEYMAP, spec, clock=clock)
+        # 2/3 into slot 1: every phase due
+        now = clock.slot_start(1) + 2 * spec.SECONDS_PER_SLOT / 3 + 0.1
+        produced = sched.on_tick(store, now=now)
+        assert produced.get("attestations"), "attest phase must fire"
+        assert "committees_per_slot" in produced
+        assert produced.get("aggregates") is not None
+        assert produced.get("block") is not None, (
+            "every proposer is managed, so slot 1's block must build"
+        )
+        signed, _post = produced["block"]
+        assert int(signed.message.slot) == 1
+        again = sched.on_tick(store, now=now + 0.5)
+        assert not again, "phases fire once per slot"
+
+
+def test_node_config_carries_duty_keys():
+    from lambda_ethereum_consensus_tpu.node.node import NodeConfig
+
+    assert NodeConfig().duty_keys is None
+    cfg = NodeConfig(duty_keys=KEYMAP)
+    assert len(cfg.duty_keys) == N
+
+
+def test_cross_boundary_duties_read_the_advanced_state(chain):
+    """Across an epoch boundary the un-advanced head state still carries
+    the PRE-boundary justified checkpoint and effective balances; the
+    scheduler must sign the source (and derive the proposer schedule) an
+    epoch-advanced state answers, or the whole epoch's first votes are
+    un-includable.  Justification is made to actually move by minting
+    full target participation before the boundary."""
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        from lambda_ethereum_consensus_tpu.state_transition.mutable import (
+            BeaconStateMut,
+        )
+
+        flag = (
+            (1 << constants.TIMELY_SOURCE_FLAG_INDEX)
+            | (1 << constants.TIMELY_TARGET_FLAG_INDEX)
+        )
+        # last slot of epoch 2: the first boundary where justification
+        # may move (process_justification skips epochs <= GENESIS+1)
+        pre = process_slots(genesis, 3 * spec.SLOTS_PER_EPOCH - 1, spec)
+        ws = BeaconStateMut(pre)
+        for i in range(N):
+            ws.previous_epoch_participation[i] = flag
+            ws.current_epoch_participation[i] = flag
+        head_state = ws.freeze()
+        boundary = 3 * spec.SLOTS_PER_EPOCH
+        advanced = process_slots(head_state, boundary, spec)
+        assert (
+            advanced.current_justified_checkpoint
+            != head_state.current_justified_checkpoint
+        ), "premise: the boundary must move justification"
+
+        sched = DutyScheduler(KEYMAP, spec)
+        votes = sched.produce_attestations(head_state, boundary, b"\x0a" * 32)
+        assert votes, "boundary slot must carry managed duties"
+        assert votes[0].data.source == advanced.current_justified_checkpoint
+        duties = sched.duties_for_epoch(head_state, 3)
+        assert duties.proposers[boundary] == proposer_index_at_slot(
+            advanced, boundary, spec
+        )
+
+
+def test_produce_block_screens_unincludable_pooled_attestations(chain):
+    """One pooled attestation with a wrong source (the pool never
+    verifies) must cost its own inclusion, never the proposal: the
+    pre-state screen drops it and the block still builds and applies."""
+    genesis, _anchor, spec = chain
+    with use_chain_spec(spec):
+        from lambda_ethereum_consensus_tpu.state_transition.core import (
+            state_transition,
+        )
+
+        sched = DutyScheduler(KEYMAP, spec)
+        head = genesis.latest_block_header.copy(
+            state_root=genesis.hash_tree_root(spec)
+        ).hash_tree_root(spec)
+        good = sched.produce_attestations(genesis, 1, head)
+        assert good
+        bad = Attestation(
+            aggregation_bits=[True] + [False] * 3,
+            data=AttestationData(
+                slot=1, index=0, beacon_block_root=head,
+                source=Checkpoint(epoch=5, root=b"\x66" * 32),  # bogus
+                target=Checkpoint(epoch=0, root=head),
+            ),
+            signature=bls.G2_POINT_AT_INFINITY,
+        )
+        sched.pool.add_aggregate(bad)
+        produced = sched.produce_block(genesis, 2)
+        assert produced is not None, "the bad candidate must not forfeit the slot"
+        signed, _post = produced
+        sources = {
+            (int(a.data.source.epoch), bytes(a.data.source.root))
+            for a in signed.message.body.attestations
+        }
+        assert (5, b"\x66" * 32) not in sources, "screen must drop the bad source"
+        assert signed.message.body.attestations, "good votes still included"
+        # and the screened block passes full validation
+        state_transition(genesis, signed, validate_result=True, spec=spec)

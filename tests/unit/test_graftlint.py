@@ -531,6 +531,66 @@ def test_retrace_hazard_passes_with_witness_bucket_snap(tmp_path):
     assert findings == []
 
 
+def test_retrace_hazard_fires_on_unsnapped_duty_sign_batch(tmp_path):
+    """The duty_sign bucket discipline (round 16): feeding the batched
+    signing plane scalar-bit arrays built straight from a variable-length
+    duty list — no snap/pad in scope — would trace a fresh program per
+    committee size mid-slot."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def _ladder(kbits):
+                return kbits
+
+            sign_kernel = jax.jit(_ladder)
+
+            def sign_batch(scalar_bits):
+                return sign_kernel(jnp.asarray(scalar_bits))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert len(findings) == 1 and "variable-length" in findings[0].message
+
+
+def test_retrace_hazard_passes_with_duty_sign_bucket_snap(tmp_path):
+    """The shipped discipline (ops/bls_sign.py): the batch snaps to the
+    registered duty_sign shape buckets and pads before the jitted plane
+    ladder sees it."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def shape_buckets(kind):
+                return (256, 1024)
+
+            def _ladder(kbits):
+                return kbits
+
+            sign_kernel = jax.jit(_ladder)
+
+            def sign_batch(scalar_bits):
+                batch = None
+                for b in shape_buckets("duty_sign"):
+                    if len(scalar_bits) <= b:
+                        batch = b
+                        break
+                padded = list(scalar_bits) + [0] * (batch - len(scalar_bits))
+                return sign_kernel(jnp.asarray(padded))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert findings == []
+
+
 def test_retrace_hazard_fires_on_use_after_donate(tmp_path):
     findings = lint_sources(
         tmp_path,

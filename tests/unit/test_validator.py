@@ -1,4 +1,5 @@
-"""Validator duties: aggregator selection, aggregate-and-proof, SSZ wire."""
+"""Validator duties: aggregator selection, aggregate-and-proof, SSZ wire,
+and the round-16 batched signing plane's bit-exactness contract."""
 
 import pytest
 
@@ -17,6 +18,7 @@ from lambda_ethereum_consensus_tpu.validator import (
     build_aggregate_and_proof,
     get_slot_signature,
     is_aggregator,
+    is_aggregator_hash,
     make_attestation,
 )
 
@@ -94,3 +96,206 @@ def test_attestation_signature_valid_for_committee(setup):
         domain = accessors.get_domain(state, constants.DOMAIN_BEACON_ATTESTER, 0, spec)
         root = misc.compute_signing_root(att.data, domain)
         assert bls.fast_aggregate_verify(pubkeys, root, bytes(att.signature))
+
+
+# ------------------------------------------------- aggregator lottery math
+
+
+def test_is_aggregator_modulo_one_committee():
+    """Any committee below TARGET_AGGREGATORS_PER_COMMITTEE (and exactly
+    at it: len // TARGET == 1) has modulo 1 — every member aggregates,
+    whatever the proof hashes to."""
+    for committee_len in (1, 3, constants.TARGET_AGGREGATORS_PER_COMMITTEE,
+                          2 * constants.TARGET_AGGREGATORS_PER_COMMITTEE - 1):
+        for i in range(16):
+            assert is_aggregator_hash(b"proof-%d" % i, committee_len)
+
+
+def test_is_aggregator_exact_threshold_hash():
+    """At modulo 2 (committee of 2*TARGET) selection is exactly the
+    parity of the digest's little-endian first 8 bytes — pin both sides
+    of the threshold and the exact spec formula."""
+    committee_len = 2 * constants.TARGET_AGGREGATORS_PER_COMMITTEE
+    selected = rejected = 0
+    for i in range(64):
+        proof = b"threshold-%d" % i
+        lottery = int.from_bytes(misc.hash_bytes(proof)[:8], "little")
+        want = lottery % 2 == 0
+        assert is_aggregator_hash(proof, committee_len) is want
+        selected += want
+        rejected += not want
+    assert selected and rejected  # both branches actually exercised
+
+
+def test_is_aggregator_state_path_matches_pure_lottery(setup):
+    state, spec = setup
+    with use_chain_spec(spec):
+        committee = accessors.get_beacon_committee(state, 1, 0, spec)
+        proof = get_slot_signature(state, 1, SKS[committee[0]], spec)
+        assert is_aggregator(state, 1, 0, proof, spec) is (
+            is_aggregator_hash(proof, len(committee))
+        )
+
+
+# ------------------------------------------- scheduler AAP -> verify plane
+
+
+def test_aggregate_and_proof_roundtrip_through_verify_plane(setup):
+    """A scheduler-produced SignedAggregateAndProof checked end to end
+    through the REAL batched verify plane (crypto.bls.batch_verify, the
+    RLC chain the gossip drain runs): wrapper signature, selection
+    proof, and the aggregate itself — then tampered copies must fail."""
+    state, spec = setup
+    with use_chain_spec(spec):
+        from lambda_ethereum_consensus_tpu.validator import DutyScheduler
+
+        frozen = state.freeze()
+        sched = DutyScheduler({i: SKS[i] for i in range(N)}, spec)
+        head = b"\x07" * 32
+        votes = sched.produce_attestations(frozen, 1, head)
+        assert votes, "managed keys must have slot-1 duties"
+        aggs = sched.produce_aggregates(frozen, 1)
+        assert aggs, "minimal committees make every member an aggregator"
+        signed = aggs[0]
+        agg = signed.message.aggregate
+        committee = accessors.get_beacon_committee(
+            frozen, int(agg.data.slot), int(agg.data.index), spec
+        )
+        attesters = [
+            committee[i] for i, b in enumerate(agg.aggregation_bits) if b
+        ]
+        assert attesters, "pool aggregate must carry the produced votes"
+
+        wrapper_domain = accessors.get_domain(
+            frozen, constants.DOMAIN_AGGREGATE_AND_PROOF, 0, spec
+        )
+        sel_domain = accessors.get_domain(
+            frozen, constants.DOMAIN_SELECTION_PROOF, 0, spec
+        )
+        att_domain = accessors.get_domain(
+            frozen, constants.DOMAIN_BEACON_ATTESTER, 0, spec
+        )
+        agg_pk = bls.eth_aggregate_pubkeys(
+            [bls.sk_to_pk(SKS[v]) for v in attesters]
+        )
+        items = [
+            (
+                bls.sk_to_pk(SKS[int(signed.message.aggregator_index)]),
+                misc.compute_signing_root(signed.message, wrapper_domain),
+                bytes(signed.signature),
+            ),
+            (
+                bls.sk_to_pk(SKS[int(signed.message.aggregator_index)]),
+                misc.compute_signing_root_epoch(1, sel_domain),
+                bytes(signed.message.selection_proof),
+            ),
+            (
+                agg_pk,
+                misc.compute_signing_root(agg.data, att_domain),
+                bytes(agg.signature),
+            ),
+        ]
+        assert bls.batch_verify(items)
+        # wire round-trip survives the plane check too
+        back = SignedAggregateAndProof.decode(signed.encode(spec), spec)
+        assert back.hash_tree_root(spec) == signed.hash_tree_root(spec)
+        # tamper each leg: the batch must reject
+        for i in range(3):
+            forged = list(items)
+            pk, msg, _sig = forged[i]
+            forged[i] = (pk, msg, bls.sign(SKS[0], b"not-this-message"))
+            assert not bls.batch_verify(forged)
+
+
+# --------------------------------------- device-vs-host sign bit-exactness
+
+
+def _tiny_sign_buckets(monkeypatch):
+    """Pin the duty_sign bucket registry to tiny test buckets so the
+    eager interpret ladder exercises the identical snap/pad/chunk logic
+    without 256-lane padded batches."""
+    from lambda_ethereum_consensus_tpu.ops import aot
+
+    monkeypatch.setitem(aot._SHAPE_BUCKETS, "duty_sign", {4, 8})
+
+
+def test_sign_batch_device_bitexact_across_shapes(monkeypatch):
+    """The device signing plane vs the host bls.sign oracle across three
+    batch shapes — sub-bucket (3 -> pad to 4), exact bucket (8), and a
+    chunked ragged tail (11 = 8 + pad-to-4) — valid and tampered keys
+    alike.  Reduced-width scalars keep the eager CPU ladder test-sized;
+    the full-width pin lives in the device lane."""
+    _tiny_sign_buckets(monkeypatch)
+    from lambda_ethereum_consensus_tpu.ops.bls_sign import sign_batch
+    from lambda_ethereum_consensus_tpu.telemetry import get_metrics
+
+    device_count0 = get_metrics().get("duty_signatures_total", path="device")
+    sks = [(i + 3).to_bytes(32, "big") for i in range(11)]
+    tampered = bytearray(sks[1])
+    tampered[-2] ^= 0x01  # bit-flip (+256): still in (0, R) and < 2^16
+    sks[1] = bytes(tampered)
+    msgs = [b"duty-shape-%d" % (i % 3) for i in range(11)]
+    for shape in (3, 8, 11):
+        got = sign_batch(sks[:shape], msgs[:shape], device=True, nbits=16)
+        want = [bls.sign(sk, m) for sk, m in zip(sks[:shape], msgs[:shape])]
+        assert got == want, f"device plane diverged at batch {shape}"
+    # the device plane must have ACTUALLY run (a raising dispatch falls
+    # back to host silently, which would make this test compare the
+    # oracle against itself — the round-16 review caught exactly that)
+    assert (
+        get_metrics().get("duty_signatures_total", path="device")
+        - device_count0
+        == 3 + 8 + 11
+    ), "device path did not execute; test would be vacuous"
+    # the tampered key's signature is bit-exact on both paths AND wrong
+    # for the original key's pubkey
+    orig_pk = bls.sk_to_pk((1 + 3).to_bytes(32, "big"))
+    assert not bls.verify(orig_pk, msgs[1], got[1])
+
+
+def test_sign_batch_host_comb_bitexact_full_width():
+    """The shared-base comb at full 255-bit scalars vs the oracle —
+    including two signers sharing one message (the committee shape that
+    triggers the table path)."""
+    from lambda_ethereum_consensus_tpu.ops.bls_sign import sign_batch
+
+    sks = [
+        int.to_bytes((0x1234567890ABCDEF << (8 * i)) + i + 1, 32, "big")
+        for i in range(5)
+    ]
+    msgs = [b"comb-shared", b"comb-shared", b"comb-shared", b"comb-x", b"comb-y"]
+    got = sign_batch(sks, msgs, device=False)
+    assert got == [bls.sign(sk, m) for sk, m in zip(sks, msgs)]
+
+
+def test_sign_batch_rejects_invalid_keys_like_the_oracle():
+    from lambda_ethereum_consensus_tpu.crypto.bls.api import BlsError
+    from lambda_ethereum_consensus_tpu.crypto.bls.fields import R
+    from lambda_ethereum_consensus_tpu.ops.bls_sign import sign_batch
+
+    for bad in (b"\x00" * 32, R.to_bytes(32, "big"), b"\x01" * 31):
+        with pytest.raises(BlsError):
+            sign_batch([bad], [b"m"], device=False)
+        with pytest.raises(BlsError):
+            bls.sign(bad, b"m")
+    with pytest.raises(BlsError):
+        sign_batch([b"\x01" * 32], [b"a", b"b"], device=False)
+    # a non-byte-multiple ladder width is a caller error, loudly — not
+    # a silent device-fault fallback (the review-round vacuity bug)
+    with pytest.raises(BlsError):
+        sign_batch([b"\x01" * 32], [b"a"], device=True, nbits=12)
+
+
+@pytest.mark.device
+@pytest.mark.slow
+def test_sign_batch_device_bitexact_full_width():
+    """Full-width scalars through the plane ladder (device lane: the
+    eager 255-step walk is minutes-scale on CPU)."""
+    from lambda_ethereum_consensus_tpu.ops.bls_sign import sign_batch
+
+    sks = [(0xDEADBEEF << (i * 16) | (i + 1)).to_bytes(32, "big")[-32:]
+           for i in range(2)]
+    msgs = [b"full-width", b"full-width"]
+    assert sign_batch(sks, msgs, device=True) == [
+        bls.sign(sk, m) for sk, m in zip(sks, msgs)
+    ]
