@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench bench-validate slo-smoke duties-gate replay-smoke lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate slo-smoke serve-gate duties-gate replay-smoke lint clean
 
 all: native
 
@@ -34,13 +34,23 @@ lint:
 test: native
 	python -m pytest tests/ -q -m "not spectest and not device"
 	python -m pytest tests/unit/test_shard_plane.py -q
-	python scripts/slo_check.py --smoke
+	$(MAKE) serve-gate
 
 # The SLO budget gate alone (round 12): a recorded load profile through
 # the real ingest pipeline + API, evaluated against slo.DEFAULT_SLOS —
 # exits nonzero with a structured violation report on any budget miss.
 slo-smoke:
 	python scripts/slo_check.py --smoke
+
+# The serving gate (round 17): the smoke SLO profile PLUS the serving
+# phase — >=10k dispatches/s of mixed GET/witness traffic (response
+# caches hot, witness verifies coalescing across requests to a mean
+# device batch >= 32) sustained concurrently with the gossip-ingest
+# phase, with api_request_p99 and the admit->apply p95 budgets holding.
+# `make test` runs this as its SLO leg (a superset of slo-smoke); the
+# pass report is recorded to SERVE_GATE.json.
+serve-gate:
+	python scripts/slo_check.py --smoke --serve --json SERVE_GATE.json
 
 # The 10k-key duty deadline gate (round 16): every attestation duty of
 # a full mainnet-spec epoch (10,240 keys, 32 slots) fired at 1/3 slot

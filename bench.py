@@ -20,8 +20,8 @@ tunnel hung the whole run at rc=124 with zero evidence):
   (``BENCH_TOTAL_BUDGET_S``, default 7000 s): nominal budgets are SSZ
   600 + mainnet 1500 + ingest 1500 + boot 600 + registry-planes 300 +
   telemetry 120 + pipeline 120 + trace 60 + sharded mesh 900 +
-  witness 300 + duties 300 + BLS 2x1200, and when elapsed time eats a
-  later stage's slice the stage
+  witness 300 + duties 300 + api 120 + BLS 2x1200, and when elapsed
+  time eats a later stage's slice the stage
   shrinks (or is skipped with a ``truncated: true`` absence record)
   instead of letting the SUM blow past the outer timeout — the
   BENCH_r05 zero-record failure mode;
@@ -116,6 +116,11 @@ _STAGE_METRICS: tuple[tuple[str | None, tuple[str, ...]], ...] = (
     ("BENCH_NO_DUTIES", (
         "duty_signatures_per_sec",
         "duties_met_per_epoch",
+    )),
+    ("BENCH_NO_API", (
+        "api_requests_per_sec",
+        "api_cache_hit_ratio",
+        "api_coalesce_mean_batch",
     )),
     (None, ("aggregate_bls_verifications_per_sec",)),
 )
@@ -797,6 +802,21 @@ def main() -> None:
             float(os.environ.get("BENCH_DUTIES_BUDGET_S", "300")),
             units={"duty_signatures_per_sec": "signatures/s",
                    "duties_met_per_epoch": "duties/epoch"},
+        ):
+            _emit(rec)
+
+    if not os.environ.get("BENCH_NO_API"):
+        # serving plane (round 17): mixed GET/witness dispatches/s
+        # through the response cache + cross-request verify coalescer
+        # (the serve gate's own harness, longer steady-state window)
+        for rec in _bench_script(
+            "bench_api.py",
+            ("api_requests_per_sec", "api_cache_hit_ratio",
+             "api_coalesce_mean_batch"),
+            float(os.environ.get("BENCH_API_BUDGET_S", "120")),
+            units={"api_requests_per_sec": "req/s",
+                   "api_cache_hit_ratio": "fraction",
+                   "api_coalesce_mean_batch": "proofs/flush"},
         ):
             _emit(rec)
 

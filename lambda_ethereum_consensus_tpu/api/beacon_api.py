@@ -62,10 +62,30 @@ from typing import Callable
 
 from ..config import ChainSpec
 from ..fork_choice import Store, get_head
+from ..serve_cache import ServeCache
 from ..telemetry import get_metrics, scrape_stats_lines
 from ..tracing import SlotClock, get_recorder
+from ..utils.env import env_flag
 
 log = logging.getLogger("beacon_api")
+
+
+def _serve_cache_entries() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("SERVE_CACHE_ENTRIES", "2048"))
+    except ValueError:
+        return 2048
+
+
+def _serve_cache_bytes() -> int:
+    import os
+
+    try:
+        return int(float(os.environ.get("SERVE_CACHE_MB", "64")) * (1 << 20))
+    except ValueError:
+        return 64 << 20
 
 
 class BeaconApiServer:
@@ -103,6 +123,23 @@ class BeaconApiServer:
         # per-state multiproof planners (lambda_ethereum_consensus_tpu.
         # witness), created lazily on the first witness request
         self._witness = None
+        # round-17 serving plane: the response cache holds fully encoded
+        # answers for the hot GET routes keyed by RESOLVED root (+ route
+        # discriminators); the head-transition observer evicts the stale
+        # head's entries on a reorg (see serve_cache.py module doc).
+        # SERVE_NO_CACHE=1 reverts to round-15 encode-per-GET behavior.
+        self._serve_cache = (
+            None
+            if env_flag("SERVE_NO_CACHE")
+            else ServeCache(
+                "response",
+                capacity=_serve_cache_entries(),
+                max_bytes=_serve_cache_bytes(),
+            )
+        )
+        # cross-request verify coalescer (witness/coalesce.py), created
+        # lazily with the witness subsystem
+        self._coalescer = None
 
     # Routes answered ON the event loop (derived from _inline_routes in
     # __init__ — the patterns are literal paths): trivially cheap, and
@@ -337,6 +374,12 @@ class BeaconApiServer:
 
     def _resolve_block_root(self, block_id: str) -> bytes:
         if block_id == "head":
+            # get_head is memoized on (store.mutations, slot): GET-rate
+            # resolution is an O(1) memo hit between store mutations,
+            # WITH proposer boost and the viable-branch filter applied —
+            # the streamed HeadCache deliberately omits both (its class
+            # contract scopes it to telemetry/logging), so serving from
+            # it could answer a different head than the node attests on
             return get_head(self.store, self.spec)
         if block_id == "finalized":
             return bytes(self.store.finalized_checkpoint.root)
@@ -357,21 +400,83 @@ class BeaconApiServer:
             raise KeyError(block_id)
         raise ValueError(f"invalid block id {block_id!r}")
 
+    # ------------------------------------------------------- serving cache
+
+    def _cached_answer(self, kind: str, root: bytes, extra, build):
+        """The response-cache read path for one resolved root: a hit is
+        a memcpy of the stored ``(status, ctype, payload)`` triple —
+        no re-resolve, no re-encode; a miss runs ``build()`` once and
+        retains it tagged with the block's epoch (the eviction
+        discipline's age axis) and the resolved root (the invalidation
+        axis the head-transition observer evicts by)."""
+        cache = self._serve_cache
+        if cache is None:
+            return build()
+        key = (kind, root, extra)
+        hit = cache.get(key, kind=kind)
+        if hit is not None:
+            return hit
+        answer = build()
+        block = self.store.blocks.get(root) if self.store is not None else None
+        epoch = (
+            int(block.slot) // int(self.spec.SLOTS_PER_EPOCH)
+            if block is not None and self.spec is not None
+            else 0
+        )
+        return cache.put(
+            key, answer, root=root, epoch=epoch, nbytes=len(answer[2])
+        )
+
+    def on_head_transition(self, old_head: bytes | None, new_head: bytes) -> None:
+        """Round-9 observer hook (node._observe_head_transition): the
+        moment the cached fork-choice head flips, evict the STALE head's
+        cached encodings from the response cache and the witness
+        service's proof cache — an attestation-weight reorg must never
+        leave a dead branch's answers pinned, and the next GET for an
+        alias must rebuild fresh under the new resolved root."""
+        if old_head is None or old_head == new_head:
+            return
+        if self._serve_cache is not None:
+            self._serve_cache.invalidate_root(old_head, reason="head_transition")
+        witness = self._witness
+        if witness is not None:
+            witness.invalidate_root(old_head, reason="head_transition")
+
     # --------------------------------------------------------------- routes
 
     def _state_root(self, state_id: str) -> tuple[str, str, bytes]:
         root = self._resolve_block_root(state_id)
-        state = self.store.block_states[root]
-        return self._json(
-            {"data": {"root": "0x" + state.hash_tree_root(self.spec).hex()}}
-        )
+
+        def build():
+            state = self.store.block_states[root]
+            return self._json(
+                {"data": {"root": "0x" + state.hash_tree_root(self.spec).hex()}}
+            )
+
+        return self._cached_answer("state_root", root, None, build)
 
     def _block_root(self, block_id: str) -> tuple[str, str, bytes]:
         root = self._resolve_block_root(block_id)
-        return self._json({"data": {"root": "0x" + root.hex()}})
+        return self._cached_answer(
+            "block_root",
+            root,
+            None,
+            lambda: self._json({"data": {"root": "0x" + root.hex()}}),
+        )
 
     def _block_v2(self, block_id: str) -> tuple[str, str, bytes]:
         root = self._resolve_block_root(block_id)
+        # the ``finalized`` bit depends on the finalized checkpoint, so
+        # the cache key carries it: finality advancing re-keys the entry
+        # instead of serving a stale bit
+        return self._cached_answer(
+            "block_v2",
+            root,
+            bytes(self.store.finalized_checkpoint.root),
+            lambda: self._block_v2_build(root),
+        )
+
+    def _block_v2_build(self, root: bytes) -> tuple[str, str, bytes]:
         block = self.store.blocks[root]
         return self._json(
             {
@@ -414,6 +519,17 @@ class BeaconApiServer:
             self._witness = WitnessService()
         return self._witness
 
+    def _verify_coalescer(self):
+        """Lazy per-server verify coalescer (or None when
+        ``WITNESS_NO_COALESCE`` opts back into verify-per-request)."""
+        if self._coalescer is None:
+            from ..witness.coalesce import VerifyCoalescer, coalesce_enabled
+
+            if not coalesce_enabled():
+                return None
+            self._coalescer = VerifyCoalescer()
+        return self._coalescer
+
     def _witness_proof(self, state_id: str, query: str = "") -> tuple[str, str, bytes]:
         """``GET /eth/v0/witness/{state_id}?indices=field:idx,...`` —
         a deduplicated binary-Merkle multiproof for arbitrary element
@@ -437,23 +553,28 @@ class BeaconApiServer:
             requests.append((field, int(idx)))
         if not requests:
             raise ValueError("indices query parameter is required")
-        root = self._resolve_block_root(state_id)
-        state = self.store.block_states[root]
-        proof = self._witness_service().prove(root, state, requests, self.spec)
         fmt = params.get("format", "json")
-        if fmt == "ssz":
-            payload = proof.encode()
-            answer = ("200 OK", "application/octet-stream", payload)
-        elif fmt == "json":
-            payload = json.dumps({"data": proof.to_json()}).encode()
-            answer = ("200 OK", "application/json", payload)
-        else:
+        if fmt not in ("json", "ssz"):
             raise ValueError(f"unknown format {fmt!r} (json|ssz)")
+        root = self._resolve_block_root(state_id)
+
+        def build():
+            state = self.store.block_states[root]
+            proof = self._witness_service().prove(
+                root, state, requests, self.spec
+            )
+            if fmt == "ssz":
+                return "200 OK", "application/octet-stream", proof.encode()
+            return self._json({"data": proof.to_json()})
+
+        answer = self._cached_answer(
+            "witness", root, (tuple(requests), fmt), build
+        )
         m = get_metrics()
         m.observe(
             "witness_request_seconds", time.perf_counter() - t0, route="proof"
         )
-        m.inc("witness_proof_bytes_total", len(payload))
+        m.inc("witness_proof_bytes_total", len(answer[2]))
         return answer
 
     def _witness_verify(self, body: bytes, ctype: str) -> tuple[str, str, bytes]:
@@ -493,7 +614,16 @@ class BeaconApiServer:
         else:
             expected = [p.state_root for p in proofs]
             anchored = False
-        results = verify_batch(proofs, expected)
+        coalescer = self._verify_coalescer()
+        if coalescer is not None:
+            # cross-request coalescing (round 17): park with every other
+            # in-flight verify so the {64,256} buckets fill from
+            # DIFFERENT requests before one device dispatch; this
+            # request's verdicts come back demuxed, and a lone request
+            # flushes at its deadline budget (witness/coalesce.py)
+            results = coalescer.verify(proofs, expected)
+        else:
+            results = verify_batch(proofs, expected)
         get_metrics().observe(
             "witness_request_seconds", time.perf_counter() - t0, route="verify"
         )

@@ -702,7 +702,15 @@ class BeaconNode:
         if head_block is None:
             return
         first = self._head_root is None
+        prev = self._head_root
         self._head_root = head
+        # serving-plane invalidation (round 17): the response/proof
+        # caches key hot entries by resolved head root — evict the STALE
+        # head's encodings the moment the head flips, so a reorg (weight
+        # flip, proposer-boost expiry, checkpoint move) never leaves a
+        # dead branch's answers pinned in the serving plane
+        if self.api is not None and prev is not None:
+            self.api.on_head_transition(prev, head)
         if first:
             # adopting the anchor at boot is not a head UPDATE: the
             # anchor's age (minutes on a devnet, hours after checkpoint

@@ -1,0 +1,225 @@
+"""Head-root/epoch-keyed serving caches (round 17).
+
+The serving plane's read path answers the same few questions at very
+different costs: a state root is seconds of Merkleization on a cold
+engine, a witness multiproof is a plan + a SHA-256 pass, a block
+envelope is a JSON encode — and at light-client scale every one of them
+repeats thousands of times per head.  :class:`ServeCache` is the one
+bounded container behind both layers of the round-17 serving plane:
+
+- the **response cache** in :mod:`api.beacon_api` holds fully encoded
+  ``(status, content-type, payload)`` answers keyed by the RESOLVED
+  block root (plus route-specific discriminators such as the leaf-index
+  set, the encoding format, or the finalized-checkpoint root the
+  ``finalized`` bit depends on), so a cache hit is a memcpy of bytes
+  that never touches SSZ, JSON, or the witness planner again;
+- the **witness-proof cache** in :mod:`witness.service` holds
+  :class:`~witness.multiproof.WitnessProof` objects keyed by
+  ``(block root, requested leaf set)`` so hot leaf sets skip the
+  re-plan + re-hash even across output formats.
+
+Keying discipline: every key carries the CONCRETE resolved root —
+``head``/``justified``/``finalized`` aliases are resolved per request
+through the real consensus path (``get_head``, whose
+``(store.mutations, slot)`` memo makes the warm read O(1) while keeping
+proposer boost and the viable-branch filter — the streamed
+:class:`~fork_choice.tree.HeadCache` deliberately omits both, so
+serving from it could answer a different head than the node attests
+on) before the lookup, so a reorg changes the key and can never read a
+stale head's entry.  The
+round-9 head-transition observer (``node._observe_head_transition``)
+additionally EVICTS the stale head's entries the moment the cached head
+flips (:meth:`ServeCache.invalidate_root`): correctness comes from the
+key, memory honesty and the invalidation contract from the observer.
+
+Eviction reuses the round-6 epoch-LRU discipline
+(``fork_choice/attestation._evict_oldest_epoch``): overflow — by entry
+count or by accounted payload bytes — evicts from the OLDEST epoch
+present first, least-recently-used within that epoch, so a burst of
+historical-state traffic can never wash the hot head's encodings out of
+a full cache.
+
+Every instance reports the ``serve_cache_*`` metric families
+(hit/miss/eviction/invalidation counters plus entry/byte gauges),
+labeled ``cache=<name>`` so the response and proof layers chart
+separately on the round-17 Grafana panels.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+from .telemetry import get_metrics
+
+__all__ = ["ServeCache"]
+
+
+@dataclass
+class _Entry:
+    value: object
+    root: bytes
+    epoch: int
+    nbytes: int
+
+
+class ServeCache:
+    """Thread-safe bounded cache with epoch-LRU eviction and root-keyed
+    invalidation.  ``get``/``put`` run on API worker threads concurrently
+    with the node loop's ``invalidate_root`` — one lock guards all maps
+    (pure dict bookkeeping inside; nothing blocking is ever held under
+    it)."""
+
+    def __init__(
+        self,
+        name: str,
+        capacity: int = 2048,
+        max_bytes: int = 64 << 20,
+        metrics=None,
+    ):
+        self.name = name
+        self.capacity = max(1, int(capacity))
+        self.max_bytes = int(max_bytes)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._entries: dict = {}  # key -> _Entry (recency lives per-epoch)
+        # secondary indexes: per-root key set (O(keys-of-root)
+        # invalidation) and per-epoch recency (oldest-epoch-first
+        # eviction, LRU within the epoch — the round-6 discipline; the
+        # ONLY ordering eviction consults, so the main map stays a
+        # plain dict with no hit-path reordering)
+        self._by_root: dict[bytes, set] = {}
+        self._by_epoch: dict[int, OrderedDict] = {}
+        self._bytes = 0
+
+    # ------------------------------------------------------------ plumbing
+
+    @property
+    def metrics(self):
+        return self._metrics if self._metrics is not None else get_metrics()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "roots": len(self._by_root),
+                "epochs": sorted(self._by_epoch),
+            }
+
+    def _unlink(self, key) -> "_Entry":
+        """Drop one entry from every index (caller holds the lock)."""
+        entry = self._entries.pop(key)
+        self._bytes -= entry.nbytes
+        keys = self._by_root.get(entry.root)
+        if keys is not None:
+            keys.discard(key)
+            if not keys:
+                del self._by_root[entry.root]
+        epoch_keys = self._by_epoch.get(entry.epoch)
+        if epoch_keys is not None:
+            epoch_keys.pop(key, None)
+            if not epoch_keys:
+                del self._by_epoch[entry.epoch]
+        return entry
+
+    def _publish_gauges(self) -> None:
+        m = self.metrics
+        m.set_gauge("serve_cache_entries", len(self._entries), cache=self.name)
+        m.set_gauge("serve_cache_bytes", self._bytes, cache=self.name)
+
+    # ------------------------------------------------------------- surface
+
+    def get(self, key, kind: str = "value"):
+        """The cached value, or ``None`` — counting the hit/miss under
+        ``kind`` (the route family on the response layer)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                epoch_keys = self._by_epoch.get(entry.epoch)
+                if epoch_keys is not None and key in epoch_keys:
+                    epoch_keys.move_to_end(key)
+                value = entry.value
+            else:
+                value = None
+        m = self.metrics
+        if value is not None:
+            m.inc("serve_cache_hit_total", cache=self.name, kind=kind)
+        else:
+            m.inc("serve_cache_miss_total", cache=self.name, kind=kind)
+        return value
+
+    def put(self, key, value, root: bytes = b"", epoch: int = 0, nbytes: int = 0):
+        """Insert (returning ``value`` so call sites read
+        ``return cache.put(...)``), evicting oldest-epoch/LRU entries
+        past the count/byte bounds.  An oversized single payload (past
+        ``max_bytes`` on its own) is served but not retained — caching
+        it would evict the entire working set for one straggler."""
+        nbytes = int(nbytes)
+        if nbytes > self.max_bytes:
+            return value
+        root = bytes(root)
+        epoch = int(epoch)
+        evicted = 0
+        with self._lock:
+            if key in self._entries:
+                self._unlink(key)
+            self._entries[key] = _Entry(value, root, epoch, nbytes)
+            self._bytes += nbytes
+            self._by_root.setdefault(root, set()).add(key)
+            self._by_epoch.setdefault(epoch, OrderedDict())[key] = None
+            while len(self._entries) > self.capacity or self._bytes > self.max_bytes:
+                oldest = min(self._by_epoch)
+                victim = next(iter(self._by_epoch[oldest]))
+                self._unlink(victim)
+                evicted += 1
+            self._publish_gauges()
+        if evicted:
+            self.metrics.inc(
+                "serve_cache_evictions_total", evicted, cache=self.name
+            )
+        return value
+
+    def invalidate_root(self, root: bytes, reason: str = "head_transition") -> int:
+        """Evict every entry keyed to one resolved root — the round-9
+        head-transition observer calls this with the STALE head the
+        moment the cached fork-choice head flips, so a reorg's dead
+        branch never pins served encodings."""
+        root = bytes(root)
+        with self._lock:
+            keys = list(self._by_root.get(root, ()))
+            for key in keys:
+                self._unlink(key)
+            if keys:
+                self._publish_gauges()
+        if keys:
+            self.metrics.inc(
+                "serve_cache_invalidations_total",
+                len(keys),
+                cache=self.name,
+                reason=reason,
+            )
+        return len(keys)
+
+    def clear(self, reason: str = "clear") -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._by_root.clear()
+            self._by_epoch.clear()
+            self._bytes = 0
+            if n:
+                self._publish_gauges()
+        if n:
+            self.metrics.inc(
+                "serve_cache_invalidations_total",
+                n,
+                cache=self.name,
+                reason=reason,
+            )
+        return n

@@ -118,6 +118,16 @@ _HELP = {
     "witness_verify_seconds": "one batched multiproof verification (host or device plane)",
     "witness_verified_total": "multiproofs verified by the witness plane, by result",
     "witness_proof_bytes_total": "witness proof bytes served by the proof route",
+    "serve_cache_hit_total": "serving-cache hits, by cache layer and route kind",
+    "serve_cache_miss_total": "serving-cache misses, by cache layer and route kind",
+    "serve_cache_entries": "entries resident per serving cache",
+    "serve_cache_bytes": "accounted payload bytes resident per serving cache",
+    "serve_cache_evictions_total": "serving-cache epoch-LRU evictions at the count/byte bound",
+    "serve_cache_invalidations_total": "serving-cache entries evicted by invalidation, by reason",
+    "serve_coalesce_flush_total": "witness-verify coalescer flushes, by trigger (target|deadline)",
+    "serve_coalesce_proofs_total": "proofs dispatched through coalesced verify flushes",
+    "serve_coalesce_requests_total": "verify requests merged into coalesced flushes",
+    "serve_coalesce_wait_seconds": "per-request park wait inside the verify coalescer",
     "duty_sign_seconds": "one batched duty-signing dispatch (device G2 plane or host comb)",
     "duty_signatures_total": "signatures produced by the signing plane, by path",
     "duty_completion_offset_seconds": "duty-phase completion offset into its slot, by type",

@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
+from ..serve_cache import ServeCache
 from ..types.beacon import BeaconState
 from .multiproof import WitnessPlanner, WitnessProof
 
@@ -34,9 +35,26 @@ __all__ = ["WitnessService"]
 class WitnessService:
     """Thread-safe planner cache (witness requests run on API worker
     threads; two concurrent first-requests for one state would otherwise
-    both build engines)."""
+    both build engines).
 
-    def __init__(self, cls: type = BeaconState, capacity: int = 4):
+    Round 17 adds the **shared witness-proof cache**: completed proofs
+    keyed by ``(block root, requested leaf set)``.  A proof for a fixed
+    root and leaf set is immutable, so a hot leaf set amortizes to a
+    dictionary hit instead of a re-plan + re-hash — the API's response
+    cache above this one additionally holds the fully encoded payloads
+    (the memcpy), while this layer serves every consumer and every
+    output format from one plan.  The key is ORDER-SENSITIVE by design:
+    ``WitnessProof.indices`` records the requested order, so two
+    orderings of one leaf set are two distinct (bit-exact) payloads.
+    Bounded by the same epoch-LRU discipline as the response cache and
+    evicted by root on a head transition (``invalidate_root``)."""
+
+    def __init__(
+        self,
+        cls: type = BeaconState,
+        capacity: int = 4,
+        proof_cache_entries: int = 1024,
+    ):
         # capacity covers the states the API actually serves hot (head,
         # justified, finalized) plus one historical straggler — at 2 the
         # head/justified/finalized rotation would evict the planner it
@@ -49,6 +67,22 @@ class WitnessService:
         # mid-rebuild), so two different states prove concurrently
         self._planners: OrderedDict[bytes, tuple] = OrderedDict()
         self._lock = threading.Lock()
+        # (root, requests) -> WitnessProof; proofs are a few KB each, so
+        # the byte bound mostly guards adversarially wide index sets.
+        # SERVE_NO_CACHE disables this layer too — the knob's contract
+        # is "revert to round-15 re-plan-per-request", not "response
+        # cache off but a proof cache still answering underneath"
+        from ..utils.env import env_flag
+
+        self._proofs = (
+            None
+            if env_flag("SERVE_NO_CACHE")
+            else ServeCache(
+                "witness_proof",
+                capacity=max(1, int(proof_cache_entries)),
+                max_bytes=16 << 20,
+            )
+        )
 
     def planner(self, anchor_root: bytes) -> tuple:
         """``(planner, lock)`` for one state root, LRU-bounded."""
@@ -65,6 +99,30 @@ class WitnessService:
         return entry
 
     def prove(self, anchor_root: bytes, state, requests, spec=None) -> WitnessProof:
-        planner, lock = self.planner(bytes(anchor_root))
+        root = bytes(anchor_root)
+        key = (root, tuple(requests))
+        if self._proofs is not None:
+            hit = self._proofs.get(key, kind="proof")
+            if hit is not None:
+                return hit
+        planner, lock = self.planner(root)
         with lock:
-            return planner.prove(state, requests, spec)
+            proof = planner.prove(state, requests, spec)
+        if self._proofs is None:
+            return proof
+        # nbytes from the compact encoding's arithmetic (32 B per chunk
+        # + per-index overhead) without paying an actual encode
+        nbytes = 40 + 32 * (len(proof.leaves) + len(proof.siblings)) + sum(
+            12 + len(f) for f, _ in proof.indices
+        )
+        epoch = 0
+        if state is not None and spec is not None:
+            epoch = int(state.slot) // int(spec.SLOTS_PER_EPOCH)
+        return self._proofs.put(key, proof, root=root, epoch=epoch, nbytes=nbytes)
+
+    def invalidate_root(self, root: bytes, reason: str = "head_transition") -> int:
+        """Evict one root's cached proofs (the head-transition observer
+        calls this through the API server on a reorg)."""
+        if self._proofs is None:
+            return 0
+        return self._proofs.invalidate_root(bytes(root), reason=reason)

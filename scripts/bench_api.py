@@ -1,0 +1,78 @@
+"""Serving-plane bench (round 17): sustained mixed GET/witness
+throughput through the response cache + verify coalescer.
+
+Drives the SAME closed-loop mixed-traffic harness the serve gate runs
+(``api/harness.py`` — the gate and the bench cannot desynchronize on
+the traffic mix) against a live minimal-spec chain, for a longer
+steady-state window, and emits:
+
+- ``api_requests_per_sec`` (headline): total dispatches/s across the
+  GET mix (state root / block root / block v2 / witness proofs, alias-
+  and root-addressed, both encodings) and the coalesced verify POSTs;
+- ``api_cache_hit_ratio`` (rider): response-cache hits over lookups for
+  the window — the fraction of GETs that were a memcpy instead of a
+  re-encode;
+- ``api_coalesce_mean_batch`` (rider): mean proofs per coalesced verify
+  dispatch — the cross-request bucket-filling the round-17 coalescer
+  exists for.
+
+Registered as a guarded bench.py stage (``BENCH_NO_API`` skips it); one
+JSON line per metric on stdout, like every stage script.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.api.harness import (  # noqa: E402
+    run_mixed_traffic,
+    serving_fixture,
+)
+from lambda_ethereum_consensus_tpu.telemetry import get_metrics  # noqa: E402
+
+
+def main() -> int:
+    duration = float(os.environ.get("BENCH_API_DURATION_S", "8"))
+    get_metrics().set_enabled(True)
+    with serving_fixture() as (api, _store, _spec, head_root):
+        t0 = time.perf_counter()
+        stats = run_mixed_traffic(api, head_root, duration)
+        wall = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "api_requests_per_sec",
+        "value": round(stats["req_per_sec"], 1),
+        "unit": "req/s",
+        "requests": stats["requests"],
+        "get_requests": stats["get_requests"],
+        "post_requests": stats["post_requests"],
+        "post_proofs": stats["post_proofs"],
+        "non_200": len(stats["non_200"]),
+        "duration_s": round(wall, 2),
+    }))
+    ratio = stats["cache_hit_ratio"]
+    print(json.dumps({
+        "metric": "api_cache_hit_ratio",
+        "value": None if ratio is None else round(ratio, 4),
+        "unit": "fraction",
+        "hits": stats["cache_hits"],
+        "misses": stats["cache_misses"],
+    }))
+    mean_batch = stats["coalesce_mean_batch"]
+    print(json.dumps({
+        "metric": "api_coalesce_mean_batch",
+        "value": None if mean_batch is None else round(mean_batch, 1),
+        "unit": "proofs/flush",
+        "flushes": stats["coalesce_flushes"],
+        "proofs": stats["coalesce_proofs"],
+        "requests_merged": stats["coalesce_requests"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

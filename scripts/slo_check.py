@@ -28,6 +28,16 @@ synthetic metric injection:
    every attestation against its broadcast deadline (fired at 1/3
    slot, due before aggregation opens at 2/3 — production must fit one
    interval; one miss is a first-class violation, not a quantile blip).
+5. **Serving plane** (round 17, ``--serve``): the shared
+   ``api/harness.py`` driver pushes closed-loop mixed GET/witness
+   traffic (state/block/witness GETs through the response cache,
+   witness-verify POSTs through the cross-request coalescer) against a
+   live minimal-spec chain CONCURRENTLY with phase 1's ingest — the
+   serve gate (``make serve-gate``) asserts >= --serve-min-rps
+   dispatches/s, a coalesced mean device batch >= --serve-min-batch,
+   a sane cache hit ratio, and zero non-200/invalid answers, on top of
+   the ``api_request_p99`` + admit->apply p95 budgets the engine
+   already judges.
 
 The gate never lets no_data read as green silently: every SLO the
 profile is declared to exercise (:data:`EXERCISED`) must produce
@@ -368,6 +378,77 @@ async def drive_api(n_requests: int) -> tuple[int, list[str]]:
     return served, failed
 
 
+def drive_serving_concurrently(loop, duration_s: float, stack):
+    """Arm the round-17 serving phase: build the mini chain NOW (so the
+    measured window overlaps the ingest phase, not the chain build) and
+    return an awaitable running the shared mixed-traffic driver on an
+    executor thread.  ``stack`` (an ExitStack) keeps the fixture's spec
+    context alive until the caller closes it."""
+    from lambda_ethereum_consensus_tpu.api.harness import (
+        run_mixed_traffic,
+        serving_fixture,
+    )
+
+    api, _store, _spec, head_root = stack.enter_context(serving_fixture())
+    return loop.run_in_executor(
+        None, run_mixed_traffic, api, head_root, duration_s
+    )
+
+
+def serving_violations(serving: dict, min_rps: float, min_batch: float) -> list:
+    """The serve gate's own pass/fail rows (beyond the engine budgets):
+    throughput floor, coalesced-batch floor, cache sanity, availability."""
+    out = []
+
+    def violation(slo, reason, observed, budget):
+        # observed/budget are in the row's own unit (req/s, proofs,
+        # ratio, answers), not seconds — the reason string names it
+        out.append({
+            "slo": slo,
+            "series": "api_request_seconds",
+            "window": "cumulative",
+            "quantile": 1.0,
+            "observed": float(observed),
+            "budget": float(budget),
+            "count": serving["requests"],
+            "reason": reason,
+        })
+
+    if serving["req_per_sec"] < min_rps:
+        violation(
+            "serve_gate_throughput",
+            f"serving plane sustained {serving['req_per_sec']:.0f} req/s "
+            f"of mixed GET/witness traffic, below the {min_rps:.0f} floor",
+            serving["req_per_sec"], min_rps,
+        )
+    mean_batch = serving.get("coalesce_mean_batch")
+    if serving["post_requests"] and (mean_batch is None or mean_batch < min_batch):
+        violation(
+            "serve_gate_coalesce",
+            f"concurrent witness verifies coalesced to a mean device "
+            f"batch of {mean_batch if mean_batch is None else round(mean_batch, 1)}, "
+            f"below the {min_batch:g} floor",
+            mean_batch or 0.0, min_batch,
+        )
+    ratio = serving.get("cache_hit_ratio")
+    if ratio is None or ratio < 0.5:
+        violation(
+            "serve_gate_cache",
+            f"response-cache hit ratio {ratio} under hot-key traffic "
+            "(cache disabled or invalidation thrashing)",
+            ratio or 0.0, 0.5,
+        )
+    if serving["non_200_count"] or serving["invalid_verdicts"]:
+        violation(
+            "serve_gate_availability",
+            f"{serving['non_200_count']} non-200 answers "
+            f"(sample: {serving['non_200'][:4]}) and "
+            f"{serving['invalid_verdicts']} false-invalid verify verdicts",
+            serving["non_200_count"] + serving["invalid_verdicts"], 0.0,
+        )
+    return out
+
+
 def _usage_error(message: str):
     print(f"slo_check: {message}", file=sys.stderr)
     raise SystemExit(2)
@@ -417,6 +498,16 @@ def main() -> int:
                     help="override one SLO's budget (repeatable)")
     ap.add_argument("--seed", type=int, default=12,
                     help="recorded-profile RNG seed")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the round-17 serving phase (mixed "
+                         "GET/witness traffic through the response "
+                         "cache + verify coalescer) concurrently with "
+                         "the ingest phase, and gate its floors")
+    ap.add_argument("--serve-min-rps", type=float, default=10000.0,
+                    help="serving throughput floor, dispatches/s "
+                         "(default 10000)")
+    ap.add_argument("--serve-min-batch", type=float, default=32.0,
+                    help="coalesced mean device batch floor (default 32)")
     ap.add_argument("--duties-keys", type=int, default=None,
                     help="validator keys for the duty phase "
                          "(default: 1024 smoke, 10240 full)")
@@ -458,19 +549,39 @@ def main() -> int:
     )
 
     async def drive_load():
-        """Ingest + duties CONCURRENTLY: the duty phase signs on an
-        executor thread while the scheduler drains gossip-shaped load
-        on the loop — deadline quantiles are measured under the same
-        contention a live attesting node ingests through."""
+        """Ingest + duties CONCURRENTLY (the round-16 contract: deadline
+        quantiles measured under the same contention a live attesting
+        node ingests through), then — with --serve — a SECOND full
+        gossip-ingest phase with the serving plane dispatching mixed
+        GET/witness traffic on executor threads against it (the
+        round-17 contract: >=10k req/s sustained while the scheduler
+        drains gossip-shaped load on the loop).  Two phases rather than
+        one three-way pile-up: each concurrency claim is judged under
+        the load mix it names, and the ingest SLOs accumulate across
+        both phases so the admit->apply p95 covers the serving window
+        too."""
+        import contextlib
+
         loop = asyncio.get_running_loop()
         duty_fut = loop.run_in_executor(
             None, drive_duties, duty_keys, duty_slots
         )
         pipe = await drive_pipeline(engine, duration, rates)
-        return pipe, await duty_fut
+        duties = await duty_fut
+        serving = None
+        if args.serve:
+            with contextlib.ExitStack() as stack:
+                serve_fut = drive_serving_concurrently(loop, duration, stack)
+                pipe2 = await drive_pipeline(engine, duration, rates)
+                serving = await serve_fut
+                pipe = {
+                    "processed": pipe["processed"] + pipe2["processed"],
+                    "sheds": pipe["sheds"] + pipe2["sheds"],
+                }
+        return pipe, duties, serving
 
     t0 = time.monotonic()
-    load, duties = asyncio.run(drive_load())
+    load, duties, serving = asyncio.run(drive_load())
     slots = replay_slot_phases(8 if args.smoke else 64, args.seed)
     blocks = drive_transitions(9 if args.smoke else 17)
     witness_batches = drive_witness(24 if args.smoke else 60)
@@ -497,6 +608,16 @@ def main() -> int:
             ),
         })
         report["ok"] = False
+    if serving is not None:
+        # the serve gate's own floors (round 17): throughput, coalesced
+        # batch size, cache sanity, availability — each a first-class
+        # violation alongside the engine's quantile budgets
+        gate_rows = serving_violations(
+            serving, args.serve_min_rps, args.serve_min_batch
+        )
+        if gate_rows:
+            report["violations"].extend(gate_rows)
+            report["ok"] = False
     if api_failed:
         # a dead route answers its 500 fast — latency green, route
         # broken; availability failures are first-class violations
@@ -547,6 +668,11 @@ def main() -> int:
         "api_requests_expected": n_api,
         "seed": args.seed,
     }
+    if serving is not None:
+        report["profile"]["serving"] = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in serving.items()
+        }
     print(json.dumps(report, indent=2))
     if args.json:
         with open(args.json, "w") as fh:

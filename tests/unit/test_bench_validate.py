@@ -19,12 +19,14 @@ def test_required_metrics_honors_env_gates():
     assert "aggregate_bls_verifications_per_sec" in everything
     assert "pipeline_overload_block_p95_ms" in everything
     assert "duty_signatures_per_sec" in everything
+    assert "api_requests_per_sec" in everything
+    assert "api_cache_hit_ratio" in everything
     gated = bench.required_metrics(env={
         "BENCH_NO_MAINNET": "1", "BENCH_NO_INGEST": "1",
         "BENCH_NO_PLANES": "1", "BENCH_NO_PIPELINE": "1",
         "BENCH_NO_TELEMETRY": "1", "BENCH_NO_TRACE": "1",
         "BENCH_NO_SHARD": "1", "BENCH_NO_WITNESS": "1",
-        "BENCH_NO_DUTIES": "1",
+        "BENCH_NO_DUTIES": "1", "BENCH_NO_API": "1",
     })
     # the ungated headline pair survives every knob
     assert set(gated) == {
@@ -213,7 +215,8 @@ def test_validate_cli_passes_on_covered_artifact(tmp_path):
     # narrow the required set to the two ungated metrics
     for knob in ("BENCH_NO_MAINNET", "BENCH_NO_INGEST", "BENCH_NO_PLANES",
                  "BENCH_NO_PIPELINE", "BENCH_NO_TELEMETRY", "BENCH_NO_TRACE",
-                 "BENCH_NO_SHARD", "BENCH_NO_WITNESS", "BENCH_NO_DUTIES"):
+                 "BENCH_NO_SHARD", "BENCH_NO_WITNESS", "BENCH_NO_DUTIES",
+                 "BENCH_NO_API"):
         env[knob] = "1"
     artifact = tmp_path / "BENCH_ok.json"
     artifact.write_text(

@@ -591,6 +591,71 @@ def test_retrace_hazard_passes_with_duty_sign_bucket_snap(tmp_path):
     assert findings == []
 
 
+def test_retrace_hazard_fires_on_uncoalesced_flush_shape(tmp_path):
+    """The coalescer's bucket-snap discipline (round 17): a flush that
+    concatenates whatever proofs happen to be parked and feeds the
+    jitted plane an array shaped by the merge — no snap/pad in scope —
+    would trace a fresh program per coalesced batch size mid-serve."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def _verify_rounds(nodes):
+                return nodes
+
+            verify_kernel = jax.jit(_verify_rounds)
+
+            def flush(parked):
+                return verify_kernel(
+                    jnp.asarray([p for entry in parked for p in entry.proofs])
+                )
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert len(findings) == 1 and "variable-length" in findings[0].message
+
+
+def test_retrace_hazard_passes_with_coalesced_bucket_snap(tmp_path):
+    """The shipped discipline (witness/coalesce.py -> verify.py): the
+    merged cross-request batch snaps to the registered witness_verify
+    buckets and pads before the jitted plane sees it — a flush can
+    never dispatch an unregistered batch shape."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def shape_buckets(kind):
+                return (64, 256)
+
+            def _verify_rounds(nodes):
+                return nodes
+
+            verify_kernel = jax.jit(_verify_rounds)
+
+            def flush(parked):
+                merged = [p for entry in parked for p in entry.proofs]
+                batch = None
+                for b in shape_buckets("witness_verify"):
+                    if len(merged) <= b:
+                        batch = b
+                        break
+                return verify_kernel(
+                    jnp.asarray(merged + [0] * (batch - len(merged)))
+                )
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert findings == []
+
+
 def test_retrace_hazard_fires_on_use_after_donate(tmp_path):
     findings = lint_sources(
         tmp_path,

@@ -1,0 +1,510 @@
+"""Serving plane (round 17): response-cache bit-exactness + encode-span
+absence on hits, reorg invalidation through the round-9 head-transition
+observer (attestation-weight head flip), the witness-proof cache, the
+cross-request verify coalescer (merge / demux / deadline / bucket-snap),
+and the epoch-LRU eviction discipline of ServeCache itself."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.api.beacon_api import BeaconApiServer
+from lambda_ethereum_consensus_tpu.config import (
+    constants,
+    minimal_spec,
+    use_chain_spec,
+)
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.fork_choice import (
+    get_forkchoice_store,
+    get_head,
+    on_attestation,
+    on_block,
+    on_tick,
+)
+from lambda_ethereum_consensus_tpu.serve_cache import ServeCache
+from lambda_ethereum_consensus_tpu.state_transition import accessors, misc
+from lambda_ethereum_consensus_tpu.state_transition.genesis import (
+    build_genesis_state,
+)
+from lambda_ethereum_consensus_tpu.telemetry import get_metrics
+from lambda_ethereum_consensus_tpu.types.beacon import (
+    Attestation,
+    AttestationData,
+    BeaconBlock,
+    BeaconBlockBody,
+    Checkpoint,
+)
+from lambda_ethereum_consensus_tpu.witness.coalesce import VerifyCoalescer
+from lambda_ethereum_consensus_tpu.witness.multiproof import WitnessPlanner
+
+N = 16
+SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
+
+
+@pytest.fixture(autouse=True)
+def _metrics_on():
+    m = get_metrics()
+    was = m.enabled
+    m.set_enabled(True)
+    yield
+    m.set_enabled(was)
+
+
+@pytest.fixture(scope="module")
+def genesis_ctx():
+    with use_chain_spec(minimal_spec()) as spec:
+        genesis = build_genesis_state(
+            [bls.sk_to_pk(sk) for sk in SKS], spec=spec
+        )
+        anchor = BeaconBlock(
+            slot=0,
+            proposer_index=0,
+            parent_root=b"\x00" * 32,
+            state_root=genesis.hash_tree_root(spec),
+            body=BeaconBlockBody(),
+        )
+        yield genesis, anchor, spec
+
+
+def _hist_count(name: str, **labels) -> int:
+    got = get_metrics().get_histogram(name, **labels)
+    return 0 if got is None else got[3]
+
+
+def _counter(name: str, **labels) -> float:
+    return get_metrics().get(name, **labels)
+
+
+# --------------------------------------------------------- response cache
+
+
+def test_cache_hit_is_bit_exact_and_skips_encode(genesis_ctx):
+    genesis, anchor, spec = genesis_ctx
+    store = get_forkchoice_store(genesis, anchor, spec)
+    api = BeaconApiServer(store=store, spec=spec)
+
+    # JSON path: the state root for "head"
+    miss_status, miss_ctype, miss_payload = api._route(
+        "GET", "/eth/v1/beacon/states/head/root"
+    )
+    assert miss_status.startswith("200")
+    roots_before = _hist_count("ssz_hash_tree_root_seconds", type="BeaconState")
+    hits_before = _counter(
+        "serve_cache_hit_total", cache="response", kind="state_root"
+    )
+    hit_status, hit_ctype, hit_payload = api._route(
+        "GET", "/eth/v1/beacon/states/head/root"
+    )
+    # bit-exact fresh-vs-cached pin + the encode-span ABSENCE assertion:
+    # a cache hit must not touch the Merkleization span at all
+    assert (hit_status, hit_ctype, hit_payload) == (
+        miss_status, miss_ctype, miss_payload
+    )
+    assert _hist_count("ssz_hash_tree_root_seconds", type="BeaconState") == roots_before
+    assert _counter(
+        "serve_cache_hit_total", cache="response", kind="state_root"
+    ) == hits_before + 1
+
+    # SSZ path: the compact witness encoding for a hot leaf set
+    path = "/eth/v0/witness/head?indices=balances:0,validators:3&format=ssz"
+    first = api._route("GET", path)
+    assert first[0].startswith("200") and first[1] == "application/octet-stream"
+    wit_hits_before = _counter(
+        "serve_cache_hit_total", cache="response", kind="witness"
+    )
+    second = api._route("GET", path)
+    assert second == first
+    assert _counter(
+        "serve_cache_hit_total", cache="response", kind="witness"
+    ) == wit_hits_before + 1
+
+
+def test_serve_no_cache_env_reverts_to_encode_per_get(genesis_ctx, monkeypatch):
+    genesis, anchor, spec = genesis_ctx
+    monkeypatch.setenv("SERVE_NO_CACHE", "1")
+    store = get_forkchoice_store(genesis, anchor, spec)
+    api = BeaconApiServer(store=store, spec=spec)
+    assert api._serve_cache is None
+    a = api._route("GET", "/eth/v1/beacon/states/head/root")
+    roots_before = _hist_count("ssz_hash_tree_root_seconds", type="BeaconState")
+    b = api._route("GET", "/eth/v1/beacon/states/head/root")
+    assert a == b
+    # no cache: the second GET re-enters the Merkleization span
+    assert _hist_count("ssz_hash_tree_root_seconds", type="BeaconState") > roots_before
+    # the knob disables the witness-proof layer too — "revert to
+    # round-15" means no cache answering anywhere underneath
+    from lambda_ethereum_consensus_tpu.witness.service import WitnessService
+
+    assert WitnessService()._proofs is None
+
+
+def test_block_v2_rekeys_when_finality_moves(genesis_ctx):
+    genesis, anchor, spec = genesis_ctx
+    store = get_forkchoice_store(genesis, anchor, spec)
+    api = BeaconApiServer(store=store, spec=spec)
+    anchor_root = anchor.hash_tree_root(spec)
+    first = api._route("GET", "/eth/v2/beacon/blocks/head")
+    misses_before = _counter(
+        "serve_cache_miss_total", cache="response", kind="block_v2"
+    )
+    # same finalized checkpoint: a hit
+    assert api._route("GET", "/eth/v2/beacon/blocks/head") == first
+    assert _counter(
+        "serve_cache_miss_total", cache="response", kind="block_v2"
+    ) == misses_before
+    # finality "moves" (same root, new epoch object — the key carries
+    # the finalized ROOT; change it to a distinct value): the entry
+    # re-keys and the next GET rebuilds instead of serving a stale bit
+    store.finalized_checkpoint = Checkpoint(
+        epoch=0, root=b"\x11" * 32
+    )
+    try:
+        api._route("GET", "/eth/v2/beacon/blocks/head")
+        assert _counter(
+            "serve_cache_miss_total", cache="response", kind="block_v2"
+        ) == misses_before + 1
+    finally:
+        store.finalized_checkpoint = Checkpoint(epoch=0, root=anchor_root)
+
+
+# ------------------------------------------- reorg invalidation (satellite)
+
+
+def _single_bit_attestation(store, spec, target_root, anchor_root, head_block_root):
+    """One committee's worth of real signed votes for ``head_block_root``."""
+    committee = accessors.get_beacon_committee(
+        store.block_states[head_block_root], 1, 0, spec
+    )
+    data = AttestationData(
+        slot=1,
+        index=0,
+        beacon_block_root=head_block_root,
+        source=store.justified_checkpoint,
+        target=Checkpoint(epoch=0, root=anchor_root),
+    )
+    domain = accessors.get_domain(
+        store.block_states[head_block_root],
+        constants.DOMAIN_BEACON_ATTESTER,
+        0,
+        spec,
+    )
+    signing_root = misc.compute_signing_root(data, domain)
+    sigs = [bls.sign(SKS[i], signing_root) for i in committee]
+    return Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=bls.aggregate(sigs),
+    )
+
+
+def test_attestation_weight_reorg_evicts_stale_head_encodings(genesis_ctx):
+    """The satellite pin: an attestation-weight head flip through the
+    round-9 ``_observe_head_transition`` observer must evict the stale
+    head's cached encodings before the next GET, and the next GET must
+    answer bit-exactly what an uncached server answers — on the JSON
+    AND the SSZ paths."""
+    from tests.unit.test_fork_choice import build_block
+    from lambda_ethereum_consensus_tpu.node.node import BeaconNode, NodeConfig
+    from lambda_ethereum_consensus_tpu.tracing import SlotClock
+
+    genesis, anchor, spec = genesis_ctx
+    store = get_forkchoice_store(genesis, anchor, spec)
+    anchor_root = anchor.hash_tree_root(spec)
+    signed_a, _ = build_block(genesis, spec, 1, graffiti=b"\xaa" * 32)
+    signed_b, _ = build_block(genesis, spec, 1, graffiti=b"\xbb" * 32)
+    on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+    root_a = on_block(store, signed_a, spec=spec)
+    root_b = on_block(store, signed_b, spec=spec)
+    baseline = get_head(store, spec)  # lexicographic tiebreak, zero weight
+    loser = min(root_a, root_b)
+    assert baseline == max(root_a, root_b)
+
+    api = BeaconApiServer(store=store, spec=spec)
+    node = BeaconNode(NodeConfig(), spec)
+    node.store = store
+    node.slot_clock = SlotClock(
+        int(store.genesis_time), int(spec.SECONDS_PER_SLOT)
+    )
+    node.api = api
+    node._observe_head_transition()  # adopt the baseline head
+    assert node._head_root == baseline
+
+    json_path = "/eth/v1/beacon/states/head/root"
+    ssz_path = "/eth/v0/witness/head?indices=balances:0&format=ssz"
+    stale_json = api._route("GET", json_path)
+    stale_ssz = api._route("GET", ssz_path)
+    assert stale_json[0].startswith("200") and stale_ssz[0].startswith("200")
+    assert baseline in api._serve_cache._by_root
+
+    # the weight flip: one committee attests for the other fork, the
+    # streamed head cache moves, the observer fires — no block applied
+    inval_before = _counter(
+        "serve_cache_invalidations_total",
+        cache="response",
+        reason="head_transition",
+    )
+    on_attestation(
+        store,
+        _single_bit_attestation(store, spec, anchor_root, anchor_root, loser),
+        spec=spec,
+    )
+    assert store.head_cache.head() == loser
+    node._observe_head_transition()
+    assert node._head_root == loser
+
+    # the stale head's encodings are GONE before any GET touches them
+    assert baseline not in api._serve_cache._by_root
+    assert _counter(
+        "serve_cache_invalidations_total",
+        cache="response",
+        reason="head_transition",
+    ) > inval_before
+
+    # and the next GET serves the NEW head, bit-exact against an
+    # uncached server over the same store — JSON and SSZ paths both
+    fresh_json = api._route("GET", json_path)
+    fresh_ssz = api._route("GET", ssz_path)
+    bare = BeaconApiServer(store=store, spec=spec)
+    bare._serve_cache = None
+    assert fresh_json == bare._route("GET", json_path)
+    assert fresh_ssz == bare._route("GET", ssz_path)
+    assert fresh_json[2] != stale_json[2]  # different state root served
+    assert fresh_ssz[2] != stale_ssz[2]
+
+
+# ------------------------------------------------------ witness-proof cache
+
+
+def test_witness_proof_cache_amortizes_replans(genesis_ctx):
+    from lambda_ethereum_consensus_tpu.witness.service import WitnessService
+
+    genesis, anchor, spec = genesis_ctx
+    root = anchor.hash_tree_root(spec)
+    service = WitnessService()
+    calls = []
+    orig_prove = WitnessPlanner.prove
+
+    def counting_prove(self, state, requests, spec=None):
+        calls.append(tuple(requests))
+        return orig_prove(self, state, requests, spec)
+
+    WitnessPlanner.prove = counting_prove
+    try:
+        requests = [("balances", 0), ("validators", 3)]
+        p1 = service.prove(root, genesis, requests, spec)
+        p2 = service.prove(root, genesis, requests, spec)
+        assert len(calls) == 1  # second answer came from the proof cache
+        assert p1 is p2 and p1.encode() == p2.encode()
+        # a different ORDER is a different payload (indices record the
+        # requested order) and must not share the entry
+        p3 = service.prove(root, genesis, list(reversed(requests)), spec)
+        assert len(calls) == 2
+        assert p3.indices != p1.indices
+        # invalidation evicts by root: the next prove re-plans
+        assert service.invalidate_root(root) == 2
+        service.prove(root, genesis, requests, spec)
+        assert len(calls) == 3
+    finally:
+        WitnessPlanner.prove = orig_prove
+
+
+# ----------------------------------------------------------- the coalescer
+
+
+def _mk_proofs(genesis_ctx, n_sets=4):
+    genesis, _anchor, spec = genesis_ctx
+    planner = WitnessPlanner()
+    proofs = [
+        planner.prove(
+            genesis, [("balances", i % N), ("inactivity_scores", (i * 3) % N)],
+            spec,
+        )
+        for i in range(n_sets)
+    ]
+    return proofs, proofs[0].state_root
+
+
+def test_coalescer_merges_concurrent_requests_with_demux(genesis_ctx):
+    proofs, root = _mk_proofs(genesis_ctx)
+    tampered = proofs[1].__class__(
+        state_root=b"\x13" * 32,  # wrong root: cryptographically invalid
+        indices=proofs[1].indices,
+        leaves=proofs[1].leaves,
+        siblings=proofs[1].siblings,
+    )
+    co = VerifyCoalescer(deadline_s=5.0, target=8)
+    flushes_before = _counter("serve_coalesce_flush_total", trigger="target")
+    results = {}
+
+    def request(name, batch, roots):
+        results[name] = co.verify(batch, roots)
+
+    threads = [
+        threading.Thread(
+            target=request, args=("good", [proofs[0], proofs[2]], [root, root])
+        ),
+        threading.Thread(
+            target=request,
+            args=("mixed", [tampered, proofs[3]], [tampered.state_root, root]),
+        ),
+        threading.Thread(
+            target=request, args=("single", [proofs[1]] * 4, [root] * 4)
+        ),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    # per-request demux: verdicts land with their own request, in order
+    assert results["good"] == [True, True]
+    assert results["mixed"] == [False, True]
+    assert results["single"] == [True] * 4
+    # one TARGET flush carried all 8 proofs from 3 different requests
+    assert _counter(
+        "serve_coalesce_flush_total", trigger="target"
+    ) == flushes_before + 1
+
+
+def test_coalescer_lone_request_flushes_at_deadline(genesis_ctx):
+    proofs, root = _mk_proofs(genesis_ctx, n_sets=1)
+    co = VerifyCoalescer(deadline_s=0.05, target=64)
+    deadline_before = _counter("serve_coalesce_flush_total", trigger="deadline")
+    t0 = time.monotonic()
+    assert co.verify([proofs[0]], [root]) == [True]
+    waited = time.monotonic() - t0
+    assert waited < 2.0  # deadline-bounded, not target-starved
+    assert _counter(
+        "serve_coalesce_flush_total", trigger="deadline"
+    ) == deadline_before + 1
+
+
+def test_coalescer_flush_never_exceeds_largest_bucket(genesis_ctx, monkeypatch):
+    """The bucket-snap pin: whatever piles up in the queue, one dispatch
+    never exceeds the largest registered witness_verify bucket (and
+    verify_batch snaps/chunks the rest — its own tests pin that)."""
+    import lambda_ethereum_consensus_tpu.witness.coalesce as CO
+
+    proofs, root = _mk_proofs(genesis_ctx, n_sets=2)
+    sizes = []
+
+    def fake_verify(batch, roots, device=None):
+        sizes.append(len(batch))
+        return [True] * len(batch)
+
+    monkeypatch.setattr(CO, "verify_batch", fake_verify)
+    co = VerifyCoalescer(deadline_s=0.02)
+    assert co.max_flush == 256  # the largest registered bucket
+
+    def request():
+        co.verify([proofs[0]] * 40, [root] * 40)
+
+    threads = [threading.Thread(target=request) for _ in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert sizes and sum(sizes) == 400
+    assert all(size <= 256 for size in sizes)
+
+
+def test_verify_route_coalesces_across_requests(genesis_ctx):
+    """API integration: two concurrent POSTs merge into ONE device
+    dispatch, each answer carrying its own verdicts."""
+    genesis, anchor, spec = genesis_ctx
+    store = get_forkchoice_store(genesis, anchor, spec)
+    api = BeaconApiServer(store=store, spec=spec)
+    proofs, _root = _mk_proofs(genesis_ctx, n_sets=2)
+    # pre-arm a deterministic coalescer: one flush exactly when both
+    # requests (3 proofs each) are parked
+    api._coalescer = VerifyCoalescer(deadline_s=5.0, target=6)
+    requests_before = _counter("serve_coalesce_requests_total")
+    bodies = [
+        json.dumps({
+            "state_id": "head",
+            "proofs": [proofs[i].to_json()] * 3,
+        }).encode()
+        for i in range(2)
+    ]
+    answers = {}
+
+    def post(i):
+        answers[i] = api._route(
+            "POST", "/eth/v0/witness/verify", bodies[i], "application/json"
+        )
+
+    threads = [threading.Thread(target=post, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for i in range(2):
+        status, _ctype, payload = answers[i]
+        assert status.startswith("200")
+        data = json.loads(payload)["data"]
+        assert data["batch"] == 3 and data["valid"] is True
+    assert _counter("serve_coalesce_requests_total") == requests_before + 2
+
+
+def test_verify_route_honors_no_coalesce_env(genesis_ctx, monkeypatch):
+    genesis, anchor, spec = genesis_ctx
+    monkeypatch.setenv("WITNESS_NO_COALESCE", "1")
+    store = get_forkchoice_store(genesis, anchor, spec)
+    api = BeaconApiServer(store=store, spec=spec)
+    proofs, _root = _mk_proofs(genesis_ctx, n_sets=1)
+    flushes_before = _counter(
+        "serve_coalesce_flush_total", trigger="target"
+    ) + _counter("serve_coalesce_flush_total", trigger="deadline")
+    body = json.dumps(
+        {"state_id": "head", "proofs": [proofs[0].to_json()]}
+    ).encode()
+    status, _ctype, payload = api._route(
+        "POST", "/eth/v0/witness/verify", body, "application/json"
+    )
+    assert status.startswith("200")
+    assert json.loads(payload)["data"]["valid"] is True
+    assert api._coalescer is None  # bypassed, straight to verify_batch
+    assert _counter(
+        "serve_coalesce_flush_total", trigger="target"
+    ) + _counter(
+        "serve_coalesce_flush_total", trigger="deadline"
+    ) == flushes_before
+
+
+# -------------------------------------------------- ServeCache discipline
+
+
+def test_serve_cache_evicts_oldest_epoch_first():
+    cache = ServeCache("t1", capacity=3)
+    cache.put("young-a", "A", root=b"\x0a", epoch=9)
+    cache.put("old", "B", root=b"\x0b", epoch=2)
+    cache.put("young-b", "C", root=b"\x0c", epoch=9)
+    # touch the OLD entry last: plain LRU would evict young-a; the
+    # round-6 epoch discipline still evicts the oldest EPOCH
+    assert cache.get("old") == "B"
+    cache.put("young-c", "D", root=b"\x0d", epoch=9)
+    assert cache.get("old") is None
+    assert cache.get("young-a") == "A"
+    assert len(cache) == 3
+
+
+def test_serve_cache_byte_bound_and_oversize_passthrough():
+    cache = ServeCache("t2", capacity=100, max_bytes=100)
+    cache.put("a", "A", epoch=1, nbytes=60)
+    cache.put("b", "B", epoch=2, nbytes=60)  # evicts a (oldest epoch)
+    assert cache.get("a") is None and cache.get("b") == "B"
+    # a single oversized payload is served but never retained
+    assert cache.put("huge", "H", epoch=3, nbytes=10_000) == "H"
+    assert cache.get("huge") is None and cache.get("b") == "B"
+
+
+def test_serve_cache_invalidate_root_only_hits_that_root():
+    cache = ServeCache("t3", capacity=10)
+    cache.put(("k", 1), "A", root=b"\x01" * 32, epoch=1)
+    cache.put(("k", 2), "B", root=b"\x02" * 32, epoch=1)
+    cache.put(("k", 3), "C", root=b"\x01" * 32, epoch=2)
+    assert cache.invalidate_root(b"\x01" * 32) == 2
+    assert cache.get(("k", 1)) is None and cache.get(("k", 3)) is None
+    assert cache.get(("k", 2)) == "B"
