@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench bench-validate slo-smoke serve-gate duties-gate replay-smoke lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke lint clean
 
 all: native
 
@@ -34,6 +34,7 @@ lint:
 test: native
 	python -m pytest tests/ -q -m "not spectest and not device"
 	python -m pytest tests/unit/test_shard_plane.py -q
+	python scripts/bench_compare.py --report-only
 	$(MAKE) serve-gate
 
 # The SLO budget gate alone (round 12): a recorded load profile through
@@ -109,6 +110,15 @@ bench:
 # silently recur.  BENCH_ARTIFACT overrides the newest BENCH_r*.json.
 bench-validate:
 	python bench.py --validate "$${BENCH_ARTIFACT:-$$(ls -t BENCH_r*.json | head -1)}"
+
+# Bench-trajectory regression gate (round 18): per-headline-metric
+# deltas across the checked-in BENCH_r*.json sequence, judged against a
+# ±15% noise band (per-metric overrides via --override) — exits nonzero
+# on a regression, so the perf trajectory gates instead of accumulating.
+# `make test` runs the same report in --report-only mode (historical
+# regressions are facts, not CI failures).
+bench-compare:
+	python scripts/bench_compare.py --markdown BENCH_TREND.md --json BENCH_TREND.json
 
 clean:
 	$(MAKE) -C native clean

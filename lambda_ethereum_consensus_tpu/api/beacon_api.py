@@ -42,7 +42,13 @@ debug contract from the causal-tracing round):
   cache hit/miss counts, causing call site and last use;
 - ``GET /debug/slo`` — one SLO-engine evaluation (observed quantiles vs
   budgets, multi-window burn rates) as JSON; ``scripts/slo_check.py``
-  turns the same report into a CI exit code.
+  turns the same report into a CI exit code;
+- ``GET /debug/profile`` — the round-18 cost & memory observatory:
+  entry points ranked by roofline headroom (HLO FLOP/byte attribution
+  vs the per-backend peak table) plus per-plane device-memory
+  accounting; ``POST /debug/profile/capture`` opens a budgeted
+  on-demand ``jax.profiler`` window whose start/stop instants land in
+  the flight recorder.
 
 Every matched route records its handler latency into the
 ``api_request_seconds{route=...}`` histogram (the family the
@@ -333,6 +339,7 @@ class BeaconApiServer:
             (r"/metrics", self._metrics),
             (r"/debug/trace", self._debug_trace),
             (r"/debug/compile", self._debug_compile),
+            (r"/debug/profile", self._debug_profile),
             (r"/debug/slo", self._debug_slo),
         ] + self._inline_routes()
 
@@ -341,6 +348,7 @@ class BeaconApiServer:
         *groups))."""
         return [
             (r"/eth/v0/witness/verify", self._witness_verify),
+            (r"/debug/profile/capture", self._debug_profile_capture),
         ]
 
     def _inline_routes(self) -> list[tuple[str, Callable]]:
@@ -701,10 +709,23 @@ class BeaconApiServer:
         """The AOT compile/retrace attribution table: every cached
         executable with its shape signature, compile/load seconds, cache
         hit/miss counts, causing call site and last use — plus the
-        process-wide stat counters.  Offloaded route: the table snapshot
-        copies under ops/aot._LOCK."""
+        process-wide stat counters.  Round 18 joins the cost-analysis
+        columns (FLOPs, bytes accessed, roofline ratio) onto the same
+        per-(entry, shape) rows — ONE attribution surface, not two.
+        Offloaded route: the table snapshot copies under ops/aot._LOCK."""
+        from ..ops import profile as ops_profile
         from ..ops.aot import aot_stats, compile_profile, shape_buckets
 
+        rows = compile_profile()
+        roofline = {
+            e["entry"]: e["roofline_ratio"]
+            for e in ops_profile.entry_report()
+        }
+        for row in rows:
+            cost = ops_profile.cost_for(row["entry"], row["signature"])
+            row["flops"] = cost["flops"] if cost else None
+            row["bytes_accessed"] = cost["bytes_accessed"] if cost else None
+            row["roofline_ratio"] = roofline.get(row["entry"])
         return self._json({
             "data": {
                 "stats": aot_stats(),
@@ -714,9 +735,50 @@ class BeaconApiServer:
                     ),
                     "witness_verify": list(shape_buckets("witness_verify")),
                 },
-                "executables": compile_profile(),
+                "executables": rows,
             }
         })
+
+    def _debug_profile(self) -> tuple[str, str, bytes]:
+        """The round-18 device cost & memory observatory: entry points
+        ranked by roofline headroom (FLOP/byte attribution joined with
+        their span histograms against the per-backend peak table),
+        per-plane device-memory accounting with the unattributed
+        remainder and high watermark, and the capture budget/state.
+        Offloaded route: reads histogram snapshots and (when jax is
+        live) walks ``jax.live_arrays()``."""
+        from ..ops import profile as ops_profile
+
+        return self._json({"data": ops_profile.profile_report()})
+
+    def _debug_profile_capture(
+        self, body: bytes, ctype: str
+    ) -> tuple[str, str, bytes]:
+        """``POST /debug/profile/capture`` — one budgeted on-demand
+        ``jax.profiler`` trace window (body: ``{"seconds": s}``, with an
+        optional ``"dir"``).  Runs on the worker thread (the capture
+        sleeps for the whole window — the round-10 executor discipline
+        keeps that off the event loop); an over-budget request is
+        refused BEFORE tracing and answers 400."""
+        from ..ops import profile as ops_profile
+
+        try:
+            obj = json.loads(body.decode() or "{}")
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"malformed JSON body: {e}") from None
+        if not isinstance(obj, dict):
+            raise ValueError("body must be a JSON object")
+        if "seconds" not in obj:
+            raise ValueError("body must carry 'seconds'")
+        try:
+            seconds = float(obj["seconds"])
+        except (TypeError, ValueError):
+            raise ValueError("'seconds' must be a number") from None
+        out_dir = obj.get("dir")
+        if out_dir is not None and not isinstance(out_dir, str):
+            raise ValueError("'dir' must be a string path")
+        report = ops_profile.capture_trace(seconds, out_dir=out_dir)
+        return self._json({"data": report})
 
     def _debug_slo(self) -> tuple[str, str, bytes]:
         """One READ-ONLY evaluation of the process-wide SLO engine.  The

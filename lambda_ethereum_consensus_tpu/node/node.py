@@ -852,11 +852,34 @@ class BeaconNode:
 
                 arrays = jax.live_arrays()
                 proc_m.set_gauge("device_live_arrays", float(len(arrays)))
+                # round-18 plane accounting replaces the old single
+                # device_live_bytes total: one series per accounted
+                # plane + the unattributed remainder, so the old total
+                # is still derivable (live-array planes + remainder)
+                # and the Grafana panel says WHO holds the memory
+                from ..ops import profile as ops_profile
+
+                total = float(sum(getattr(a, "nbytes", 0) for a in arrays))
+                for plane, nbytes in ops_profile.plane_bytes(total).items():
+                    proc_m.set_gauge(
+                        "device_plane_bytes", float(nbytes), plane=plane
+                    )
                 proc_m.set_gauge(
-                    "device_live_bytes",
-                    float(sum(getattr(a, "nbytes", 0) for a in arrays)),
+                    "device_plane_bytes_watermark",
+                    float(ops_profile.plane_watermark()),
                 )
             except Exception:  # a dead device tunnel must not kill ticks
+                pass
+        if "lambda_ethereum_consensus_tpu.ops.profile" in sys.modules:
+            # per-entry cost counters/roofline gauges (round 18): gated
+            # on the observatory already being imported — it is pulled
+            # in by the first AOT compile, so a node that never compiled
+            # a device program pays nothing here
+            try:
+                from ..ops import profile as ops_profile
+
+                ops_profile.emit_entry_metrics(proc_m)
+            except Exception:
                 pass
         bls_batch = sys.modules.get(
             "lambda_ethereum_consensus_tpu.ops.bls_batch"

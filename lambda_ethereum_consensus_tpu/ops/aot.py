@@ -183,6 +183,20 @@ def _note_load(name: str, load_s: float) -> None:
     observe("aot_load_seconds", load_s, entry=name)
 
 
+def _note_cost(name: str, sig: str, executable) -> None:
+    """Feed one resolved executable's compile-time HLO cost/memory
+    analysis to the round-18 observatory (ops/profile.py).  Compiled and
+    deserialized executables both answer the analyses; anything that
+    doesn't (the uncached fallback, test fakes) is silently skipped —
+    cost attribution must never break a dispatch."""
+    try:
+        from .profile import record_entry_cost
+
+        record_entry_cost(name, sig, executable)
+    except Exception:
+        pass
+
+
 def _note_save() -> None:
     inc("aot_saves_total")
 
@@ -356,6 +370,7 @@ def aot_jit(fn, name: str, disk: bool = True):
                 prof["load_seconds"] += load_s
                 prof["source"] = "disk"
                 _note_load(name, load_s)
+                _note_cost(name, sig, loaded)
                 compiled_by_sig[sig] = loaded
             except Exception as e:
                 _log(f"{name}: AOT load FAILED ({type(e).__name__}: {e})")
@@ -425,6 +440,7 @@ def aot_jit(fn, name: str, disk: bool = True):
                 time.sleep(2.0 * (attempt + 1))
         with _LOCK:
             _STATS["compiles"] += 1
+        _note_cost(name, sig, compiled)
         compiled_by_sig[sig] = compiled
         if path is not None:
             try:

@@ -761,6 +761,16 @@ def plane_store_stats() -> dict:
     }
 
 
+# round-18 HBM accounting: the shared registry planes are the largest
+# deliberate device residents, so they claim their bytes in the plane
+# registry the node tick emits as device_plane_bytes{plane}
+from .profile import register_plane as _register_plane  # noqa: E402
+
+_register_plane(
+    "registry_planes", lambda: plane_store_stats()["resident_bytes"]
+)
+
+
 class DeviceCommitteeCache:
     """Epoch-scoped device-resident committee aggregate pubkeys.
 

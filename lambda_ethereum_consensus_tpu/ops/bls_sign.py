@@ -48,6 +48,14 @@ from ..utils.env import env_flag
 from .aot import aot_jit, compile_context, register_shape_bucket, shape_buckets
 from .bls_g1 import SCALAR_BITS, _ints_batch, _scalar_bits_batch, batch_inv_mod
 from .bls_g2 import fq2_limbs_batch, g2_plane_field
+from .profile import register_entry_plane
+
+# round-18 HBM accounting: the duty-sign ladders' compiled programs (the
+# retained device footprint of this plane — bases and scalars are
+# per-dispatch transients) report as their own plane instead of folding
+# into the shared aot_executables plane (both are non-live planes:
+# program bytes never appear in the jax.live_arrays() total)
+register_entry_plane("duty_sign_ladders", "duty_sign")
 
 __all__ = [
     "DEFAULT_SIGN_BUCKETS",

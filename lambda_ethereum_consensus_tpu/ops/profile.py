@@ -1,0 +1,689 @@
+"""Device cost & memory observatory (round 18).
+
+The round-12 attribution table (ops/aot.py) says which entry points
+compile and retrace; the span histograms (telemetry.py) say how long
+their dispatches take.  Neither says what the programs *cost* — so
+ROADMAP item 1's "move the SHA-256 round body and the Miller-loop
+einsum into hand-written Pallas kernels where XLA leaves throughput on
+the table" had no way to locate *where*.  This module closes that loop
+with three planks:
+
+- **Cost attribution** (:func:`record_entry_cost` / :func:`entry_report`):
+  every executable the AOT cache resolves — compiled or deserialized —
+  contributes its compile-time ``cost_analysis()`` FLOPs/bytes-accessed
+  and ``memory_analysis()`` footprint, keyed ``(entry, shape signature)``
+  like the attribution table.  Joined with the per-entry call counts and
+  the entry's span-histogram family, each entry gets achieved-GFLOP/s and
+  achieved-GB/s plus a roofline ratio against a per-backend peak table
+  (:data:`PEAKS` — the TPU row is the v5e datasheet; CPU/GPU rows are
+  honest order-of-magnitude placeholders, overridable via
+  ``PROFILE_PEAK_GFLOPS``/``PROFILE_PEAK_GBS``).  ``/debug/profile``
+  serves the ranked headroom view; ``ops_entry_flops_total`` /
+  ``ops_entry_bytes_total`` / ``ops_entry_roofline_ratio`` expose the
+  same numbers to Prometheus.
+- **Per-plane HBM accounting** (:class:`PlaneRegistry`): the subsystems
+  that pin device memory (registry planes, the resident epoch plane,
+  witness buffers, AOT executables, duty-sign ladders) register byte
+  providers; :func:`plane_bytes` resolves them against the
+  ``jax.live_arrays()`` total into ``device_plane_bytes{plane}`` series
+  with an ``unattributed`` remainder (so the old single total is the
+  sum of the live-array planes plus the remainder) and a high-watermark
+  gauge.  Providers registered ``device=False`` report retained bytes
+  that are NOT part of the live-array total — host buffers (the witness
+  planners' tree rows) and compiled program code/temps (the executable
+  planes) — emitted for budget visibility but excluded from the
+  remainder arithmetic.
+- **Capture windows** (:func:`capture_trace`): a bounded on-demand
+  ``jax.profiler`` trace (``POST /debug/profile/capture``) — refused
+  BEFORE tracing when the requested window exceeds
+  ``PROFILE_CAPTURE_MAX_S``, deleted (and errored) when the written
+  trace exceeds ``PROFILE_CAPTURE_MAX_MB``.  Start/stop instants land in
+  the PR-4 flight recorder so Perfetto exports line up with the node's
+  own timeline.
+
+Achieved rates are deliberately conservative: an entry's cumulative
+FLOPs divide by its mapped span family's cumulative seconds, and a span
+can cover host prep plus several entries (the BLS chain stages all ride
+``attestation_batch_verify_seconds``) — so per-entry achieved is a
+*contribution* rate, a lower bound, and the headroom ranking errs toward
+naming more candidates, which is the useful direction for a "where is
+throughput left on the table" view.
+
+No jax import at module scope: a pure-host node can import (and
+register planes with) this module for free; everything device-touching
+is deferred behind the same ``sys.modules`` gating the node tick uses.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import threading
+import time
+
+from ..telemetry import get_metrics
+from ..tracing import get_recorder
+
+__all__ = [
+    "PEAKS",
+    "PlaneRegistry",
+    "backend_peaks",
+    "capture_budget",
+    "capture_state",
+    "capture_trace",
+    "cost_for",
+    "cost_table",
+    "emit_entry_metrics",
+    "entry_report",
+    "entry_plane_bytes",
+    "live_device_bytes",
+    "plane_bytes",
+    "plane_watermark",
+    "profile_report",
+    "record_entry_cost",
+    "register_entry_plane",
+    "register_plane",
+    "unregister_plane",
+]
+
+_LOCK = threading.Lock()
+
+# ------------------------------------------------------- cost attribution
+
+# (entry, signature) -> cost row.  Filled by ops/aot.py the moment an
+# executable is compiled or deserialized (both carry the analyses), so
+# the table needs no tracing of its own and is exactly as warm as the
+# attribution table it joins against.
+_COSTS: dict[tuple[str, str], dict] = {}
+
+
+def record_entry_cost(entry: str, sig: str, compiled) -> dict | None:
+    """Pull ``cost_analysis()``/``memory_analysis()`` off one resolved
+    executable into the cost table.  Returns the stored row, or ``None``
+    when the executable answers neither analysis (non-XLA fallbacks) —
+    a fault here must never break the dispatch path, so every probe is
+    guarded."""
+    flops = bytes_accessed = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            flops = float(ca.get("flops", 0.0) or 0.0)
+            bytes_accessed = float(ca.get("bytes accessed", 0.0) or 0.0)
+    except Exception:
+        pass
+    code_bytes = temp_bytes = arg_bytes = out_bytes = None
+    try:
+        ma = compiled.memory_analysis()
+        code_bytes = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+        temp_bytes = int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+        arg_bytes = int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+        out_bytes = int(getattr(ma, "output_size_in_bytes", 0) or 0)
+    except Exception:
+        pass
+    if flops is None and code_bytes is None:
+        return None
+    row = {
+        "entry": entry,
+        "signature": sig,
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "code_bytes": code_bytes or 0,
+        "temp_bytes": temp_bytes or 0,
+        "arg_bytes": arg_bytes or 0,
+        "out_bytes": out_bytes or 0,
+        "recorded": time.time(),
+    }
+    with _LOCK:
+        _COSTS[(entry, sig)] = row
+    return row
+
+
+def cost_table() -> list[dict]:
+    """Every recorded cost row (copies — callers may mutate)."""
+    with _LOCK:
+        return [dict(r) for r in _COSTS.values()]
+
+
+def cost_for(entry: str, sig: str) -> dict | None:
+    """One (entry, signature) row, or None — the /debug/compile join."""
+    with _LOCK:
+        row = _COSTS.get((entry, sig))
+        return dict(row) if row is not None else None
+
+
+# Per-backend peak table: (peak GFLOP/s, peak GB/s).  The TPU row is the
+# v5e datasheet (197 TFLOP/s bf16 MXU, 819 GB/s HBM); the CPU and GPU
+# rows are HONEST PLACEHOLDERS — order-of-magnitude single-socket /
+# single-card figures so a CPU dev run still ranks entries sensibly.
+# Override per deployment with PROFILE_PEAK_GFLOPS / PROFILE_PEAK_GBS.
+PEAKS: dict[str, tuple[float, float]] = {
+    "tpu": (197000.0, 819.0),
+    "gpu": (10000.0, 900.0),
+    "cpu": (50.0, 20.0),
+}
+
+
+def backend_peaks(backend: str | None) -> dict:
+    """``{"gflops", "gbs", "backend", "source"}`` for one backend name,
+    with the env overrides applied."""
+    gflops, gbs = PEAKS.get(backend or "cpu", PEAKS["cpu"])
+    source = "table"
+    # each override parses independently: a typo in one must not
+    # silently discard the other valid calibration
+    try:
+        env_gf = os.environ.get("PROFILE_PEAK_GFLOPS")
+        if env_gf:
+            gflops, source = float(env_gf), "env"
+    except ValueError:
+        pass
+    try:
+        env_gb = os.environ.get("PROFILE_PEAK_GBS")
+        if env_gb:
+            gbs, source = float(env_gb), "env"
+    except ValueError:
+        pass
+    return {"backend": backend, "gflops": gflops, "gbs": gbs, "source": source}
+
+
+# Entry-prefix -> span-histogram family: the dispatch latency evidence
+# each entry's FLOP counts divide by.  Several chain stages share one
+# drain span — see the module doc for why that stays honest.
+_ENTRY_SPANS: tuple[tuple[str, str], ...] = (
+    ("duty_sign", "duty_sign_seconds"),
+    ("witness_verify", "witness_verify_seconds"),
+    ("transition_", "epoch_transition_seconds"),
+    ("chain_", "attestation_batch_verify_seconds"),
+    ("pair_", "attestation_batch_verify_seconds"),
+    ("shard_", "ops_shard_combine_seconds"),
+)
+
+
+def _span_family(entry: str) -> str | None:
+    for prefix, family in _ENTRY_SPANS:
+        if entry.startswith(prefix):
+            return family
+    return None
+
+
+def _family_totals(metrics, family: str) -> tuple[float, int]:
+    """Cumulative (seconds, observations) over every series of one
+    histogram family."""
+    total_s = 0.0
+    total_n = 0
+    for _labels, _bounds, _counts, h_sum, h_count in metrics.histogram_series(
+        family
+    ):
+        total_s += h_sum
+        total_n += h_count
+    return total_s, total_n
+
+
+def _default_backend() -> str | None:
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return None
+
+
+def entry_report(metrics=None, backend: str | None = None) -> list[dict]:
+    """The ranked headroom view: one row per entry point with FLOP/byte
+    attribution, achieved rates against its span family, and the
+    roofline ratio vs the backend peaks.  Rows with achieved data rank
+    first, most headroom first — the entries leaving the most throughput
+    on the table lead the list."""
+    from ..slo import slos_for_family
+    from .aot import compile_profile
+
+    m = metrics if metrics is not None else get_metrics()
+    if backend is None:
+        backend = _default_backend()
+    peaks = backend_peaks(backend)
+
+    calls: dict[tuple[str, str], int] = {}
+    for row in compile_profile():
+        calls[(row["entry"], row["signature"])] = row["hits"] + row["misses"]
+
+    with _LOCK:
+        costs = [dict(r) for r in _COSTS.values()]
+    entries: dict[str, dict] = {}
+    for c in costs:
+        key = (c["entry"], c["signature"])
+        n = calls.get(key, 0)
+        e = entries.setdefault(
+            c["entry"],
+            {
+                "entry": c["entry"],
+                "signatures": 0,
+                "calls": 0,
+                "flops_total": 0.0,
+                "bytes_total": 0.0,
+                "flops_per_call_max": 0.0,
+                "code_bytes": 0,
+                "temp_bytes": 0,
+            },
+        )
+        e["signatures"] += 1
+        e["calls"] += n
+        e["flops_total"] += (c["flops"] or 0.0) * n
+        e["bytes_total"] += (c["bytes_accessed"] or 0.0) * n
+        e["flops_per_call_max"] = max(e["flops_per_call_max"], c["flops"] or 0.0)
+        e["code_bytes"] += c["code_bytes"]
+        e["temp_bytes"] += c["temp_bytes"]
+
+    span_cache: dict[str, tuple[float, int]] = {}
+    for e in entries.values():
+        family = _span_family(e["entry"])
+        e["span_family"] = family
+        e["span_seconds"] = e["span_count"] = None
+        e["achieved_gflops"] = e["achieved_gbs"] = None
+        e["compute_ratio"] = e["memory_ratio"] = None
+        e["roofline_ratio"] = e["headroom"] = None
+        e["slo"] = None
+        if family is None:
+            continue
+        slos = slos_for_family(family)
+        if slos:
+            e["slo"] = {"name": slos[0].name, "budget": slos[0].budget}
+        if family not in span_cache:
+            span_cache[family] = _family_totals(m, family)
+        span_s, span_n = span_cache[family]
+        e["span_seconds"] = round(span_s, 6)
+        e["span_count"] = span_n
+        if span_s <= 0.0:
+            continue
+        e["achieved_gflops"] = e["flops_total"] / span_s / 1e9
+        e["achieved_gbs"] = e["bytes_total"] / span_s / 1e9
+        e["compute_ratio"] = e["achieved_gflops"] / peaks["gflops"]
+        e["memory_ratio"] = e["achieved_gbs"] / peaks["gbs"]
+        # the binding resource's achieved fraction; headroom is what a
+        # hand-written kernel could still claim on this backend
+        e["roofline_ratio"] = min(
+            1.0, max(e["compute_ratio"], e["memory_ratio"])
+        )
+        e["headroom"] = 1.0 - e["roofline_ratio"]
+
+    ranked = sorted(
+        (e for e in entries.values() if e["roofline_ratio"] is not None),
+        key=lambda e: (-(e["headroom"] or 0.0), -e["flops_total"]),
+    ) + sorted(
+        (e for e in entries.values() if e["roofline_ratio"] is None),
+        key=lambda e: -e["flops_total"],
+    )
+    for i, e in enumerate(ranked, 1):
+        e["rank"] = i
+    return ranked
+
+
+# the process-wide counter cursors: ops_entry_*_total must expose as
+# counters (rate() semantics), so emission publishes deltas against the
+# last emitted cumulative value instead of re-setting a gauge
+_EMITTED_TOTALS: dict[str, tuple[float, float]] = {}
+
+
+def emit_entry_metrics(metrics=None) -> None:
+    """Publish the per-entry families: ``ops_entry_flops_total`` /
+    ``ops_entry_bytes_total`` counter deltas and the
+    ``ops_entry_roofline_ratio`` gauge.  Called from the node tick
+    (gated on this module already being imported) — idempotent across
+    co-resident nodes because the cursors are process-wide."""
+    m = metrics if metrics is not None else get_metrics()
+    if not m.enabled:
+        return
+    for e in entry_report(metrics=m):
+        name = e["entry"]
+        # cursor read-modify-write under _LOCK: co-resident node ticks
+        # share the process-wide cursors, and an unlocked race would
+        # publish the same delta twice (counters overstate dispatched
+        # work by the number of racing ticks)
+        with _LOCK:
+            prev_f, prev_b = _EMITTED_TOTALS.get(name, (0.0, 0.0))
+            d_flops = max(0.0, e["flops_total"] - prev_f)
+            d_bytes = max(0.0, e["bytes_total"] - prev_b)
+            # monotonic cursor: a tick holding a STALE report (computed
+            # before a concurrent tick's newer emission) must not rewind
+            # the cursor, or the next tick would re-publish the newer
+            # tick's already-emitted delta
+            _EMITTED_TOTALS[name] = (
+                max(prev_f, e["flops_total"]),
+                max(prev_b, e["bytes_total"]),
+            )
+        if d_flops > 0:
+            m.inc("ops_entry_flops_total", d_flops, entry=name)
+        if d_bytes > 0:
+            m.inc("ops_entry_bytes_total", d_bytes, entry=name)
+        if e["roofline_ratio"] is not None:
+            m.set_gauge(
+                "ops_entry_roofline_ratio", e["roofline_ratio"], entry=name
+            )
+
+
+# --------------------------------------------------- per-plane accounting
+
+
+class PlaneRegistry:
+    """Named byte providers for everything that retains device (or
+    host-pinned) buffers.  ``snapshot(total)`` resolves every provider
+    and derives the ``unattributed`` remainder from the device-flagged
+    planes, tracking the total's high watermark.  The ``device`` flag
+    means "these bytes are part of the ``jax.live_arrays()`` total the
+    remainder is derived from" — planes holding memory OUTSIDE that
+    total (host numpy rows, compiled program code/temps) register
+    ``device=False`` so they report as their own series without
+    corrupting the remainder arithmetic.  A provider that raises
+    reports 0 for that snapshot — accounting must never take down the
+    tick loop."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._planes: dict[str, tuple] = {}  # name -> (provider, device)
+        self._watermark = 0.0
+
+    def register(self, name: str, provider, device: bool = True) -> None:
+        if not callable(provider):
+            raise TypeError(f"plane {name!r} provider must be callable")
+        with self._lock:
+            self._planes[name] = (provider, bool(device))
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._planes.pop(name, None)
+
+    def names(self) -> tuple[str, ...]:
+        with self._lock:
+            return tuple(sorted(self._planes))
+
+    def snapshot(self, total_bytes: float | None = None) -> dict[str, float]:
+        with self._lock:
+            items = list(self._planes.items())
+        out: dict[str, float] = {}
+        attributed = 0.0
+        for name, (provider, device) in items:
+            try:
+                nbytes = float(provider() or 0.0)
+            except Exception:
+                nbytes = 0.0
+            out[name] = nbytes
+            if device:
+                attributed += nbytes
+        if total_bytes is not None:
+            total = float(total_bytes)
+            out["unattributed"] = max(0.0, total - attributed)
+            with self._lock:
+                self._watermark = max(self._watermark, total)
+        return out
+
+    @property
+    def watermark(self) -> float:
+        with self._lock:
+            return self._watermark
+
+
+_REGISTRY = PlaneRegistry()
+
+# entry-prefix planes: an AOT entry family whose executables are
+# accounted as their own plane (the duty-sign ladders) instead of under
+# the shared "aot_executables" remainder
+_ENTRY_PLANES: dict[str, str] = {}  # plane name -> entry prefix
+
+
+def register_plane(name: str, provider, device: bool = True) -> None:
+    """Register a retained-bytes provider on the default registry."""
+    _REGISTRY.register(name, provider, device=device)
+
+
+def unregister_plane(name: str) -> None:
+    _REGISTRY.unregister(name)
+
+
+def entry_plane_bytes(prefix: str) -> int:
+    """Device footprint (program code + preallocated temps) of every
+    cost-table executable whose entry starts with ``prefix``."""
+    with _LOCK:
+        return sum(
+            r["code_bytes"] + r["temp_bytes"]
+            for (entry, _sig), r in _COSTS.items()
+            if entry.startswith(prefix)
+        )
+
+
+def register_entry_plane(name: str, prefix: str) -> None:
+    """Account one AOT entry family as its own named plane; its rows are
+    excluded from the shared ``aot_executables`` plane so nothing
+    double-counts.  Program code/temp bytes live in device memory but
+    are NOT ``jax.live_arrays()`` entries, so executable planes register
+    ``device=False`` — subtracting them from the live-array total would
+    under-report (or zero-clamp) the ``unattributed`` remainder."""
+    _ENTRY_PLANES[name] = prefix
+    register_plane(name, lambda: entry_plane_bytes(prefix), device=False)
+
+
+def _unclaimed_executable_bytes() -> int:
+    prefixes = tuple(_ENTRY_PLANES.values())
+    with _LOCK:
+        return sum(
+            r["code_bytes"] + r["temp_bytes"]
+            for (entry, _sig), r in _COSTS.items()
+            if not (prefixes and entry.startswith(prefixes))
+        )
+
+
+def plane_bytes(total_bytes: float | None = None) -> dict[str, float]:
+    """Resolve every registered plane (plus ``unattributed`` when the
+    live total is supplied) — the node tick's ``device_plane_bytes``
+    source."""
+    return _REGISTRY.snapshot(total_bytes)
+
+
+def plane_watermark() -> float:
+    """High watermark of the live-total bytes ever snapshotted."""
+    return _REGISTRY.watermark
+
+
+def live_device_bytes() -> float | None:
+    """Total bytes pinned by live device arrays, or ``None`` when jax
+    was never imported (a pure-host node must not pay the import for an
+    accounting sample)."""
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        return float(
+            sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
+        )
+    except Exception:
+        return None
+
+
+# --------------------------------------------------------- trace capture
+
+_CAPTURE_LOCK = threading.Lock()  # one capture at a time, process-wide
+_CAPTURE_STATE: dict = {"running": False, "last": None}
+
+
+def capture_budget() -> tuple[float, float]:
+    """(max seconds, max MB) for one on-demand capture —
+    ``PROFILE_CAPTURE_MAX_S`` (default 10) / ``PROFILE_CAPTURE_MAX_MB``
+    (default 128)."""
+    try:
+        max_s = float(os.environ.get("PROFILE_CAPTURE_MAX_S", "") or 10.0)
+    except ValueError:
+        max_s = 10.0
+    try:
+        max_mb = float(os.environ.get("PROFILE_CAPTURE_MAX_MB", "") or 128.0)
+    except ValueError:
+        max_mb = 128.0
+    return max_s, max_mb
+
+
+def capture_state() -> dict:
+    max_s, max_mb = capture_budget()
+    with _LOCK:
+        last = (
+            dict(_CAPTURE_STATE["last"])
+            if _CAPTURE_STATE["last"] is not None
+            else None
+        )
+        running = _CAPTURE_STATE["running"]
+    return {
+        "max_seconds": max_s,
+        "max_mb": max_mb,
+        "running": running,
+        "last": last,
+    }
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for fname in files:
+            try:
+                total += os.path.getsize(os.path.join(root, fname))
+            except OSError:
+                pass
+    return total
+
+
+def _default_capture_dir() -> str:
+    d = os.environ.get("PROFILE_CAPTURE_DIR")
+    if d:
+        return d
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    return os.path.join(repo, ".profile_captures")
+
+
+def capture_trace(seconds: float, out_dir: str | None = None, tracer=None) -> dict:
+    """One budgeted ``jax.profiler`` capture window.
+
+    Refuses BEFORE tracing when ``seconds`` exceeds the time budget (an
+    oversized window must not start eating the device), deletes the
+    capture and raises when the written trace exceeds the byte budget.
+    Runs synchronously — callers own the threading (the API route runs
+    it on a worker thread per the round-10 executor discipline).
+    ``tracer`` is a test seam defaulting to ``jax.profiler``."""
+    max_s, max_mb = capture_budget()
+    m = get_metrics()
+    seconds = float(seconds)
+    if not seconds > 0.0:
+        raise ValueError(f"capture seconds must be positive, got {seconds!r}")
+    if seconds > max_s:
+        m.inc("profile_captures_total", result="refused")
+        raise ValueError(
+            f"capture of {seconds:g}s exceeds the PROFILE_CAPTURE_MAX_S="
+            f"{max_s:g} budget — refused before tracing"
+        )
+    if not _CAPTURE_LOCK.acquire(blocking=False):
+        m.inc("profile_captures_total", result="busy")
+        raise ValueError("a profiler capture is already running")
+    try:
+        with _LOCK:
+            _CAPTURE_STATE["running"] = True
+        if tracer is None:
+            import jax.profiler as tracer  # deferred: host nodes stay jax-free
+        path = os.path.join(
+            out_dir or _default_capture_dir(),
+            time.strftime("capture-%Y%m%d-%H%M%S")
+            + f"-{int(time.time() * 1e3) % 1000:03d}",
+        )
+        os.makedirs(path, exist_ok=True)
+        rec = get_recorder()
+        rec.record(
+            "inst", 0, "profile_capture_start",
+            {"dir": path, "budget_s": round(seconds, 3)},
+        )
+        t0 = time.perf_counter()
+        try:
+            tracer.start_trace(path)
+            try:
+                time.sleep(seconds)
+            finally:
+                tracer.stop_trace()
+        except Exception:
+            m.inc("profile_captures_total", result="error")
+            # close the window on the /debug/trace timeline even on a
+            # failed capture — a dangling start instant would render as
+            # a capture that never ends in the Perfetto export
+            rec.record(
+                "inst", 0, "profile_capture_stop",
+                {"dir": path, "error": True,
+                 "seconds": round(time.perf_counter() - t0, 3)},
+            )
+            raise
+        dt = time.perf_counter() - t0
+        rec.record(
+            "inst", 0, "profile_capture_stop",
+            {"dir": path, "seconds": round(dt, 3)},
+        )
+        m.observe("profile_capture_seconds", dt)
+        nbytes = _dir_bytes(path)
+        if nbytes > max_mb * (1 << 20):
+            shutil.rmtree(path, ignore_errors=True)
+            m.inc("profile_captures_total", result="over_budget")
+            raise ValueError(
+                f"capture wrote {nbytes} bytes, over the "
+                f"PROFILE_CAPTURE_MAX_MB={max_mb:g} budget — trace deleted"
+            )
+        m.inc("profile_captures_total", result="ok")
+        last = {
+            "dir": path,
+            "seconds": round(dt, 3),
+            "bytes": nbytes,
+            "at": time.time(),
+        }
+        with _LOCK:
+            _CAPTURE_STATE["last"] = last
+        return dict(last)
+    finally:
+        with _LOCK:
+            _CAPTURE_STATE["running"] = False
+        _CAPTURE_LOCK.release()
+
+
+# -------------------------------------------------------------- reporting
+
+
+def profile_report(metrics=None, total_bytes: float | None = None) -> dict:
+    """The ``/debug/profile`` payload: ranked entries, plane accounting,
+    peaks and capture state in one snapshot."""
+    backend = _default_backend()
+    if total_bytes is None:
+        total_bytes = live_device_bytes()
+    return {
+        "backend": backend,
+        "peaks": backend_peaks(backend),
+        "entries": entry_report(metrics=metrics, backend=backend),
+        "planes": plane_bytes(total_bytes),
+        "live_device_bytes": total_bytes,
+        "plane_watermark_bytes": plane_watermark(),
+        "capture": capture_state(),
+    }
+
+
+# the shared-executables plane: every cost-table program not claimed by
+# a named entry plane (duty-sign registers its own) — registered at
+# import so any process that compiles through ops/aot.py accounts its
+# program footprint without further wiring.  device=False: program
+# code/temp bytes are device-resident but never appear in the
+# jax.live_arrays() total the unattributed remainder is derived from.
+register_plane("aot_executables", _unclaimed_executable_bytes, device=False)
+
+# The rest of the shipped plane set starts as zero-byte placeholders so
+# the device_plane_bytes cardinality is stable from the first tick: a
+# subsystem that never loaded retains nothing, and the moment it DOES
+# load it re-registers the same name with its real provider (bls_batch,
+# state_transition/resident, witness/service, ops/bls_sign).  Dashboards
+# and the acceptance contract therefore always resolve the full named
+# set plus the unattributed remainder.
+register_plane("registry_planes", lambda: 0.0)
+register_plane("resident_epoch", lambda: 0.0)
+register_plane("witness_buffers", lambda: 0.0, device=False)
+register_entry_plane("duty_sign_ladders", "duty_sign")

@@ -59,6 +59,7 @@ __all__ = [
     "estimate_quantile",
     "good_fraction",
     "get_engine",
+    "slos_for_family",
 ]
 
 # (name, seconds) burn-rate windows: "fast" catches a regression within
@@ -179,6 +180,14 @@ DEFAULT_SLOS = (
         "one batched stateless-witness multiproof verification",
     ),
 )
+
+
+def slos_for_family(family: str) -> tuple[SloDef, ...]:
+    """Every shipped budget over one histogram family — the round-18
+    cost observatory annotates each entry point's span family with the
+    latency budget that governs it, so the ``/debug/profile`` headroom
+    ranking shows which budgeted path a kernel rewrite would relieve."""
+    return tuple(s for s in DEFAULT_SLOS if s.family == family)
 
 
 # ------------------------------------------------------ quantile estimation

@@ -216,6 +216,18 @@ class IncrementalStateRoot:
             return None
         return cache.levels
 
+    def retained_bytes(self) -> int:
+        """Bytes held by the retained tree rows (per-field subtree
+        levels + container-level rows) — the witness plane's entry in
+        the round-18 memory accounting."""
+        total = 0
+        if self._top_levels:
+            total += sum(int(lvl.nbytes) for lvl in self._top_levels)
+        for cache in self._fields.values():
+            if cache.levels:
+                total += sum(int(lvl.nbytes) for lvl in cache.levels)
+        return total
+
     def rotate_participation(self, new_current, spec=None) -> bool:
         """Epoch participation reset as two structural moves: the cached
         current-participation subtree becomes previous's (the lists were

@@ -39,11 +39,13 @@ from __future__ import annotations
 import math
 import os
 import threading
+import weakref
 
 import numpy as np
 
 from ..config import ChainSpec, constants, get_chain_spec
 from ..ops.aot import aot_jit, compile_context, register_shape_bucket
+from ..ops.profile import register_plane
 from ..telemetry import observe, set_gauge
 from .math import integer_squareroot
 
@@ -270,6 +272,16 @@ def _kernels() -> dict:
 # ----------------------------------------------------------------- plane
 
 
+# live planes for the round-18 HBM accounting: weak — a plane's device
+# columns free with its state lineage, and accounting must not pin them
+_LIVE_PLANES: "weakref.WeakSet[ResidentEpochPlane]" = weakref.WeakSet()
+
+register_plane(
+    "resident_epoch",
+    lambda: sum(p.device_bytes for p in list(_LIVE_PLANES)),
+)
+
+
 class ResidentEpochPlane:
     """Persistent device residency for the hot BeaconState columns.
 
@@ -299,6 +311,20 @@ class ResidentEpochPlane:
         register_shape_bucket("transition_validators", self.capacity)
         for b in _scatter_buckets(self.capacity):
             register_shape_bucket("transition_scatter", b)
+        _LIVE_PLANES.add(self)
+
+    @property
+    def device_bytes(self) -> int:
+        """Bytes pinned by the resident columns (0 before first sync) —
+        the round-18 plane-registry accounting source."""
+        return sum(
+            int(col.nbytes)
+            for col in (
+                self.bal_lo, self.bal_hi, self.scores,
+                self.part_prev, self.part_cur,
+            )
+            if col is not None
+        )
 
     # ------------------------------------------------------------- sync
 

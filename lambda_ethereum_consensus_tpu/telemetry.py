@@ -94,7 +94,13 @@ _HELP = {
     "ssz_hash_tree_root_seconds": "top-level SSZ Merkleization root",
     "sidecar_roundtrip_seconds": "one sidecar command round-trip",
     "device_live_arrays": "live device arrays (jax.live_arrays)",
-    "device_live_bytes": "bytes pinned by live device arrays",
+    "device_plane_bytes": "retained bytes per accounted memory plane (unattributed = jax.live_arrays() total minus the live-array planes; host/executable planes report outside that arithmetic)",
+    "device_plane_bytes_watermark": "high watermark of total live device bytes",
+    "ops_entry_flops_total": "HLO-estimated FLOPs dispatched per AOT entry point",
+    "ops_entry_bytes_total": "HLO-estimated bytes accessed per AOT entry point",
+    "ops_entry_roofline_ratio": "achieved/peak roofline ratio per entry (max of compute and memory fractions)",
+    "profile_captures_total": "on-demand jax.profiler capture attempts, by result",
+    "profile_capture_seconds": "wall time of one on-demand profiler capture window",
     "registry_plane_resident_bytes": "device bytes of shared registry planes",
     "registry_plane_uploaded_cols": "registry columns shipped host->device",
     "registry_plane_stores": "live per-chain registry plane stores",

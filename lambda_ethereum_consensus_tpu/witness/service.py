@@ -23,13 +23,29 @@ the planner's lock) and keeps consensus state untouched.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import OrderedDict
 
+from ..ops.profile import register_plane
 from ..serve_cache import ServeCache
 from ..types.beacon import BeaconState
 from .multiproof import WitnessPlanner, WitnessProof
 
 __all__ = ["WitnessService"]
+
+# live services for the round-18 memory accounting: the planners' tree
+# rows are tens of MB per served state, and a budget view that omits
+# them would blame the remainder.  Host-retained (numpy rows + proof
+# cache), so registered device=False — reported as its own
+# device_plane_bytes series but excluded from the unattributed-remainder
+# arithmetic over jax.live_arrays().
+_LIVE_SERVICES: "weakref.WeakSet[WitnessService]" = weakref.WeakSet()
+
+register_plane(
+    "witness_buffers",
+    lambda: sum(s.retained_bytes() for s in list(_LIVE_SERVICES)),
+    device=False,
+)
 
 
 class WitnessService:
@@ -83,6 +99,22 @@ class WitnessService:
                 max_bytes=16 << 20,
             )
         )
+        _LIVE_SERVICES.add(self)
+
+    def retained_bytes(self) -> int:
+        """Bytes retained by this service: every planner's engine tree
+        rows plus the proof cache's accounted payloads."""
+        with self._lock:
+            planners = [p for p, _lock in self._planners.values()]
+        total = 0
+        for planner in planners:
+            engine = getattr(planner, "engine", None)
+            retained = getattr(engine, "retained_bytes", None)
+            if retained is not None:
+                total += retained()
+        if self._proofs is not None:
+            total += int(self._proofs.stats()["bytes"])
+        return total
 
     def planner(self, anchor_root: bytes) -> tuple:
         """``(planner, lock)`` for one state root, LRU-bounded."""

@@ -1,0 +1,310 @@
+#!/usr/bin/env python3
+"""Bench-trajectory regression gate (round 18).
+
+The repo accumulates one ``BENCH_r*.json`` artifact per round but
+nothing ever *consumed* the sequence — a regression between rounds was
+invisible unless a human diffed JSON.  This script parses the checked-in
+trajectory (all three on-disk shapes — driver-wrapper, plain JSON list,
+raw JSON-lines — via the same ``bench._artifact_records`` parser the
+``--validate`` gate uses), computes per-headline-metric deltas between
+the two most recent rounds that recorded a number, judges them against a
+noise band (default ±15 %, per-metric overrides via ``--override``),
+and emits a markdown + JSON trend report.  Exit is non-zero on any
+regression — ``make bench-compare`` turns the perf trajectory into a
+gate instead of an archive.
+
+Direction is inferred from the metric name (throughputs up, latencies/
+overheads/sizes down); metrics whose name answers neither way are
+reported as informational and never gate.  A round that recorded no
+number for a metric (honest-absence records, the empty rc-124 artifact)
+simply does not participate — the gate compares recorded evidence, it
+does not invent it.
+
+``--report-only`` prints the same report without gating (the ``make
+test`` CI smoke runs this over the historical artifacts, where old
+regressions are facts, not failures).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (the shared artifact parser)
+
+DEFAULT_NOISE_BAND = 0.15
+
+# bookkeeping records that are not performance metrics
+META_METRICS = frozenset({
+    "bench_total_budget_s",
+    "bench_artifact_selfcheck",
+    "bench_artifact_validation",
+    "bench_truncated",
+    "capella_replay_progress",
+    "chain_verify_smoke",
+})
+
+# name fragments that say "bigger is better" / "smaller is better";
+# checked in order — the first hit wins, unmatched names are
+# informational (reported, never gated)
+_HIGHER_TOKENS = ("per_sec", "per_epoch", "hit_ratio", "_gain", "per_drain")
+_LOWER_SUFFIXES = (
+    "_s", "_ms", "_us", "_seconds", "_pct", "_bytes", "_frac",
+    "_us_per_item",
+)
+
+
+def metric_direction(name: str) -> str | None:
+    """``"higher"`` / ``"lower"`` / ``None`` (informational)."""
+    for tok in _HIGHER_TOKENS:
+        if tok in name:
+            return "higher"
+    for suffix in _LOWER_SUFFIXES:
+        if name.endswith(suffix):
+            return "lower"
+    return None
+
+
+def artifact_label(path: str, index: int) -> str:
+    """``r04``-style round label from the filename, else a sequence
+    ordinal — the x-axis of the trend report."""
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return f"r{int(m.group(1)):02d}" if m else f"#{index}"
+
+
+def artifact_values(path: str) -> dict[str, float]:
+    """metric -> recorded value for one artifact (numeric records only;
+    the LAST record of a metric wins, matching bench.py's emit order
+    where partial records precede the final one)."""
+    values: dict[str, float] = {}
+    for rec in bench._artifact_records(path):
+        name = rec.get("metric")
+        value = rec.get("value")
+        if (
+            isinstance(name, str)
+            and name not in META_METRICS
+            and isinstance(value, (int, float))
+            and not isinstance(value, bool)
+        ):
+            values[name] = float(value)
+    return values
+
+
+def evaluate(
+    paths: list[str],
+    band: float = DEFAULT_NOISE_BAND,
+    overrides: dict[str, float] | None = None,
+) -> dict:
+    """The trend report over an ordered artifact sequence."""
+    overrides = overrides or {}
+    labels = [artifact_label(p, i + 1) for i, p in enumerate(paths)]
+    per_artifact = [artifact_values(p) for p in paths]
+    metrics: dict[str, dict] = {}
+    for values in per_artifact:
+        for name in values:
+            metrics.setdefault(name, {})
+    regressions: list[dict] = []
+    for name, row in sorted(metrics.items()):
+        points = [
+            {"artifact": label, "value": vals.get(name)}
+            for label, vals in zip(labels, per_artifact)
+        ]
+        numeric = [p["value"] for p in points if p["value"] is not None]
+        direction = metric_direction(name)
+        band_used = float(overrides.get(name, band))
+        row.update({
+            "points": points,
+            "direction": direction,
+            "noise_band": band_used,
+            "delta_frac": None,
+            "status": "no_data",
+        })
+        if len(numeric) == 1:
+            row["status"] = "single_point"
+            continue
+        if len(numeric) < 1:
+            continue
+        prev, latest = numeric[-2], numeric[-1]
+        delta = (latest - prev) / abs(prev) if prev else None
+        row["previous"] = prev
+        row["latest"] = latest
+        row["delta_frac"] = round(delta, 6) if delta is not None else None
+        if direction is None:
+            row["status"] = "informational"
+            continue
+        if delta is None:
+            row["status"] = "informational"
+            continue
+        worse = -delta if direction == "higher" else delta
+        if worse > band_used:
+            row["status"] = "regressed"
+            regressions.append({
+                "metric": name,
+                "previous": prev,
+                "latest": latest,
+                "delta_frac": row["delta_frac"],
+                "noise_band": band_used,
+                "direction": direction,
+            })
+        elif worse < -band_used:
+            row["status"] = "improved"
+        else:
+            row["status"] = "ok"
+    return {
+        "artifacts": [
+            {"path": os.path.relpath(p, REPO), "label": label}
+            for p, label in zip(paths, labels)
+        ],
+        "noise_band": band,
+        "overrides": dict(overrides),
+        "metrics": metrics,
+        "regressions": regressions,
+        "ok": not regressions,
+    }
+
+
+def to_markdown(report: dict) -> str:
+    lines = [
+        "# Bench trajectory",
+        "",
+        "Artifacts: "
+        + ", ".join(f"`{a['label']}`" for a in report["artifacts"]),
+        f"Noise band: ±{report['noise_band'] * 100:.0f}% "
+        "(per-metric overrides applied where listed)",
+        "",
+        "| metric | direction | trend | prev | latest | Δ | band | status |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for name, row in sorted(report["metrics"].items()):
+        trend = " → ".join(
+            "·" if p["value"] is None else f"{p['value']:g}"
+            for p in row["points"]
+        )
+        delta = (
+            f"{row['delta_frac'] * 100:+.1f}%"
+            if row.get("delta_frac") is not None
+            else "—"
+        )
+        lines.append(
+            f"| {name} | {row['direction'] or 'info'} | {trend} "
+            f"| {row.get('previous', '—')} | {row.get('latest', '—')} "
+            f"| {delta} | ±{row['noise_band'] * 100:.0f}% | {row['status']} |"
+        )
+    lines.append("")
+    if report["regressions"]:
+        lines.append("## Regressions")
+        for r in report["regressions"]:
+            lines.append(
+                f"- **{r['metric']}**: {r['previous']:g} → {r['latest']:g} "
+                f"({r['delta_frac'] * 100:+.1f}%, band "
+                f"±{r['noise_band'] * 100:.0f}%, {r['direction']} is better)"
+            )
+    else:
+        lines.append("No regressions outside the noise band.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def default_artifacts() -> list[str]:
+    """The checked-in ``BENCH_r*.json`` sequence, ordered by round."""
+    def key(path: str):
+        m = re.search(r"r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else 0, path)
+
+    return sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")), key=key)
+
+
+def parse_overrides(items: list[str]) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for item in items:
+        name, sep, frac = item.partition("=")
+        if not sep or not name:
+            raise ValueError(
+                f"override must be metric=fraction, got {item!r}"
+            )
+        out[name] = float(frac)
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="bench-trajectory trend report + regression gate"
+    )
+    parser.add_argument(
+        "artifacts", nargs="*",
+        help="artifact paths in trajectory order "
+             "(default: BENCH_r*.json in the repo root, by round)",
+    )
+    parser.add_argument(
+        "--noise-band", type=float, default=DEFAULT_NOISE_BAND,
+        help="relative change treated as noise (default 0.15 = ±15%%)",
+    )
+    parser.add_argument(
+        "--override", action="append", default=[], metavar="METRIC=FRAC",
+        help="per-metric noise band (repeatable)",
+    )
+    parser.add_argument(
+        "--markdown", metavar="PATH",
+        help="write the markdown trend report here (always printed)",
+    )
+    parser.add_argument(
+        "--json", metavar="PATH", help="write the JSON trend report here"
+    )
+    parser.add_argument(
+        "--report-only", action="store_true",
+        help="never gate: exit 0 even on regressions (the CI smoke over "
+             "historical artifacts)",
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    paths = args.artifacts or default_artifacts()
+    if len(paths) < 2:
+        print(
+            "bench-compare: need at least 2 artifacts to compare "
+            f"(got {len(paths)})",
+            file=sys.stderr,
+        )
+        return 2
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"bench-compare: missing artifacts: {missing}", file=sys.stderr)
+        return 2
+    try:
+        overrides = parse_overrides(args.override)
+    except ValueError as e:
+        print(f"bench-compare: {e}", file=sys.stderr)
+        return 2
+    report = evaluate(paths, band=args.noise_band, overrides=overrides)
+    md = to_markdown(report)
+    print(md)
+    if args.markdown:
+        with open(args.markdown, "w") as fh:
+            fh.write(md)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=1)
+            fh.write("\n")
+    for r in report["regressions"]:
+        print(
+            f"bench-compare: REGRESSION {r['metric']}: "
+            f"{r['previous']:g} -> {r['latest']:g} "
+            f"({r['delta_frac'] * 100:+.1f}%)",
+            file=sys.stderr,
+        )
+    if report["regressions"] and not args.report_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

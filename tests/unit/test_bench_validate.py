@@ -210,6 +210,35 @@ def test_replay_progress_promotes_partial_headline():
     ) == []
 
 
+def test_bench_compare_knob_inventory():
+    """Round-18 satellite: every bench_compare knob is enumerated here —
+    a new flag (or a renamed one) must update this inventory, the same
+    discipline the BENCH_NO_* gates follow above."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    import bench_compare
+
+    parser = bench_compare.build_parser()
+    flags = {
+        opt
+        for action in parser._actions
+        for opt in action.option_strings
+        if opt.startswith("--")
+    }
+    assert flags == {
+        "--help", "--noise-band", "--override", "--markdown", "--json",
+        "--report-only",
+    }
+    assert bench_compare.DEFAULT_NOISE_BAND == 0.15
+    # the positional artifact list defaults to the checked-in trajectory
+    assert [a.dest for a in parser._actions if not a.option_strings] == [
+        "artifacts"
+    ]
+    # per-metric overrides parse as metric=fraction pairs
+    assert bench_compare.parse_overrides(["a_per_sec=0.3"]) == {
+        "a_per_sec": 0.3
+    }
+
+
 def test_validate_cli_passes_on_covered_artifact(tmp_path):
     env = dict(os.environ)
     # narrow the required set to the two ungated metrics

@@ -932,6 +932,134 @@ def test_metric_contract_slo_passes_over_emitted_histogram(tmp_path):
     assert findings == []
 
 
+def test_metric_contract_round18_families_pass(tmp_path):
+    """The observatory families (ops_entry_*, device_plane_bytes,
+    profile_*) stay at 0 findings when inventory, emitters, dashboards
+    and the SLO cross-check agree — the shipped wiring's shape."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "telemetry.py": """
+            _HELP = {
+                "device_plane_bytes": "retained bytes per accounted plane",
+                "device_plane_bytes_watermark": "high watermark of live device bytes",
+                "ops_entry_flops_total": "FLOPs dispatched per entry",
+                "ops_entry_roofline_ratio": "achieved/peak per entry",
+                "profile_captures_total": "captures by result",
+                "profile_capture_seconds": "capture wall time",
+            }
+            """,
+            "profile.py": """
+            def emit(m, planes, entries):
+                for plane, nbytes in planes.items():
+                    m.set_gauge("device_plane_bytes", nbytes, plane=plane)
+                m.set_gauge("device_plane_bytes_watermark", 1.0)
+                for e in entries:
+                    m.inc("ops_entry_flops_total", 5, entry=e)
+                    m.set_gauge("ops_entry_roofline_ratio", 0.5, entry=e)
+
+            def capture(m):
+                m.inc("profile_captures_total", result="ok")
+                m.observe("profile_capture_seconds", 0.2)
+            """,
+            "slo.py": """
+            class SloDef:
+                def __init__(self, *a, **k):
+                    pass
+
+            DEFAULT_SLOS = (
+                SloDef("capture_p95", "profile_capture_seconds", 0.95, 5.0),
+            )
+            """,
+        },
+        rules=["metric-contract"],
+        extra_files={
+            "metrics/grafana/dash.json": json.dumps({
+                "panels": [
+                    {
+                        "targets": [
+                            {
+                                "expr": "sum by (plane) (device_plane_bytes)",
+                                "legendFormat": "{{plane}}",
+                            },
+                            {"expr": "device_plane_bytes_watermark"},
+                            {
+                                "expr": "sum by (entry) (rate(ops_entry_flops_total[5m]))",
+                                "legendFormat": "{{entry}}",
+                            },
+                            {
+                                "expr": "ops_entry_roofline_ratio",
+                                "legendFormat": "{{entry}}",
+                            },
+                            {
+                                "expr": "sum by (result) (rate(profile_captures_total[5m]))",
+                            },
+                            {
+                                "expr": "histogram_quantile(0.95, sum by (le) (rate(profile_capture_seconds_bucket[5m])))",
+                            },
+                        ]
+                    }
+                ]
+            })
+        },
+    )
+    assert findings == []
+
+
+def test_metric_contract_round18_families_fire(tmp_path):
+    """The same families drift-checked: an undeclared emitter, a dead
+    inventory row, a dashboard label no emitter attaches, and an SLO
+    over the counter (not histogram) capture family all fire."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "telemetry.py": """
+            _HELP = {
+                "device_plane_bytes": "retained bytes per accounted plane",
+                "ops_entry_bytes_total": "declared but never emitted",
+                "profile_captures_total": "captures by result",
+            }
+            """,
+            "profile.py": """
+            def emit(m):
+                m.set_gauge("device_plane_bytes", 1.0)
+                m.inc("ops_entry_flops_total", 5, entry="duty_sign")
+                m.inc("profile_captures_total", result="ok")
+            """,
+            "slo.py": """
+            class SloDef:
+                def __init__(self, *a, **k):
+                    pass
+
+            DEFAULT_SLOS = (
+                SloDef("capture_p95", "profile_captures_total", 0.95, 5.0),
+            )
+            """,
+        },
+        rules=["metric-contract"],
+        extra_files={
+            "metrics/grafana/dash.json": json.dumps({
+                "panels": [
+                    {
+                        "targets": [
+                            {
+                                # 'plane' label never attached by the emitter
+                                "expr": "sum by (plane) (device_plane_bytes)",
+                            },
+                        ]
+                    }
+                ]
+            })
+        },
+    )
+    messages = "\n".join(f.message for f in findings)
+    assert "'ops_entry_flops_total' is emitted here but missing" in messages
+    assert "'ops_entry_bytes_total' is declared in telemetry._HELP" in messages
+    assert "label 'plane' on 'device_plane_bytes'" in messages
+    assert "not as a histogram" in messages
+    assert len(findings) == 4
+
+
 # ------------------------------------------------- suppression and baseline
 
 
