@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke soak-smoke soak-validate lint clean
 
 all: native
 
@@ -36,6 +36,7 @@ test: native
 	python -m pytest tests/unit/test_shard_plane.py -q
 	python scripts/bench_compare.py --report-only
 	$(MAKE) serve-gate
+	$(MAKE) soak-smoke
 
 # The SLO budget gate alone (round 12): a recorded load profile through
 # the real ingest pipeline + API, evaluated against slo.DEFAULT_SLOS —
@@ -52,6 +53,27 @@ slo-smoke:
 # pass report is recorded to SERVE_GATE.json.
 serve-gate:
 	python scripts/slo_check.py --smoke --serve --json SERVE_GATE.json
+
+# The chaos/soak gate (round 19, ROADMAP item 2): the five slot-clocked
+# scenarios (steady, storm, partition, equivocation, churn) drive the
+# real node stack — seeded transport faults, a 3-node fleet over the
+# loopback wire with partition-and-heal, adversarial payloads, sidecar
+# kill/restart — and assert RECOVERY against the SLO burn-rate engine:
+# burn back under threshold and one fleet head within the budgeted slot
+# count.  Smoke profile is seeded and ~1 min; exits nonzero with one
+# structured violation line per breach.  Knobs: SOAK_SEED, SOAK_NO_*.
+soak-smoke:
+	python scripts/soak_check.py --smoke
+
+# Audit a recorded soak artifact (truncation fails loudly, the same way
+# bench.py --validate audits bench artifacts).  SOAK_ARTIFACT overrides
+# the newest SOAK_r*.json.
+soak-validate:
+	@artifact="$${SOAK_ARTIFACT:-$$(ls -t SOAK_r*.json 2>/dev/null | head -1)}"; \
+	if [ -z "$$artifact" ]; then \
+	  echo "soak-validate: no SOAK_r*.json artifact found" >&2; exit 1; \
+	fi; \
+	python scripts/soak_check.py --validate "$$artifact"
 
 # The 10k-key duty deadline gate (round 16): every attestation duty of
 # a full mainnet-spec epoch (10,240 keys, 32 slots) fired at 1/3 slot

@@ -1,0 +1,41 @@
+"""Chaos & soak subsystem (round 19, ROADMAP item 2).
+
+Deterministic fault injection over the real transport plus an in-process
+multi-node fleet harness, so partitions, equivocations, fork storms and
+sidecar churn are first-class declarative scenarios gated on the round-12
+SLO burn-rate engine (``scripts/soak_check.py`` is the CI entry point).
+
+- :mod:`.faults` — the seeded fault model: every drop/dup/reorder/delay
+  decision is a pure function of ``(seed, link, per-link counter)``, so
+  one seed reproduces one fault schedule bit for bit.
+- :mod:`.inject` — :class:`ChaosPort`, a transparent wrapper around a
+  live :class:`~..network.port.Port` applying the fault schedule to
+  inbound gossip and outbound publishes, enforcing partitions, and able
+  to stall/kill the sidecar to exercise the restart supervisor.
+- :mod:`.fleet` — chain minting + node boot/teardown plumbing (shared
+  with ``tests/integration/test_node.py`` so the test and the harness
+  cannot drift) and :class:`Fleet`, N nodes gossiping over the real
+  loopback wire with partition/heal and head-convergence observation.
+- :mod:`.scenarios` — the slot-clocked soak profiles (``steady``,
+  ``storm``, ``partition``, ``equivocation``, ``churn``), each replaying
+  seeded load and asserting recovery — not just survival — against the
+  SLO engine.
+"""
+
+from .faults import FaultDecision, FaultScheduler, FaultSpec
+from .inject import ChaosPort
+from .fleet import Fleet, make_chain, started_node
+from .scenarios import SCENARIOS, ScenarioContext, run_scenario
+
+__all__ = [
+    "ChaosPort",
+    "FaultDecision",
+    "FaultScheduler",
+    "FaultSpec",
+    "Fleet",
+    "SCENARIOS",
+    "ScenarioContext",
+    "make_chain",
+    "run_scenario",
+    "started_node",
+]

@@ -1,0 +1,118 @@
+"""Deterministic, seeded fault model for the chaos transport layer.
+
+Every per-message decision (drop / duplicate / reorder / extra latency)
+is a pure function of ``(seed, link label, that link's message counter,
+fault kind)`` hashed through SHA-256 — no shared RNG stream — so the
+schedule is reproducible bit for bit regardless of how asyncio
+interleaves links: message ``n`` on link ``a->b`` always gets the same
+verdict under the same seed, whatever happened on other links in
+between.  ``tests/unit/test_chaos.py`` pins this reproducibility (the
+ISSUE-14 acceptance: same seed == same fault schedule).
+
+Window-scoped faults (partitions, sidecar stalls) are *slot-indexed* in
+the scenario specs (:mod:`.scenarios`) rather than probability-driven,
+which keeps them deterministic by construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import NamedTuple
+
+__all__ = ["FaultDecision", "FaultScheduler", "FaultSpec"]
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Per-link fault probabilities and latency parameters.
+
+    Probabilities are per message in ``[0, 1]``; ``delay_s`` is a fixed
+    base latency added to every delivery on the link, ``jitter_s`` an
+    additional uniform(0, jitter) component drawn from the seeded hash
+    stream (so even the jitter reproduces)."""
+
+    drop: float = 0.0
+    dup: float = 0.0
+    reorder: float = 0.0
+    delay_s: float = 0.0
+    jitter_s: float = 0.0
+
+    def __post_init__(self):
+        for name in ("drop", "dup", "reorder"):
+            p = getattr(self, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} probability out of [0,1]: {p}")
+        if self.delay_s < 0.0 or self.jitter_s < 0.0:
+            raise ValueError("latency parameters must be non-negative")
+
+    @property
+    def any_active(self) -> bool:
+        return bool(
+            self.drop or self.dup or self.reorder
+            or self.delay_s or self.jitter_s
+        )
+
+
+class FaultDecision(NamedTuple):
+    """One message's verdict on one link."""
+
+    drop: bool
+    dup: bool
+    reorder: bool
+    delay_s: float
+
+
+_NO_FAULT = FaultDecision(False, False, False, 0.0)
+
+
+class FaultScheduler:
+    """Seeded decision stream, one counter per link.
+
+    ``decide(link)`` consumes that link's next counter value and returns
+    the message's :class:`FaultDecision`.  Two schedulers constructed
+    with the same ``(seed, spec)`` produce identical streams; the
+    uniform draw for each ``(link, n, kind)`` never depends on draws for
+    other links or kinds, so partial replays stay aligned."""
+
+    def __init__(self, seed: int, spec: FaultSpec):
+        self.seed = int(seed)
+        self.spec = spec
+        self._counters: dict[str, int] = {}
+
+    def uniform(self, link: str, n: int, kind: str) -> float:
+        """The deterministic uniform(0,1) draw for one decision cell."""
+        digest = hashlib.sha256(
+            f"{self.seed}|{link}|{n}|{kind}".encode()
+        ).digest()
+        return int.from_bytes(digest[:8], "big") / 2.0**64
+
+    def peek_counter(self, link: str) -> int:
+        return self._counters.get(link, 0)
+
+    def decide(self, link: str) -> FaultDecision:
+        n = self._counters.get(link, 0)
+        self._counters[link] = n + 1
+        spec = self.spec
+        if not spec.any_active:
+            return _NO_FAULT
+        drop = spec.drop > 0.0 and self.uniform(link, n, "drop") < spec.drop
+        if drop:
+            # a dropped message has no further fate — skip the remaining
+            # draws (they are per-cell, so skipping cannot desync links)
+            return FaultDecision(True, False, False, 0.0)
+        dup = spec.dup > 0.0 and self.uniform(link, n, "dup") < spec.dup
+        reorder = (
+            spec.reorder > 0.0
+            and self.uniform(link, n, "reorder") < spec.reorder
+        )
+        delay = spec.delay_s
+        if spec.jitter_s:
+            delay += spec.jitter_s * self.uniform(link, n, "jitter")
+        return FaultDecision(False, dup, reorder, delay)
+
+    def schedule(self, link: str, count: int) -> list[FaultDecision]:
+        """The next ``count`` decisions for ``link`` — consumed exactly
+        as ``decide`` would consume them (the unit-test surface for the
+        bit-for-bit reproducibility pin)."""
+        return [self.decide(link) for _ in range(count)]

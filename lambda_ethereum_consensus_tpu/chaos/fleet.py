@@ -1,0 +1,324 @@
+"""In-process node fleet over the real loopback wire + shared fixtures.
+
+Grown from the two-node sync test's embryo (tests/integration/
+test_node.py): the chain-minting and node boot/teardown plumbing lives
+HERE and the integration test consumes it, so the test and the chaos
+harness can never drift apart (ISSUE-14 satellite).  :class:`Fleet`
+boots N full :class:`~..node.BeaconNode`\\ s gossiping over the real
+wire (gossipsub-style mesh + req/resp on real TCP loopback), each
+optionally wrapped in a :class:`~.inject.ChaosPort` carrying a seeded
+fault schedule — partitions, eclipse attempts and competing-fork storms
+become declarative scenario steps instead of bespoke test plumbing.
+
+Head convergence is *observed*, not just asserted: every
+:meth:`Fleet.sample_heads` updates the ``fleet_head_lag_slots`` gauge,
+and a divergence episode's wall-clock duration lands in the
+``fleet_head_divergence_seconds`` histogram (the family behind the
+round-19 ``fleet_divergence_p95`` SLO row) when the members reconverge.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import asynccontextmanager
+from dataclasses import dataclass
+
+from ..config import ChainSpec, minimal_spec, use_chain_spec
+from ..crypto import bls
+from ..fork_choice import get_head
+from ..network.gossip import publish_ssz, topic_name
+from ..node import BeaconNode, NodeConfig
+from ..telemetry import get_metrics
+from ..tracing import get_recorder
+from .faults import FaultScheduler, FaultSpec
+from .inject import ChaosPort
+
+__all__ = [
+    "ChainBundle",
+    "Fleet",
+    "default_keys",
+    "make_chain",
+    "started_node",
+]
+
+
+def default_keys(n: int) -> list[bytes]:
+    """The devnet key recipe shared by the integration test and every
+    chaos scenario (validator ``i`` signs with ``i+1``)."""
+    return [(i + 1).to_bytes(32, "big") for i in range(n)]
+
+
+@dataclass
+class ChainBundle:
+    """A minted devnet chain: genesis + built blocks + signing keys."""
+
+    spec: ChainSpec
+    genesis: object
+    blocks: list
+    tip_state: object
+    sks: list[bytes]
+    genesis_time: int
+
+
+def make_chain(
+    n_keys: int = 64,
+    chain_len: int = 5,
+    spec: ChainSpec | None = None,
+    now: float | None = None,
+) -> ChainBundle:
+    """Genesis (recent wall-clock genesis_time) + ``chain_len`` built
+    blocks — the two-node test's chain fixture, extracted.
+
+    ``genesis_time`` sits just far enough in the past that slots
+    ``1..chain_len+1`` are acceptable now — and stays inside the
+    one-epoch gossip window for as long as possible, so slow machines
+    don't flake gossip assertions.  Callers wanting a fresh wall-clock
+    window (the reason the test fixture is function-scoped) simply call
+    this again.
+    """
+    spec = spec or minimal_spec()
+    sks = default_keys(n_keys)
+    with use_chain_spec(spec):
+        from ..state_transition.genesis import build_genesis_state
+        from ..validator import build_signed_block
+
+        genesis_time = (
+            int(now if now is not None else time.time())
+            - (chain_len + 1) * int(spec.SECONDS_PER_SLOT)
+            - 2
+        )
+        genesis = build_genesis_state(
+            [bls.sk_to_pk(sk) for sk in sks],
+            genesis_time=genesis_time,
+            spec=spec,
+        )
+        blocks = []
+        state = genesis
+        for slot in range(1, chain_len + 1):
+            signed, state = build_signed_block(state, slot, sks, spec=spec)
+            blocks.append(signed)
+    return ChainBundle(spec, genesis, blocks, state, sks, genesis_time)
+
+
+@asynccontextmanager
+async def started_node(config: NodeConfig, spec: ChainSpec):
+    """Boot one node, guarantee teardown — the boot/teardown plumbing
+    every integration test used to inline."""
+    node = BeaconNode(config, spec)
+    await node.start()
+    try:
+        yield node
+    finally:
+        await node.stop()
+
+
+class _ChaosFactory:
+    """Per-node ``port_wrapper``: wraps every (re)built port in a
+    :class:`ChaosPort` carrying the node's seeded fault schedule, and
+    re-applies the current partition state so a sidecar restart cannot
+    silently heal a cut."""
+
+    def __init__(self, faults: FaultScheduler, name: str, peer_names: dict):
+        self.faults = faults
+        self.name = name
+        self.peer_names = peer_names
+        self.blocked: set[bytes] = set()
+        self.port: ChaosPort | None = None
+
+    def __call__(self, port) -> ChaosPort:
+        chaos = ChaosPort(port, self.faults, name=self.name)
+        chaos.peer_names = self.peer_names
+        if self.blocked:
+            chaos.set_partition(self.blocked)
+        self.port = chaos
+        return chaos
+
+    def set_partition(self, blocked: set[bytes]) -> None:
+        self.blocked = set(blocked)
+        if self.port is not None:
+            self.port.set_partition(self.blocked)
+
+
+class Fleet:
+    """N beacon nodes on one loop, gossiping over the real wire.
+
+    ``node 0`` is the bootstrap; later members dial it and learn each
+    other through peer exchange.  With ``fault_spec`` every member's
+    port is chaos-wrapped (seed ``seed + index``, so the fleet-wide
+    schedule derives from one scenario seed)."""
+
+    def __init__(self, bundle: ChainBundle):
+        self.bundle = bundle
+        self.spec = bundle.spec
+        self.nodes: list[BeaconNode] = []
+        self.chaos: list[_ChaosFactory | None] = []
+        self._peer_names: dict[bytes, str] = {}
+        self._diverged_since: float | None = None
+
+    @classmethod
+    async def boot(
+        cls,
+        n: int,
+        bundle: ChainBundle,
+        base_dir: str,
+        *,
+        wire: str | None = None,
+        fault_spec: FaultSpec | None = None,
+        seed: int = 0,
+        subnets: tuple[int, ...] = (0, 1),
+        enable_range_sync: bool = True,
+        seed_chain_on: tuple[int, ...] = (0,),
+    ) -> "Fleet":
+        os.makedirs(base_dir, exist_ok=True)
+        self = cls(bundle)
+        for i in range(n):
+            factory = None
+            if fault_spec is not None:
+                factory = _ChaosFactory(
+                    FaultScheduler(seed + i, fault_spec),
+                    f"n{i}",
+                    self._peer_names,
+                )
+            config = NodeConfig(
+                db_path=f"{base_dir}/fleet_{i}.wal",
+                genesis_state=bundle.genesis,
+                bootnodes=(
+                    [] if not self.nodes
+                    else [f"127.0.0.1:{self.nodes[0].port.listen_port}"]
+                ),
+                enable_range_sync=enable_range_sync and bool(self.nodes),
+                wire=wire,
+                attnet_subnets=subnets,
+                port_wrapper=factory,
+            )
+            node = BeaconNode(config, self.spec)
+            await node.start()
+            self.nodes.append(node)
+            self.chaos.append(factory)
+            if i in seed_chain_on:
+                # seed BEFORE later members boot: range sync negotiates
+                # heads at peer connect, so a joiner must find the chain
+                # already on its bootnode or it will idle at genesis
+                for signed in bundle.blocks:
+                    node.pending.add_block(signed)
+                await node.pending.process_once()
+        for i, node in enumerate(self.nodes):
+            self._peer_names[node.port.node_id] = f"n{i}"
+        return self
+
+    async def stop(self) -> None:
+        for node in reversed(self.nodes):
+            await node.stop()
+
+    # ------------------------------------------------------------- heads
+
+    def heads(self) -> list[bytes]:
+        return [get_head(node.store, self.spec) for node in self.nodes]
+
+    def head_slots(self) -> list[int]:
+        return [
+            int(node.store.blocks[head].slot)
+            for node, head in zip(self.nodes, self.heads())
+        ]
+
+    def sample_heads(self) -> dict:
+        """One convergence observation: updates ``fleet_head_lag_slots``
+        and, on a divergence episode ending, observes its duration into
+        ``fleet_head_divergence_seconds``."""
+        now = time.monotonic()
+        heads = self.heads()
+        slots = self.head_slots()
+        distinct = len(set(heads))
+        lag = float(max(slots) - min(slots)) if slots else 0.0
+        m = get_metrics()
+        m.set_gauge("fleet_head_lag_slots", lag)
+        if distinct > 1:
+            if self._diverged_since is None:
+                self._diverged_since = now
+                get_recorder().record(
+                    "inst", 0, "fleet_diverged",
+                    {"distinct_heads": distinct, "lag_slots": lag},
+                )
+        elif self._diverged_since is not None:
+            duration = now - self._diverged_since
+            self._diverged_since = None
+            m.observe("fleet_head_divergence_seconds", duration)
+            get_recorder().record(
+                "inst", 0, "fleet_reconverged",
+                {"divergence_s": round(duration, 4)},
+            )
+        return {"heads": heads, "distinct": distinct, "lag_slots": lag}
+
+    async def wait_converged(
+        self, timeout_s: float = 60.0, root: bytes | None = None,
+        poll_s: float = 0.2,
+    ) -> bool:
+        """Poll pending-block processing on every member until all heads
+        agree (and match ``root`` when given)."""
+        import asyncio
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            for node in self.nodes:
+                await node.pending.process_once()
+                await node.pending.download_once()
+            # graftlint: disable=async-blocking — uncached head walk over
+            # a devnet-sized store (a handful of blocks), harness-only
+            # convergence polling off the consensus hot path
+            sample = self.sample_heads()
+            if sample["distinct"] == 1 and (
+                root is None or sample["heads"][0] == root
+            ):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            await asyncio.sleep(poll_s)
+
+    # --------------------------------------------------------- partitions
+
+    def partition(self, groups: list[list[int]]) -> None:
+        """Cut the fleet into ``groups`` (lists of node indices): every
+        member blocks every node outside its own group, which makes the
+        cut transitive through relaying sidecars.  Requires chaos
+        wrapping (``fault_spec`` at boot)."""
+        ids = [node.port.node_id for node in self.nodes]
+        group_of = {}
+        for g, members in enumerate(groups):
+            for i in members:
+                group_of[i] = g
+        for i, factory in enumerate(self.chaos):
+            if factory is None:
+                raise RuntimeError("partition needs a chaos-wrapped fleet")
+            blocked = {
+                ids[j]
+                for j in range(len(self.nodes))
+                if j != i and group_of.get(j) != group_of.get(i)
+            }
+            factory.set_partition(blocked)
+
+    def heal(self) -> None:
+        for factory in self.chaos:
+            if factory is not None:
+                factory.set_partition(set())
+
+    # ------------------------------------------------------------ gossip
+
+    async def publish_block(self, publisher: int, signed) -> bytes:
+        """Import ``signed`` locally on ``publisher`` and gossip it to
+        the fleet; returns the block root."""
+        node = self.nodes[publisher]
+        node.pending.add_block(signed)
+        await node.pending.process_once()
+        digest = node.chain.fork_digest()
+        await publish_ssz(
+            node.port, topic_name(digest, "beacon_block"), signed, self.spec
+        )
+        return signed.message.hash_tree_root(self.spec)
+
+    async def publish_raw(self, publisher: int, topic_short: str, value) -> None:
+        node = self.nodes[publisher]
+        digest = node.chain.fork_digest()
+        await publish_ssz(
+            node.port, topic_name(digest, topic_short), value, self.spec
+        )

@@ -1,0 +1,228 @@
+"""ChaosPort: the fault-injection seam around a live network ``Port``.
+
+Wraps the host side of the sidecar control channel transparently — the
+node wires handlers and issues commands exactly as against a bare
+:class:`~..network.port.Port` — while applying the seeded fault schedule
+(:mod:`.faults`) to the message flow:
+
+- **inbound gossip**: each subscription's handler is wrapped; per
+  message the link's :class:`FaultDecision` may drop it (IGNOREd so the
+  sidecar forgets the id), duplicate it, hold it for one-message
+  reordering, or delay delivery by the scheduled latency+jitter.
+- **outbound publishes**: the egress link's decisions drop, duplicate
+  or delay whole publishes.
+- **partitions**: a blocked-peer set enforced on inbound gossip AND
+  req/resp (both directions of the host's view) — the fleet applies the
+  complement sets on every member, which makes group partitions
+  transitive even through relaying sidecars (a relay that never accepts
+  a message never forwards it).
+- **sidecar stall/restart**: kills the sidecar subprocess outright, so
+  the node's ``on_exit`` restart supervisor is exercised by the real
+  death path, not a simulation.
+
+Every injected fault is observable: ``chaos_fault_injected_total{kind}``
+counts it, partition/stall state changes land as flight-recorder
+instants, and the per-port ``fault_counts`` feed the scenario artifact.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import Counter
+
+from ..network.port import VERDICT_IGNORE, PortError
+from ..telemetry import get_metrics
+from ..tracing import get_recorder
+from .faults import FaultScheduler
+
+__all__ = ["ChaosPort"]
+
+log = logging.getLogger("chaos")
+
+# a held (reordered) message is force-flushed after this much silence on
+# its link, so reordering can never blackhole the final message of a burst
+HOLD_FLUSH_S = 0.25
+
+# attributes the node assigns on its port; forwarded to the inner Port so
+# the read loop dispatches to the real handlers
+_FORWARDED_ATTRS = frozenset({"on_new_peer", "on_peer_gone", "on_exit"})
+
+
+class ChaosPort:
+    """A transparent fault-injecting wrapper over one node's ``Port``."""
+
+    def __init__(self, port, faults: FaultScheduler, name: str = "node"):
+        object.__setattr__(self, "_port", port)
+        self._faults = faults
+        self.name = name
+        self._blocked: set[bytes] = set()
+        # peer node_id -> stable link label (fleet fills this in so the
+        # fault schedule keys on deterministic names, not random ids)
+        self.peer_names: dict[bytes, str] = {}
+        self._held: dict[str, tuple] = {}
+        self.fault_counts: Counter = Counter()
+
+    # ------------------------------------------------------- delegation
+
+    def __getattr__(self, name):
+        return getattr(self._port, name)
+
+    def __setattr__(self, name, value):
+        if name in _FORWARDED_ATTRS:
+            setattr(self._port, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    # ------------------------------------------------------ observation
+
+    def _record(self, kind: str, **args) -> None:
+        self.fault_counts[kind] += 1
+        get_metrics().inc("chaos_fault_injected_total", kind=kind)
+        get_recorder().record(
+            "inst", 0, "chaos_fault", {"kind": kind, "node": self.name, **args}
+        )
+
+    def _link(self, peer_id: bytes) -> str:
+        return f"{self.name}<-{self.peer_names.get(peer_id, 'peer')}"
+
+    # -------------------------------------------------------- partition
+
+    def set_partition(self, blocked: set[bytes]) -> None:
+        """Enforce a partition: inbound gossip and req/resp involving
+        ``blocked`` peers is refused until :meth:`heal`."""
+        self._blocked = set(blocked)
+        get_metrics().set_gauge(
+            "chaos_partition_active",
+            1.0 if self._blocked else 0.0,
+            node=self.name,
+        )
+        get_recorder().record(
+            "inst", 0, "chaos_partition",
+            {"node": self.name, "blocked": len(self._blocked)},
+        )
+
+    def heal(self) -> None:
+        self.set_partition(set())
+
+    @property
+    def partitioned(self) -> bool:
+        return bool(self._blocked)
+
+    # ----------------------------------------------------- sidecar stall
+
+    async def stall_sidecar(self) -> None:
+        """Kill the sidecar subprocess — the real unexpected-death path:
+        the read loop dies, pending futures fail, and the node's
+        ``on_exit`` supervisor rebuilds the network (re-wrapped through
+        the same ``port_wrapper`` seam)."""
+        self._record("sidecar_stall")
+        proc = self._port._proc
+        if proc is not None and proc.returncode is None:
+            proc.kill()
+
+    # ---------------------------------------------------------- inbound
+
+    async def subscribe(self, topic: str, handler) -> None:
+        await self._port.subscribe(topic, self._wrap_handler(handler))
+
+    def _wrap_handler(self, handler):
+        async def chaotic(topic, msg_id, payload, peer_id):
+            if peer_id in self._blocked:
+                self._record("partition_drop")
+                await self._ignore(msg_id)
+                return
+            link = self._link(peer_id)
+            decision = self._faults.decide(link)
+            if decision.drop:
+                self._record("drop")
+                await self._ignore(msg_id)
+                return
+            if decision.delay_s > 0:
+                self.fault_counts["delay"] += 1
+                get_metrics().inc("chaos_fault_injected_total", kind="delay")
+                await asyncio.sleep(decision.delay_s)
+            if decision.reorder and link not in self._held:
+                # hold THIS message; it rides behind the link's next one
+                # (or the flush timer, so a burst's tail cannot hang)
+                self._record("reorder")
+                held = (handler, (topic, msg_id, payload, peer_id))
+                self._held[link] = held
+                loop = asyncio.get_running_loop()
+                loop.call_later(HOLD_FLUSH_S, self._flush_held, link, held)
+                return
+            await self._deliver(handler, topic, msg_id, payload, peer_id)
+            released = self._held.pop(link, None)
+            if released is not None:
+                r_handler, r_args = released
+                await self._deliver(r_handler, *r_args)
+            if decision.dup:
+                self._record("dup")
+                await self._deliver(handler, topic, msg_id, payload, peer_id)
+
+        return chaotic
+
+    def _flush_held(self, link: str, held: tuple) -> None:
+        if self._held.get(link) is not held:
+            return  # already released behind a later message
+        del self._held[link]
+        handler, args = held
+        task = asyncio.ensure_future(self._deliver(handler, *args))
+        task.add_done_callback(_log_task_exception)
+
+    async def _deliver(self, handler, topic, msg_id, payload, peer_id):
+        value = handler(topic, msg_id, payload, peer_id)
+        if asyncio.iscoroutine(value):
+            await value
+
+    async def _ignore(self, msg_id: bytes) -> None:
+        try:
+            await self._port.validate_message(msg_id, VERDICT_IGNORE)
+        except PortError:
+            pass  # sidecar died mid-fault; its seen-cache expires the id
+
+    # --------------------------------------------------------- outbound
+
+    async def publish(self, topic: str, payload: bytes) -> None:
+        decision = self._faults.decide(f"{self.name}->out")
+        if decision.drop:
+            self._record("drop")
+            return
+        if decision.delay_s > 0:
+            self.fault_counts["delay"] += 1
+            get_metrics().inc("chaos_fault_injected_total", kind="delay")
+            await asyncio.sleep(decision.delay_s)
+        await self._port.publish(topic, payload)
+        if decision.dup:
+            self._record("dup")
+            await self._port.publish(topic, payload)
+
+    # ---------------------------------------------------------- req/resp
+
+    async def send_request(
+        self, peer_id: bytes, protocol_id: str, payload: bytes,
+        timeout_ms: int = 15000,
+    ) -> bytes:
+        if peer_id in self._blocked:
+            self._record("partition_req_block")
+            raise PortError("chaos partition: peer unreachable")
+        return await self._port.send_request(
+            peer_id, protocol_id, payload, timeout_ms
+        )
+
+    async def set_request_handler(self, protocol_id: str, handler) -> None:
+        async def gated(protocol, request_id, payload, peer_id):
+            if peer_id in self._blocked:
+                # no response: the remote times out, as across a real cut
+                self._record("partition_req_block")
+                return
+            value = handler(protocol, request_id, payload, peer_id)
+            if asyncio.iscoroutine(value):
+                await value
+
+        await self._port.set_request_handler(protocol_id, gated)
+
+
+def _log_task_exception(task: asyncio.Task) -> None:
+    if not task.cancelled() and task.exception() is not None:
+        log.error("chaos held-message flush failed", exc_info=task.exception())

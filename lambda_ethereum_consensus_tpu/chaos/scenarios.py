@@ -1,0 +1,878 @@
+"""Slot-clocked soak profiles: steady, storm, partition, equivocation, churn.
+
+Each scenario replays seeded, slot-clocked load against REAL components
+— the priority ingest scheduler, full beacon-node fleets gossiping over
+the loopback wire — with faults injected through the deterministic
+chaos layer (:mod:`.faults`/:mod:`.inject`), and asserts *recovery*,
+not just survival:
+
+- every injected fault must be observable afterwards in the
+  ``chaos_fault_injected_total`` counters (a fault the metrics cannot
+  see is a fault a production operator cannot diagnose);
+- after each fault window the burn rates must come back under threshold
+  and the fleet must reconverge on ONE head within the scenario's
+  budgeted slot count — the wall time lands in
+  ``chaos_recovery_seconds``, the family behind the round-19
+  ``chaos_recovery_p95`` SLO row.
+
+Scenarios run on a devnet chain spec with shortened slots
+(:data:`SOAK_SECONDS_PER_SLOT`), so "minutes of slot-clocked load" fits
+a CI smoke budget while the cadence — arrivals paced into slots, blocks
+built at their own wall-clock slots, publication waiting on slot
+boundaries — stays real.  ``scripts/soak_check.py`` drives the catalogue
+and writes the pass/fail artifact; the final budget gate is one
+:class:`~..slo.SloEngine` evaluation over :data:`~..slo.SOAK_SLOS`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+from ..config import minimal_spec, use_chain_spec
+from ..pipeline import IngestScheduler, LaneConfig
+from ..slo import SloEngine
+from ..telemetry import get_metrics
+from ..tracing import (
+    SlotClock,
+    new_trace,
+    observe_block_arrival,
+    observe_head_update,
+    record_verify_batch,
+)
+from .faults import FaultScheduler, FaultSpec
+from .fleet import Fleet, make_chain
+
+__all__ = ["SCENARIOS", "ScenarioContext", "run_scenario", "soak_spec"]
+
+# Shortened slots for the soak devnet: cadence stays slot-shaped while a
+# five-scenario smoke fits ~2 minutes.  The full profile scales slot
+# counts, not the slot length.
+SOAK_SECONDS_PER_SLOT = 2
+
+# Burn-rate windows for the soak engine, sized to the soak slot length
+# (the node's 60/300 s SRE windows would make "burn back under
+# threshold" undetectable inside a CI smoke run).
+SOAK_WINDOWS = (("fast", 2.0), ("slow", 6.0))
+
+_FAULT_COUNTER = "chaos_fault_injected_total"
+
+
+def soak_spec():
+    """The minimal preset with soak-length slots."""
+    return minimal_spec().replace(SECONDS_PER_SLOT=SOAK_SECONDS_PER_SLOT)
+
+
+def _count_fault(kind: str) -> None:
+    """Harness-injected faults (adversarial payloads, pipeline-level
+    chaos) count on the same family as the transport layer's, so ONE
+    counter family answers "what was injected" for the whole run."""
+    get_metrics().inc(_FAULT_COUNTER, kind=kind)
+
+
+def _fault_totals(kinds) -> dict[str, float]:
+    m = get_metrics()
+    return {kind: m.get(_FAULT_COUNTER, kind=kind) for kind in kinds}
+
+
+@dataclass
+class ScenarioContext:
+    """Shared run state: one seed, one engine, one artifact dir."""
+
+    seed: int
+    smoke: bool
+    engine: SloEngine
+    base_dir: str
+    violations: list = field(default_factory=list)
+
+    def violation(self, scenario: str, reason: str, observed=None, budget=None):
+        self.violations.append({
+            "slo": f"soak_{scenario}",
+            "series": _FAULT_COUNTER,
+            "window": "scenario",
+            "quantile": 1.0,
+            "observed": observed,
+            "budget": budget,
+            "count": 0,
+            "reason": reason,
+        })
+
+
+# --------------------------------------------------------------- pipeline
+
+class _SoakSink:
+    """Lane flush target terminating item traces through the real batch
+    fan-in (fills ``attestation_admit_apply_seconds``), with a small
+    modeled verify cost so backlog under storm is real queueing."""
+
+    def __init__(self, name: str, per_batch_s: float = 0.0005,
+                 per_item_s: float = 5e-6):
+        self.name = name
+        self.per_batch_s = per_batch_s
+        self.per_item_s = per_item_s
+        self.processed = 0
+        self.sheds = 0
+
+    async def process(self, items):
+        self.processed += len(items)
+        traces = [trace for trace, _seq in items]
+        t0 = time.monotonic()
+        cost = self.per_batch_s + self.per_item_s * len(items)
+        if cost > 0:
+            await asyncio.sleep(cost)
+        record_verify_batch(
+            traces, [None] * len(items), "soak", t0, time.monotonic() - t0
+        )
+        for trace in traces:
+            if trace is not None:
+                trace.end("done")
+
+    async def shed(self, item, reason: str = "overload"):
+        self.sheds += 1
+        trace = item[0]
+        if trace is not None:
+            trace.end("shed", {"reason": reason})
+
+
+def _build_scheduler(max_items: int | None = None) -> IngestScheduler:
+    sched = IngestScheduler(
+        metrics=get_metrics(), max_items=max_items, degraded_window_s=2.0
+    )
+    sched.add_lane(LaneConfig(
+        name="block", priority=0, weight=64, max_batch=64, max_queue=1024,
+        deadline_s=0.025, coalesce_target=1, shed_newest=True,
+    ))
+    sched.add_lane(LaneConfig(
+        name="aggregate", priority=1, weight=512, max_batch=512,
+        max_queue=2048, deadline_s=0.05, coalesce_target=64,
+    ))
+    sched.add_lane(LaneConfig(
+        name="subnet", priority=2, weight=512, max_batch=512,
+        max_queue=2048, deadline_s=0.05, coalesce_target=64,
+    ))
+    return sched
+
+
+async def _slot_feed(
+    sched: IngestScheduler,
+    sinks: dict,
+    faults: FaultScheduler,
+    slots: int,
+    slot_s: float,
+    rates: dict,
+    storm_window: tuple[int, int] | None = None,
+    storm_mult: int = 1,
+) -> None:
+    """Paced, slot-clocked submission with seeded per-item chaos.
+
+    ``rates`` are items/slot per lane; inside ``storm_window`` the
+    subnet lane floods at ``storm_mult`` times its rate.  Chaos applies
+    at admission: drop (never submitted), dup (submitted twice),
+    reorder (one message held behind its successor), delay (link
+    latency, carried into the tick pacing — the feeder must NOT await
+    per delayed item, or a storm slot's thousands of messages would
+    serialize through the sleeps and the flood could never outrun the
+    sink).  Every fault counts on the chaos counter family.
+    """
+    seq = 0
+    held: dict[str, int] = {}
+    delay_carry = 0.0
+
+    def submit_one(lane: str, item_id: int) -> list:
+        trace = new_trace(f"soak:{lane}")
+        return sched.submit(lane, (trace, item_id), sinks[lane], trace=trace)
+
+    async def submit(lane: str, n: int) -> None:
+        nonlocal seq, delay_carry
+        for _ in range(n):
+            decision = faults.decide(f"ingest:{lane}")
+            item_id = seq
+            seq += 1
+            if decision.drop:
+                _count_fault("drop")
+                continue
+            if decision.delay_s > 0:
+                _count_fault("delay")
+                delay_carry += decision.delay_s
+            if decision.reorder and lane not in held:
+                _count_fault("reorder")
+                held[lane] = item_id
+                continue
+            ids = [item_id]
+            if lane in held:
+                ids.append(held.pop(lane))
+            if decision.dup:
+                _count_fault("dup")
+                ids.append(item_id)
+            for one in ids:
+                for src, item, reason in submit_one(lane, one):
+                    await src.shed(item, reason)
+
+    tick_s = 0.01
+    for slot in range(slots):
+        slot_end = time.monotonic() + slot_s
+        mult = (
+            storm_mult
+            if storm_window is not None
+            and storm_window[0] <= slot < storm_window[1]
+            else 1
+        )
+        per_slot = {
+            "block": rates["block"],
+            "aggregate": rates["aggregate"],
+            "subnet": rates["subnet"] * mult,
+        }
+        credit = {lane: 0.0 for lane in per_slot}
+        ticks = max(1, int(slot_s / tick_s))
+        while (now := time.monotonic()) < slot_end:
+            for lane, rate in per_slot.items():
+                credit[lane] += rate / ticks
+                n, credit[lane] = int(credit[lane]), credit[lane] % 1.0
+                if n:
+                    await submit(lane, n)
+            # the tick absorbs the scheduled link latency (capped by the
+            # slot boundary through the outer while) instead of awaiting
+            # it per message inside submit
+            extra, delay_carry = min(delay_carry, tick_s), 0.0
+            await asyncio.sleep(
+                max(0.0, tick_s - (time.monotonic() - now)) + extra
+            )
+    # release any message still held for reordering
+    for lane, item_id in list(held.items()):
+        for src, item, reason in submit_one(lane, item_id):
+            await src.shed(item, reason)
+        del held[lane]
+
+
+async def _snapshotting(engine: SloEngine, coro):
+    """Run ``coro`` with 250 ms engine burn-rate snapshots alongside."""
+
+    async def snapshotter():
+        while True:
+            await asyncio.sleep(0.25)
+            engine.tick()
+
+    snap = asyncio.ensure_future(snapshotter())
+    try:
+        return await coro
+    finally:
+        snap.cancel()
+
+
+def _replay_slot_phases(n_slots: int, seed: int) -> int:
+    """The recorded arrival schedule (explicit instants, seeded through
+    the same hash stream as the fault layer) — the bulk of the
+    slot-phase distributions, so the handful of honest catch-up
+    observations from the fleet scenarios cannot define the cumulative
+    p95 on their own."""
+    draws = FaultScheduler(seed, FaultSpec())
+    sps = SOAK_SECONDS_PER_SLOT
+    clock = SlotClock(1_700_000_000, sps)
+    for slot in range(n_slots):
+        arrival = clock.slot_start(slot) + 0.15 + 0.8 * sps * draws.uniform(
+            "phase", slot, "arrival"
+        )
+        observe_block_arrival(clock, slot, now=arrival)
+        observe_head_update(
+            clock, slot,
+            now=arrival + 0.1 + 0.4 * sps * draws.uniform("phase", slot, "head"),
+        )
+    return n_slots
+
+
+def _ingest_breaching(engine: SloEngine) -> bool:
+    report = engine.evaluate(emit=False, snapshot=False)
+    watched = {
+        "attestation_admit_apply_p95", "ingest_lane_wait_p95",
+        "ingest_sched_p99",
+    }
+    return any(
+        row["breaching"] for row in report["slos"] if row["slo"] in watched
+    )
+
+
+def _observe_recovery(ctx: ScenarioContext, scenario: str, recovery_s: float,
+                      budget_slots: int, recovered: bool) -> dict:
+    """Record one recovery measurement (good or bad — the SLO row must
+    see the bad tail too) and judge the slot budget."""
+    get_metrics().observe("chaos_recovery_seconds", recovery_s)
+    slot_s = float(SOAK_SECONDS_PER_SLOT)
+    if not recovered or recovery_s > budget_slots * slot_s:
+        ctx.violation(
+            scenario,
+            f"recovery took {recovery_s:.1f}s, over the budgeted "
+            f"{budget_slots} slots ({budget_slots * slot_s:.1f}s)"
+            + ("" if recovered else " — and never completed"),
+            observed=recovery_s, budget=budget_slots * slot_s,
+        )
+        recovered = False
+    return {
+        "recovered": recovered,
+        "recovery_s": round(recovery_s, 3),
+        "recovery_slots": max(1, int(recovery_s / slot_s) + 1),
+        "recovery_budget_slots": budget_slots,
+    }
+
+
+async def _wait_for_slot(node, min_slot: int, spec) -> int:
+    """Sleep until the wall clock reaches ``min_slot`` (the store's tick
+    loop advances its time once a second); returns the current slot."""
+    while node.store.current_slot(spec) < min_slot:
+        await asyncio.sleep(0.15)
+    return int(node.store.current_slot(spec))
+
+
+async def _publish_until_seen(
+    fleet: Fleet, publisher: int, signed, timeout_s: float = 12.0
+) -> bytes:
+    """Publish a block and re-publish until every non-partitioned member
+    holds it (gossip over a lossy/healing mesh may need the repeat; the
+    sidecar's publish path forwards unconditionally)."""
+    root = signed.message.hash_tree_root(fleet.spec)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        await fleet.publish_block(publisher, signed)
+        await asyncio.sleep(0.3)
+        missing = False
+        for i, node in enumerate(fleet.nodes):
+            factory = fleet.chaos[i]
+            if factory is not None and factory.blocked:
+                continue  # partitioned member: not expected to see it
+            await node.pending.process_once()
+            if root not in node.store.blocks:
+                missing = True
+        if not missing or time.monotonic() >= deadline:
+            return root
+
+
+# --------------------------------------------------------------- scenarios
+
+async def _steady(ctx: ScenarioContext) -> dict:
+    """Sustained mainnet-shaped cadence, zero injected faults: the
+    control run — no sheds, no degraded episodes, budgets green."""
+    slots = 4 if ctx.smoke else 30
+    slot_s = float(SOAK_SECONDS_PER_SLOT)
+    sched = _build_scheduler()
+    sinks = {name: _SoakSink(name) for name in ("block", "aggregate", "subnet")}
+    faults = FaultScheduler(ctx.seed, FaultSpec())  # inert: the control
+    sched.start()
+    try:
+        await _snapshotting(ctx.engine, _slot_feed(
+            sched, sinks, faults, slots, slot_s,
+            rates={"block": 2, "aggregate": 150, "subnet": 400},
+        ))
+        await asyncio.sleep(0.2)  # deadline flushes drain the tail
+    finally:
+        await sched.stop()
+    _replay_slot_phases(1024 if ctx.smoke else 4096, ctx.seed)
+    sheds = sum(sink.sheds for sink in sinks.values())
+    ok = sheds == 0
+    if not ok:
+        ctx.violation("steady", f"{sheds} sheds under steady-state load")
+    return {
+        "scenario": "steady", "ok": ok, "slots": slots,
+        "processed": sum(s.processed for s in sinks.values()),
+        "sheds": sheds, "faults": {},
+    }
+
+
+async def _storm(ctx: ScenarioContext) -> dict:
+    """A mid-run 64-subnet-shaped flood against a deliberately small
+    admission budget: sheds engage, the degraded latch flips ON once,
+    the flood ends, the latch releases ONCE, and the burn rates come
+    back under threshold within the recovery budget."""
+    slots = 8 if ctx.smoke else 40
+    storm_window = (2, 4) if ctx.smoke else (8, 20)
+    slot_s = float(SOAK_SECONDS_PER_SLOT)
+    m = get_metrics()
+    enter0 = m.get("ingest_degraded_transitions_total", edge="enter")
+    exit0 = m.get("ingest_degraded_transitions_total", edge="exit")
+    fault_kinds = ("drop", "dup", "reorder", "delay")
+    faults_before = _fault_totals(fault_kinds)
+    sched = _build_scheduler(max_items=1024)
+    # the storm's sinks model a verify plane saturating around ~2k
+    # items/s: comfortably above the steady cadence (so the latch stays
+    # quiet outside the window) but far under the 40x flood, so the
+    # backlog is real queueing and admission control MUST engage
+    sinks = {
+        name: _SoakSink(name, per_item_s=5e-4)
+        for name in ("block", "aggregate", "subnet")
+    }
+    faults = FaultScheduler(
+        ctx.seed + 1,
+        FaultSpec(drop=0.05, dup=0.05, reorder=0.05, jitter_s=0.01),
+    )
+    sched.start()
+    try:
+        async def storm_and_recover():
+            await _slot_feed(
+                sched, sinks, faults, slots, slot_s,
+                rates={"block": 2, "aggregate": 150, "subnet": 400},
+                storm_window=storm_window, storm_mult=40,
+            )
+            budget_slots = 6 if ctx.smoke else 10
+            t0 = time.monotonic()
+            deadline = t0 + budget_slots * slot_s
+            while True:
+                if (
+                    not sched.degraded.active(time.monotonic())
+                    and not _ingest_breaching(ctx.engine)
+                ):
+                    return _observe_recovery(
+                        ctx, "storm", time.monotonic() - t0, budget_slots,
+                        recovered=True,
+                    )
+                if time.monotonic() >= deadline:
+                    return _observe_recovery(
+                        ctx, "storm", time.monotonic() - t0, budget_slots,
+                        recovered=False,
+                    )
+                await asyncio.sleep(0.25)
+
+        recovery = await _snapshotting(ctx.engine, storm_and_recover())
+        # one more drain pass so the exit edge (detected inside the
+        # loop's _update_degraded) is definitely counted before stop
+        await asyncio.sleep(0.1)
+    finally:
+        await sched.stop()
+    sheds = sum(sink.sheds for sink in sinks.values())
+    enter_d = m.get("ingest_degraded_transitions_total", edge="enter") - enter0
+    exit_d = m.get("ingest_degraded_transitions_total", edge="exit") - exit0
+    injected = {
+        kind: m.get(_FAULT_COUNTER, kind=kind) - before
+        for kind, before in faults_before.items()
+    }
+    ok = recovery["recovered"]
+    if sheds == 0:
+        ok = False
+        ctx.violation("storm", "the storm produced zero sheds — the flood "
+                               "never exercised admission control")
+    if enter_d != 1 or exit_d != 1:
+        ok = False
+        ctx.violation(
+            "storm",
+            f"degraded latch edges enter={enter_d} exit={exit_d}; "
+            "expected exactly one of each for one storm window",
+        )
+    missing = [kind for kind, delta in injected.items() if delta <= 0]
+    if missing:
+        ok = False
+        ctx.violation("storm", f"injected fault kinds unobserved: {missing}")
+    return {
+        "scenario": "storm", "ok": ok, "slots": slots,
+        "storm_window": list(storm_window), "sheds": sheds,
+        "degraded_edges": {"enter": enter_d, "exit": exit_d},
+        "faults": injected, **recovery,
+    }
+
+
+def _vote_for(state, slot, root, sks, spec, only_position=None):
+    """A properly signed committee-0 attestation voting ``root``."""
+    from ..state_transition import accessors, misc as st_misc
+    from ..types.beacon import Checkpoint
+    from ..validator.duties import make_attestation
+
+    t_epoch = st_misc.compute_epoch_at_slot(slot, spec)
+    return make_attestation(
+        state, slot, 0, root,
+        Checkpoint(
+            epoch=t_epoch,
+            root=accessors.get_block_root(state, t_epoch, spec),
+        ),
+        Checkpoint(
+            epoch=state.current_justified_checkpoint.epoch,
+            root=bytes(state.current_justified_checkpoint.root),
+        ),
+        sks, spec, only_position=only_position,
+    )
+
+
+async def _equivocation(ctx: ScenarioContext) -> dict:
+    """Adversarial-payload absorption on a live two-node wire: an
+    equivocating block pair, a late orphaned-branch block, malformed
+    and bad-signature aggregates, and a duplicate-vote subnet flood —
+    the fleet must keep accepting honest traffic and converge on the
+    attested head (the attestation-weight reorg trigger)."""
+    from ..state_transition import accessors, misc as st_misc
+    from ..types.validator import AggregateAndProof, SignedAggregateAndProof
+    from ..validator import build_signed_block
+
+    bundle = make_chain(n_keys=64, chain_len=3, spec=soak_spec())
+    spec = bundle.spec
+    injected_kinds = (
+        "equivocation", "late_block", "malformed", "bad_aggregate",
+        "subnet_flood", "wrong_subnet",
+    )
+    before = _fault_totals(injected_kinds)
+    with use_chain_spec(spec):
+        # committee->subnet mapping is pure (slot, index) math at this
+        # registry size: subscribe every subnet committee 0 can land on
+        # plus one it never does (the wrong-subnet REJECT needs a
+        # subscribed topic to be delivered at all)
+        cps = 2  # 64 validators / 8 slots / target 4 => 2 committees
+        needed = sorted({
+            st_misc.compute_subnet_for_attestation(cps, s, 0, spec)
+            for s in range(4 * spec.SLOTS_PER_EPOCH)
+        })
+        wrong_subnet = next(
+            i for i in range(64)
+            if i not in needed
+        )
+        fleet = await Fleet.boot(
+            2, bundle, ctx.base_dir + "/equiv", seed=ctx.seed + 2,
+            subnets=tuple(needed) + (wrong_subnet,),
+        )
+        try:
+            seed_head = bundle.blocks[-1].message.hash_tree_root(spec)
+            assert await fleet.wait_converged(20.0, root=seed_head), (
+                "fleet never converged on the seed chain"
+            )
+            # honest head at the next wall slot + an equivocating twin
+            cur = await _wait_for_slot(
+                fleet.nodes[0], int(bundle.tip_state.slot) + 1, spec
+            )
+            honest, _post = build_signed_block(
+                bundle.tip_state, cur, bundle.sks, spec=spec
+            )
+            twin, _ = build_signed_block(
+                bundle.tip_state, cur, bundle.sks,
+                graffiti=b"\x42" * 32, spec=spec,
+            )
+            _count_fault("equivocation")
+            honest_root = await _publish_until_seen(fleet, 0, honest)
+            await fleet.publish_block(0, twin)
+            # late/orphaned: a competing block back at slot 1 (absorbed,
+            # never the head)
+            late, _ = build_signed_block(
+                bundle.genesis, 1, bundle.sks, graffiti=b"\x13" * 32,
+                spec=spec,
+            )
+            _count_fault("late_block")
+            await fleet.publish_block(0, late)
+            # malformed aggregate: undecodable bytes on the wire topic
+            from ..network.gossip import topic_name
+            _count_fault("malformed")
+            digest = fleet.nodes[0].chain.fork_digest()
+            await fleet.nodes[0].port.publish(
+                topic_name(digest, "beacon_aggregate_and_proof"),
+                b"\xff\x00garbage-not-snappy",
+            )
+            # over-aggressive aggregate: well-formed container, tampered
+            # signature — REJECT polarity through the real batched verify
+            state_h = fleet.nodes[0].store.block_states[honest_root]
+            good_vote = _vote_for(state_h, cur, honest_root, bundle.sks, spec)
+            bad_agg = SignedAggregateAndProof(
+                message=AggregateAndProof(
+                    aggregator_index=0,
+                    aggregate=good_vote.copy(signature=b"\x11" * 96),
+                    selection_proof=b"\x00" * 96,
+                ),
+                signature=b"\x00" * 96,
+            )
+            _count_fault("bad_aggregate")
+            await fleet.publish_raw(0, "beacon_aggregate_and_proof", bad_agg)
+            # subnet traffic: distinct single-bit votes for the honest
+            # twin from BOTH ends (a node's own publishes never loop
+            # back, so each side must hear the weight from its peer),
+            # a duplicate-cell double vote (IGNORE), and a wrong-subnet
+            # copy (the committee mapping REJECT)
+            att_subnet = st_misc.compute_subnet_for_attestation(
+                accessors.get_committee_count_per_slot(
+                    state_h, st_misc.compute_epoch_at_slot(cur, spec), spec
+                ),
+                cur, 0, spec,
+            )
+            votes = [
+                _vote_for(state_h, cur, honest_root, bundle.sks, spec,
+                          only_position=i)
+                for i in range(4)  # committee size at this registry
+            ]
+            topic = f"beacon_attestation_{att_subnet}"
+            # a node's own publishes never loop back, and an identical
+            # payload published from both ends would dedup by message id
+            # — so SPLIT the committee: node 0 gossips positions 0-1
+            # (heard by node 1), node 1 gossips 2-3 (heard by node 0),
+            # and BOTH members accumulate honest LMD weight
+            for i, vote in enumerate(votes):
+                _count_fault("subnet_flood")
+                await fleet.publish_raw(0 if i < 2 else 1, topic, vote)
+            twin_vote = _vote_for(
+                state_h, cur, twin.message.hash_tree_root(spec),
+                bundle.sks, spec, only_position=0,
+            )
+            _count_fault("subnet_flood")  # double vote: same cell, IGNOREd
+            await fleet.publish_raw(0, topic, twin_vote)
+            _count_fault("wrong_subnet")
+            await fleet.publish_raw(
+                0, f"beacon_attestation_{wrong_subnet}", votes[0]
+            )
+            # the weight votes settle the equivocation on every member
+            t0 = time.monotonic()
+            converged = await fleet.wait_converged(16.0, root=honest_root)
+            recovery = _observe_recovery(
+                ctx, "equivocation", time.monotonic() - t0,
+                budget_slots=6, recovered=converged,
+            )
+            heads = fleet.heads()
+            late_root = late.message.hash_tree_root(spec)
+            ok = recovery["recovered"]
+            if not converged:
+                ctx.violation(
+                    "equivocation",
+                    "fleet did not converge on the attested honest head "
+                    f"(heads={[h.hex()[:12] for h in heads]})",
+                )
+            if late_root in heads:
+                ok = False
+                ctx.violation(
+                    "equivocation", "an orphaned late block became a head"
+                )
+        finally:
+            await fleet.stop()
+    injected = {
+        kind: get_metrics().get(_FAULT_COUNTER, kind=kind) - before[kind]
+        for kind in injected_kinds
+    }
+    missing = [kind for kind, delta in injected.items() if delta <= 0]
+    if missing:
+        ok = False
+        ctx.violation(
+            "equivocation", f"injected fault kinds unobserved: {missing}"
+        )
+    return {
+        "scenario": "equivocation", "ok": ok,
+        "faults": injected, "converged_root": honest_root.hex(),
+        **recovery,
+    }
+
+
+async def _partition(ctx: ScenarioContext) -> dict:
+    """The >=3-node acceptance scenario: a seeded partition isolates one
+    member while the majority side extends the chain over the real wire;
+    on heal the laggard back-fills the missing blocks through req/resp
+    and the fleet reconverges on ONE head within the recovery budget."""
+    from ..validator import build_signed_block
+
+    bundle = make_chain(n_keys=64, chain_len=3, spec=soak_spec())
+    spec = bundle.spec
+    link_spec = FaultSpec(dup=0.05, reorder=0.05, delay_s=0.005,
+                          jitter_s=0.01)
+    kinds = ("partition_drop", "dup", "reorder", "delay")
+    before = _fault_totals(kinds)
+    with use_chain_spec(spec):
+        fleet = await Fleet.boot(
+            3, bundle, ctx.base_dir + "/part", fault_spec=link_spec,
+            seed=ctx.seed + 3,
+        )
+        try:
+            seed_head = bundle.blocks[-1].message.hash_tree_root(spec)
+            assert await fleet.wait_converged(20.0, root=seed_head), (
+                "fleet never converged on the seed chain"
+            )
+            partition_slots = 2 if ctx.smoke else 6
+            fleet.partition([[0, 1], [2]])
+            tip_state = bundle.tip_state
+            for _ in range(partition_slots):
+                cur = await _wait_for_slot(
+                    fleet.nodes[0], int(tip_state.slot) + 1, spec
+                )
+                signed, tip_state = build_signed_block(
+                    tip_state, cur, bundle.sks, spec=spec
+                )
+                await _publish_until_seen(fleet, 0, signed, timeout_s=6.0)
+                fleet.sample_heads()
+            # the isolated member must NOT have followed
+            diverged = len(set(fleet.heads())) > 1
+            fleet.sample_heads()
+            fleet.heal()
+            t_heal = time.monotonic()
+            # one more slot-clocked block after healing: its gossip
+            # arrival hands the laggard a descendant whose ancestors it
+            # back-fills through the (now unblocked) req/resp path
+            cur = await _wait_for_slot(
+                fleet.nodes[0], int(tip_state.slot) + 1, spec
+            )
+            signed, tip_state = build_signed_block(
+                tip_state, cur, bundle.sks, spec=spec
+            )
+            final_root = await _publish_until_seen(fleet, 0, signed)
+            budget_slots = 8 if ctx.smoke else 12
+            converged = await fleet.wait_converged(
+                budget_slots * float(SOAK_SECONDS_PER_SLOT), root=final_root
+            )
+            recovery = _observe_recovery(
+                ctx, "partition", time.monotonic() - t_heal, budget_slots,
+                recovered=converged,
+            )
+            ok = diverged and recovery["recovered"]
+            if not diverged:
+                ctx.violation(
+                    "partition",
+                    "the partition never diverged the fleet — the cut "
+                    "was not enforced",
+                )
+            if not converged:
+                ctx.violation(
+                    "partition",
+                    "fleet members did not reconverge on one head after "
+                    f"healing (heads={[h.hex()[:12] for h in fleet.heads()]})",
+                )
+        finally:
+            await fleet.stop()
+    m = get_metrics()
+    injected = {k: m.get(_FAULT_COUNTER, kind=k) - before[k] for k in kinds}
+    if injected["partition_drop"] <= 0:
+        ok = False
+        ctx.violation(
+            "partition", "no partition_drop faults observed — the chaos "
+                         "layer never enforced the cut",
+        )
+    return {
+        "scenario": "partition", "ok": ok, "nodes": 3,
+        "partition_slots": partition_slots, "diverged": diverged,
+        "faults": injected, "final_root": final_root.hex(), **recovery,
+    }
+
+
+async def _churn(ctx: ScenarioContext) -> dict:
+    """Sidecar stall/restart + checkpoint-sync + resume-from-db churn:
+    the supervisor restarts the dead sidecar, the restarted member keeps
+    following the chain, a checkpoint-synced joiner anchors off a live
+    member's API, and a full node restart resumes from its WAL."""
+    from ..node import BeaconNode, NodeConfig
+    from ..validator import build_signed_block
+
+    bundle = make_chain(n_keys=64, chain_len=3, spec=soak_spec())
+    spec = bundle.spec
+    before = _fault_totals(("sidecar_stall",))
+    with use_chain_spec(spec):
+        fleet = await Fleet.boot(
+            2, bundle, ctx.base_dir + "/churn", fault_spec=FaultSpec(),
+            seed=ctx.seed + 4,
+        )
+        ok = True
+        try:
+            seed_head = bundle.blocks[-1].message.hash_tree_root(spec)
+            assert await fleet.wait_converged(20.0, root=seed_head), (
+                "fleet never converged on the seed chain"
+            )
+            # kill the follower's sidecar mid-run; the node's on_exit
+            # supervisor rebuilds the network (1 s backoff) and the
+            # port_wrapper seam re-wraps the fresh port
+            t_stall = time.monotonic()
+            await fleet.chaos[1].port.stall_sidecar()
+            await asyncio.sleep(1.6)  # supervisor backoff + rebuild
+            restarts = fleet.nodes[1].metrics.get("sidecar_restarts")
+            if restarts < 1:
+                ok = False
+                ctx.violation(
+                    "churn", "sidecar stall did not trigger the restart "
+                             f"supervisor (sidecar_restarts={restarts})",
+                )
+            # the restarted member must keep following gossip
+            cur = await _wait_for_slot(
+                fleet.nodes[0], int(bundle.tip_state.slot) + 1, spec
+            )
+            signed, _post = build_signed_block(
+                bundle.tip_state, cur, bundle.sks, spec=spec
+            )
+            root = await _publish_until_seen(fleet, 0, signed, timeout_s=16.0)
+            followed = await fleet.wait_converged(8.0, root=root)
+            recovery = _observe_recovery(
+                ctx, "churn", time.monotonic() - t_stall, budget_slots=10,
+                recovered=followed and root in fleet.nodes[1].store.blocks,
+            )
+            ok = ok and recovery["recovered"]
+            # checkpoint-sync churn: a joiner anchors off node 0's API
+            ck = BeaconNode(
+                NodeConfig(
+                    db_path=ctx.base_dir + "/churn/ck.wal",
+                    checkpoint_sync_url=(
+                        f"http://127.0.0.1:{fleet.nodes[0].api.port}"
+                    ),
+                    enable_range_sync=False,
+                    wire=None,
+                ),
+                spec,
+            )
+            await ck.start()
+            try:
+                # anchored on A's finalized (genesis) state: exactly the
+                # anchor block, and its state carries OUR genesis_time —
+                # proof it came off the wire, not a local default
+                anchored = len(ck.store.blocks) == 1 and any(
+                    int(s.genesis_time) == bundle.genesis_time
+                    for s in ck.store.block_states.values()
+                )
+            finally:
+                await ck.stop()
+            if not anchored:
+                ok = False
+                ctx.violation("churn", "checkpoint-synced joiner did not anchor")
+            # resume-from-db churn: restart the follower outright
+            db_path = fleet.nodes[1].config.db_path
+            head_before = fleet.heads()[1]
+            await fleet.nodes[1].stop()
+            fleet.nodes = fleet.nodes[:1]  # already stopped; skip in stop()
+            fleet.chaos = fleet.chaos[:1]
+            resumed = BeaconNode(
+                NodeConfig(
+                    db_path=db_path, enable_range_sync=False, wire=None
+                ),
+                spec,
+            )
+            await resumed.start()
+            try:
+                from ..fork_choice import get_head
+                # graftlint: disable=async-blocking — memoized head read
+                # on a devnet-sized store, scenario teardown path
+                resumed_head = get_head(resumed.store, spec)
+            finally:
+                await resumed.stop()
+            if resumed_head != head_before:
+                ok = False
+                ctx.violation(
+                    "churn", "restart-from-db did not resume at the same head"
+                )
+        finally:
+            await fleet.stop()
+    injected = {
+        "sidecar_stall": get_metrics().get(
+            _FAULT_COUNTER, kind="sidecar_stall"
+        ) - before["sidecar_stall"],
+    }
+    if injected["sidecar_stall"] <= 0:
+        ok = False
+        ctx.violation("churn", "sidecar stall fault not observed in counters")
+    return {
+        "scenario": "churn", "ok": ok, "faults": injected,
+        "sidecar_restarts": restarts, **recovery,
+    }
+
+
+SCENARIOS = {
+    "steady": _steady,
+    "storm": _storm,
+    "partition": _partition,
+    "equivocation": _equivocation,
+    "churn": _churn,
+}
+
+
+def run_scenario(name: str, ctx: ScenarioContext) -> dict:
+    """One scenario on a fresh event loop; exceptions become structured
+    failures rather than killing the whole soak run."""
+    runner = SCENARIOS[name]
+    t0 = time.monotonic()
+    try:
+        record = asyncio.run(runner(ctx))
+    except Exception as e:
+        ctx.violation(name, f"scenario crashed: {type(e).__name__}: {e}")
+        record = {
+            "scenario": name, "ok": False,
+            "error": f"{type(e).__name__}: {e}",
+        }
+    record["elapsed_s"] = round(time.monotonic() - t0, 3)
+    record["seed"] = ctx.seed
+    return record

@@ -55,6 +55,23 @@ FANOUT_TTL_S = 60.0
 MAX_IHAVE_IDS = 5000
 MAX_IWANT_RETRANSMIT = 3
 
+# gossipsub v1.1 prune backoff + peer exchange (spec §prune-backoff, §px;
+# the reference's go-libp2p-pubsub defaults: PruneBackoff = 1 min).  A
+# pruned link MUST NOT re-graft until the backoff expires — on either
+# side — and a GRAFT arriving inside the window is refused with a fresh
+# PRUNE plus a behavioral penalty (the spec's graft-flood defense).
+# The penalty is waived inside a short grace window after OUR prune:
+# an honest peer's heartbeat GRAFT can legally cross our PRUNE on the
+# wire, and docking 10 points per race would walk a churning-but-honest
+# peer to the prune bar (go-libp2p-pubsub's GraftFloodThreshold plays
+# this role, scaled there by the P7 penalty-squared weighting).
+PRUNE_BACKOFF_S = 60.0
+GRAFT_FLOOD_PENALTY = 10.0
+GRAFT_FLOOD_GRACE_S = 2.0
+# PX is only honored from peers in good standing (spec: acceptPXThreshold)
+# and bounded, so one PRUNE cannot make us dial an attacker's whole list
+MAX_PX_PEERS = 16
+
 ACCEPT, REJECT, IGNORE = 1, 2, 3
 
 ACCEPT_REWARD = 1.0
@@ -122,14 +139,24 @@ class Gossipsub:
     """The router.  ``validator(topic, data, msg_id, peer_id) -> verdict``
     decides forwarding; absent a validator everything is accepted."""
 
-    def __init__(self, host: Libp2pHost, validator=None):
+    def __init__(self, host: Libp2pHost, validator=None, on_px=None):
         self.host = host
         self.validator = validator
+        # PX hook: ``on_px(topic, [PeerInfo, ...])`` receives the peers a
+        # good-standing PRUNE carried, so discovery can dial them — the
+        # router itself never dials (addresses live in the signed peer
+        # records, whose resolution is the host/discovery layer's job)
+        self.on_px = on_px
         self.peers: dict[PeerId, _PeerState] = {}
         self.retained_scores: dict[PeerId, float] = {}  # negative only
         self.subscriptions: set[str] = set()
         self.mesh: dict[str, set[PeerId]] = {}
         self.fanout: dict[str, tuple[set[PeerId], float]] = {}
+        # (topic, peer) -> monotonic expiry: no re-GRAFT on this link
+        # until then, whichever side sent the PRUNE (spec MUST); the
+        # noted-at side table feeds the graft-flood grace window
+        self.backoff: dict[tuple[str, PeerId], float] = {}
+        self.backoff_noted: dict[tuple[str, PeerId], float] = {}
         # seen-cache: msg_id -> expiry, ids only (550 heartbeats, as the
         # reference's WithSeenMessagesTTL) — REJECTed ids stay here so
         # invalid messages are not re-validated, but only ACCEPTed
@@ -274,15 +301,59 @@ class Gossipsub:
                         members.discard(state.peer_id)
                         await self._send_control(state, prune=[topic_])
 
+    def _in_backoff(self, topic: str, peer_id: PeerId) -> bool:
+        expiry = self.backoff.get((topic, peer_id))
+        return expiry is not None and expiry > time.monotonic()
+
+    def _note_backoff(
+        self, topic: str, peer_id: PeerId, duration_s: float = PRUNE_BACKOFF_S
+    ) -> None:
+        now = time.monotonic()
+        if not self._in_backoff(topic, peer_id):
+            # the grace window anchors to the EPISODE's first prune: a
+            # refused GRAFT restarts the expiry below but must not
+            # re-open the grace, or a flood of grafts spaced inside the
+            # grace would be penalized at most once
+            self.backoff_noted[(topic, peer_id)] = now
+        self.backoff[(topic, peer_id)] = now + duration_s
+
     async def _on_control(self, state: _PeerState, ctl: pb.ControlMessage) -> None:
         for graft in ctl.graft:
             topic = graft.topic_id
-            if topic in self.subscriptions and state.score > PRUNE_SCORE:
+            if self._in_backoff(topic, state.peer_id):
+                # GRAFT inside the prune-backoff window: refuse with a
+                # fresh PRUNE and penalize (spec §prune-backoff — the
+                # graft-flood defense; the backoff clock restarts).  A
+                # GRAFT that crossed our PRUNE on the wire lands within
+                # the grace window and is refused without the penalty.
+                noted = self.backoff_noted.get((topic, state.peer_id), 0.0)
+                if time.monotonic() - noted > GRAFT_FLOOD_GRACE_S:
+                    state.score -= GRAFT_FLOOD_PENALTY
+                # the refusal PRUNE below restarts the backoff clock (its
+                # _note_backoff), and carries no PX (go-libp2p-pubsub
+                # does the same): answering every backoff-violating GRAFT
+                # with our mesh membership would let a peer poll topology
+                # for free
+                await self._send_control(state, prune=[topic], px=False)
+            elif topic in self.subscriptions and state.score > PRUNE_SCORE:
                 self.mesh.setdefault(topic, set()).add(state.peer_id)
             else:
                 await self._send_control(state, prune=[topic])
         for prune in ctl.prune:
-            self.mesh.get(prune.topic_id, set()).discard(state.peer_id)
+            topic = prune.topic_id
+            self.mesh.get(topic, set()).discard(state.peer_id)
+            # honor the peer's announced backoff (their default when the
+            # field is unset/zero): no re-GRAFT on this link until expiry
+            self._note_backoff(
+                topic, state.peer_id, float(prune.backoff) or PRUNE_BACKOFF_S
+            )
+            if prune.peers and state.score >= 0 and self.on_px is not None:
+                # peer exchange: only from good standing, bounded — the
+                # hook owns dialing via the signed peer records
+                px = list(prune.peers)[:MAX_PX_PEERS]
+                result = self.on_px(topic, px)
+                if asyncio.iscoroutine(result):
+                    await result
         wanted: list[bytes] = []
         seen_this_rpc: set[bytes] = set()
         for ihave in ctl.ihave:
@@ -350,7 +421,10 @@ class Gossipsub:
             out = pb.RPC()
             out.CopyFrom(rpc)
             if state.peer_id in members:
-                out.control.prune.add().topic_id = topic
+                entry = out.control.prune.add()
+                entry.topic_id = topic
+                entry.backoff = int(PRUNE_BACKOFF_S)
+                self._note_backoff(topic, state.peer_id)
             await self._send_rpc(state, out)
 
     async def publish(self, topic: str, data: bytes) -> bytes:
@@ -388,13 +462,29 @@ class Gossipsub:
             await self._send_rpc(state, rpc)
 
     async def _send_control(
-        self, state: _PeerState, graft: list[str] = (), prune: list[str] = ()
+        self, state: _PeerState, graft: list[str] = (), prune: list[str] = (),
+        px: bool = True,
     ) -> None:
+        """GRAFT/PRUNE control.  Every PRUNE we send announces our
+        backoff (spec MUST: the pruned peer must not re-GRAFT before it
+        expires), records the same window locally (we must not re-graft
+        either), and — when the pruned peer is in good standing —
+        carries peer exchange: other mesh members it can dial instead,
+        so pruning for oversubscription heals the topic rather than
+        shrinking it (VERDICT r5 item 7)."""
         rpc = pb.RPC()
         for topic in graft:
             rpc.control.graft.add().topic_id = topic
         for topic in prune:
-            rpc.control.prune.add().topic_id = topic
+            entry = rpc.control.prune.add()
+            entry.topic_id = topic
+            entry.backoff = int(PRUNE_BACKOFF_S)
+            self._note_backoff(topic, state.peer_id)
+            if px and state.score >= 0:
+                members = self.mesh.get(topic, set())
+                for peer_id in list(members)[:MAX_PX_PEERS]:
+                    if peer_id != state.peer_id:
+                        entry.peers.add().peer_id = peer_id.bytes
         await self._send_rpc(state, rpc)
 
     # ------------------------------------------------------------ heartbeat
@@ -437,6 +527,10 @@ class Gossipsub:
         for topic, (members, expiry) in list(self.fanout.items()):
             if expiry < now:
                 del self.fanout[topic]
+        for key, expiry in list(self.backoff.items()):
+            if expiry < now:
+                del self.backoff[key]
+                self.backoff_noted.pop(key, None)
         # score decay: positive washes out fast, negative slowly; retained
         # (offline) penalties are forgiven once back above the prune bar
         for state in self.peers.values():
@@ -464,6 +558,9 @@ class Gossipsub:
                     if topic in s.topics
                     and s.peer_id not in members
                     and s.score > PRUNE_SCORE
+                    # spec MUST: a pruned link stays un-grafted until its
+                    # announced backoff expires — on the pruner's side too
+                    and not self._in_backoff(topic, s.peer_id)
                 ),
                 key=lambda s: -s.score,
             )

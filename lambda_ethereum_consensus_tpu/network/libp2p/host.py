@@ -132,7 +132,11 @@ class Libp2pHost:
             chosen = await ms_handle(channel, channel, MUXER_PROTOCOLS)
         if chosen == YAMUX_PROTOCOL:
             muxer = Yamux(
-                channel, on_stream=self._inbound_stream, initiator=initiator
+                channel, on_stream=self._inbound_stream, initiator=initiator,
+                # go-yamux keepalive cadence: an unanswered ping tears the
+                # session down, so a silently dead TCP path (NAT timeout,
+                # pulled cable) cannot strand its streams forever
+                keepalive_s=Yamux.KEEPALIVE_INTERVAL_S,
             )
         else:
             muxer = Mplex(channel, on_stream=self._inbound_stream)

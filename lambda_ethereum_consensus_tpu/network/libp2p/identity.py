@@ -22,11 +22,20 @@ from __future__ import annotations
 
 import hashlib
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    # optional: only key generation/signing/verification need it — the
+    # PeerId/multihash/base58/protobuf layers are pure and stay
+    # importable so the pure-frame wire modules (yamux, gossipsub
+    # control plane) can be exercised without the crypto stack
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+except ImportError:  # pragma: no cover - environment-dependent
+    serialization = None  # type: ignore[assignment]
+    Ed25519PrivateKey = None  # type: ignore[assignment]
+    Ed25519PublicKey = None  # type: ignore[assignment]
 
 KEY_ED25519 = 1  # enum KeyType { RSA=0; Ed25519=1; Secp256k1=2; ECDSA=3 }
 
@@ -157,6 +166,10 @@ class Identity:
     """Local ed25519 identity: signs noise payloads, derives the peer ID."""
 
     def __init__(self, private: Ed25519PrivateKey | None = None):
+        if Ed25519PrivateKey is None:
+            raise IdentityError(
+                "libp2p identities need the optional 'cryptography' module"
+            )
         self.private = private or Ed25519PrivateKey.generate()
         pub = self.private.public_key().public_bytes(
             serialization.Encoding.Raw, serialization.PublicFormat.Raw
@@ -166,6 +179,10 @@ class Identity:
 
     @classmethod
     def from_seed(cls, seed: bytes) -> "Identity":
+        if Ed25519PrivateKey is None:  # same clear error as __init__
+            raise IdentityError(
+                "libp2p identities need the optional 'cryptography' module"
+            )
         return cls(Ed25519PrivateKey.from_private_bytes(seed))
 
     def private_bytes(self) -> bytes:
@@ -195,6 +212,10 @@ def verify_noise_payload(payload: bytes, noise_static_pub: bytes) -> PeerId:
     key_type, key_data = decode_public_key_pb(pub_pb)
     if key_type != KEY_ED25519:
         raise IdentityError(f"unsupported identity key type {key_type}")
+    if Ed25519PublicKey is None:
+        raise IdentityError(
+            "verifying noise payloads needs the optional 'cryptography' module"
+        )
     try:
         Ed25519PublicKey.from_public_bytes(key_data).verify(
             sig, NOISE_SIG_PREFIX + noise_static_pub

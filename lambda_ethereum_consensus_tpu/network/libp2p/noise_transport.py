@@ -23,9 +23,19 @@ from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
-
+# ..noise is importable without 'cryptography' (its own crypto imports
+# are gated), so NoiseError stays ONE class repo-wide — the teardown
+# tuples in yamux/mplex/sidecar must catch what we raise here
 from ..noise import NoiseError, NoiseSession, _pub, recv_framed, send_framed
+
+try:
+    # optional: a host without 'cryptography' can still import this
+    # module (and everything that composes it — host, gossipsub); only
+    # actually securing a connection requires the crypto stack
+    from cryptography.hazmat.primitives.asymmetric.x25519 import X25519PrivateKey
+except ImportError:  # pragma: no cover - environment-dependent
+    X25519PrivateKey = None  # type: ignore[assignment]
+
 from .identity import Identity, IdentityError, PeerId, verify_noise_payload
 
 MAX_PLAINTEXT = 65535 - 16  # AEAD tag rides inside the 2-byte length budget
@@ -72,6 +82,10 @@ async def secure_connection(
     """Run the libp2p-noise handshake; returns the encrypted channel with
     the remote's PROVEN peer id (payload signature checked against the
     noise-authenticated static key)."""
+    if X25519PrivateKey is None:
+        raise NoiseError(
+            "libp2p-noise needs the optional 'cryptography' module"
+        )
     static = static or X25519PrivateKey.generate()
     session = NoiseSession(static, initiator)
     payload = identity.noise_payload(_pub(static))

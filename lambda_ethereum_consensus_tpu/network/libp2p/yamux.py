@@ -257,7 +257,23 @@ class Yamux:
     dialed the connection, even for the accepter (yamux spec §streamids).
     """
 
-    def __init__(self, channel, on_stream=None, initiator: bool = True):
+    # go-yamux's keepalive defaults: a ping every 30 s, session torn
+    # down when one goes unanswered for the connection-write timeout
+    KEEPALIVE_INTERVAL_S = 30.0
+    KEEPALIVE_TIMEOUT_S = 10.0
+
+    # GoAway codes (yamux spec §goaway)
+    GOAWAY_NORMAL = 0
+    GOAWAY_PROTOCOL_ERROR = 1
+    GOAWAY_INTERNAL_ERROR = 2
+
+    def __init__(
+        self,
+        channel,
+        on_stream=None,
+        initiator: bool = True,
+        keepalive_s: float | None = None,
+    ):
         self._channel = channel
         self._on_stream = on_stream  # async callback(YamuxStream)
         self._initiator = initiator
@@ -265,6 +281,18 @@ class Yamux:
         self._streams: dict[int, YamuxStream] = {}
         self._send_lock = asyncio.Lock()
         self._closed = False
+        # outbound ping bookkeeping: opaque value -> waiter future (the
+        # spec rides the opaque value in the length field; the ACK MUST
+        # echo it, so responses match their pings by value)
+        self._ping_counter = 0
+        self._ping_waiters: dict[int, asyncio.Future] = {}
+        self._keepalive_s = keepalive_s
+        self._keepalive_task: asyncio.Task | None = None
+        # set on receiving GoAway: no NEW streams after it (spec MUST);
+        # a normal (code 0) GoAway lets in-flight streams finish, any
+        # error code tears the session down immediately
+        self.remote_goaway: int | None = None
+        self._sent_goaway = False
 
     async def _send(self, frame: bytes) -> None:
         async with self._send_lock:
@@ -274,7 +302,70 @@ class Yamux:
     def _drop(self, stream_id: int) -> None:
         self._streams.pop(stream_id, None)
 
+    # -- keepalive / ping -------------------------------------------------
+
+    async def ping(self, timeout: float | None = None) -> float:
+        """One outbound keepalive ping; returns the RTT.  The opaque
+        value (length field) must come back verbatim in the ACK — a
+        mismatched ACK simply never resolves this waiter and the timeout
+        raises, which is what kills a half-dead session."""
+        if self._closed:
+            raise YamuxError("session closed")
+        self._ping_counter = (self._ping_counter + 1) & 0xFFFFFFFF
+        opaque = self._ping_counter
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._ping_waiters[opaque] = fut
+
+        async def send_and_wait():
+            # the SEND is inside the timeout too: a dead path with a
+            # backed-up socket buffer blocks in drain() and would hang
+            # the keepalive before ever waiting on the ACK (go-yamux's
+            # connection-write timeout covers the same case)
+            await self._send(encode_frame(TYPE_PING, FLAG_SYN, 0, opaque))
+            await fut
+
+        t0 = asyncio.get_running_loop().time()
+        try:
+            await asyncio.wait_for(
+                send_and_wait(),
+                self.KEEPALIVE_TIMEOUT_S if timeout is None else timeout,
+            )
+        finally:
+            self._ping_waiters.pop(opaque, None)
+        return asyncio.get_running_loop().time() - t0
+
+    async def _keepalive_loop(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(self._keepalive_s)
+            try:
+                await self.ping()
+            except (asyncio.TimeoutError, YamuxError, ConnectionError, OSError):
+                # an unanswered keepalive means the transport is dead in
+                # at least one direction: close the channel so run()'s
+                # read loop tears the whole session down (go-yamux's
+                # keepalive failure path)
+                close = getattr(self._channel, "close", None)
+                if close is not None:
+                    result = close()
+                    if asyncio.iscoroutine(result):
+                        await result
+                self._closed = True
+                return
+
+    # -- goaway -----------------------------------------------------------
+
+    async def goaway(self, code: int = GOAWAY_NORMAL) -> None:
+        """Announce session shutdown (spec: sent on intentional close so
+        the peer distinguishes shutdown from a dead TCP path)."""
+        if self._sent_goaway:
+            return
+        self._sent_goaway = True
+        await self._send(encode_frame(TYPE_GOAWAY, 0, 0, code))
+
     async def open_stream(self, name: str = "") -> YamuxStream:
+        if self.remote_goaway is not None or self._sent_goaway or self._closed:
+            # spec MUST: no new streams once either side said GoAway
+            raise YamuxError("session is going away; refusing new stream")
         stream_id = self._next_id
         self._next_id += 2
         stream = YamuxStream(self, stream_id, we_initiated=True)
@@ -288,6 +379,8 @@ class Yamux:
 
     async def run(self) -> None:
         """Read loop: dispatch frames until the channel dies."""
+        if self._keepalive_s is not None and self._keepalive_task is None:
+            self._keepalive_task = asyncio.ensure_future(self._keepalive_loop())
         try:
             while True:
                 head = await self._channel.readexactly(_HEADER.size)
@@ -303,12 +396,26 @@ class Yamux:
                     await self._dispatch_window(stream_id, flags, length)
                 elif typ == TYPE_PING:
                     if flags & FLAG_ACK:
-                        continue  # response to our ping (we send none)
+                        # ACK to one of OUR pings: resolve its waiter by
+                        # the echoed opaque value; an unknown value is a
+                        # stale/forged ACK and resolves nothing (the
+                        # waiting ping then times out — spec: the ACK
+                        # MUST carry the ping's opaque value)
+                        waiter = self._ping_waiters.get(length)
+                        if waiter is not None and not waiter.done():
+                            waiter.set_result(None)
+                        continue
                     await self._send(
                         encode_frame(TYPE_PING, FLAG_ACK, 0, length)
                     )
                 elif typ == TYPE_GOAWAY:
-                    return
+                    self.remote_goaway = length
+                    if length != self.GOAWAY_NORMAL:
+                        return  # error goaway: session-fatal immediately
+                    # normal termination: no NEW streams (open_stream
+                    # refuses now), but in-flight streams drain until
+                    # the peer closes the transport
+                    continue
                 else:
                     raise YamuxError(f"unknown yamux frame type {typ}")
         except (
@@ -322,12 +429,24 @@ class Yamux:
             pass  # connection dead or peer spoke garbage: tear down
         finally:
             self._closed = True
+            if self._keepalive_task is not None:
+                self._keepalive_task.cancel()
+            for waiter in self._ping_waiters.values():
+                if not waiter.done():
+                    waiter.set_exception(YamuxError("session closed"))
             for stream in list(self._streams.values()):
                 stream._feed_reset()
 
     async def _get_or_open(self, stream_id: int, flags: int) -> YamuxStream | None:
         stream = self._streams.get(stream_id)
         if stream is None and flags & FLAG_SYN:
+            if self._sent_goaway or self.remote_goaway is not None:
+                # going away: a racing inbound SYN is refused with RST
+                # instead of silently accumulating post-goaway streams
+                await self._send(
+                    encode_frame(TYPE_WINDOW, FLAG_RST, stream_id, 0)
+                )
+                return None
             if stream_id % 2 == (1 if self._initiator else 0):
                 # a SYN in OUR id space would later collide with
                 # open_stream and clobber the entry — protocol violation,

@@ -29,11 +29,19 @@ import hashlib
 import hmac as hmac_mod
 import struct
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    # optional: the module (incl. NoiseError, which frame-layer modules
+    # catch in their teardown tuples) stays importable without the
+    # crypto stack; actually opening a noise session raises below
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # pragma: no cover - environment-dependent
+    X25519PrivateKey = None  # type: ignore[assignment]
+    X25519PublicKey = None  # type: ignore[assignment]
+    ChaCha20Poly1305 = None  # type: ignore[assignment]
 
 PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
 _MAX_NONCE = (1 << 64) - 1
@@ -160,6 +168,10 @@ class NoiseSession:
     """
 
     def __init__(self, static: X25519PrivateKey, initiator: bool):
+        if ChaCha20Poly1305 is None:
+            raise NoiseError(
+                "noise transport needs the optional 'cryptography' module"
+            )
         self.s = static
         self.initiator = initiator
         self.e: X25519PrivateKey | None = None

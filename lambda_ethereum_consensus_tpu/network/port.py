@@ -14,11 +14,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import struct
 import sys
 from typing import Awaitable, Callable
 
-from ..telemetry import span
+from ..telemetry import get_metrics, span
 from .proto import port_pb2
 
 VERDICT_ACCEPT = port_pb2.ValidateMessage.ACCEPT
@@ -27,9 +28,34 @@ VERDICT_IGNORE = port_pb2.ValidateMessage.IGNORE
 
 Handler = Callable[..., Awaitable[None] | None]
 
+# Bounded retry-with-backoff for transient command failures (round 19):
+# a sidecar hiccup (one failed dial mid-churn, a dropped result frame,
+# one timed-out round-trip) should cost a retry, not a dead subscription
+# — while a persistent failure must still raise after the bounded
+# attempts so callers see real outages.  Exponential backoff with full
+# jitter; retries are skipped outright once the sidecar is dead (the
+# supervisor rebuilds the whole Port then — re-sending into a corpse
+# would just burn the backoff schedule).
+PORT_RETRY_MAX = 2
+PORT_RETRY_BASE_S = 0.05
+
+
+def _retry_max() -> int:
+    try:
+        return max(0, int(os.environ.get("PORT_RETRY_MAX", "") or PORT_RETRY_MAX))
+    except ValueError:
+        return PORT_RETRY_MAX
+
 
 class PortError(RuntimeError):
     pass
+
+
+class PortCommandError(PortError):
+    """The sidecar processed the command and said no (``result.ok``
+    false).  Deterministic — never retried: re-sending a rejected
+    command cannot change the answer, only mislabel a permanent error
+    as transient in ``port_retry_total``."""
 
 
 class Port:
@@ -48,9 +74,57 @@ class Port:
         # handler registries
         self.gossip_handlers: dict[str, Handler] = {}
         self.request_handlers: dict[str, Handler] = {}
-        self.on_new_peer: Handler | None = None
-        self.on_peer_gone: Handler | None = None
+        self._on_new_peer: Handler | None = None
+        self._on_peer_gone: Handler | None = None
         self.on_exit: Handler | None = None
+        # peer events that raced handler assignment: the sidecar dials
+        # bootnodes during init, so on a fast loopback a new_peer
+        # notification can land before the node wires on_new_peer —
+        # dropping it would leave the host-side peerbook empty (and
+        # range sync idle) while the sidecar is happily connected.
+        # Buffer them and replay on handler assignment.
+        self._early_peer_events: list[tuple[str, tuple]] = []
+
+    # -------------------------------------------------- peer-event handlers
+
+    @property
+    def on_new_peer(self) -> Handler | None:
+        return self._on_new_peer
+
+    @on_new_peer.setter
+    def on_new_peer(self, handler: Handler | None) -> None:
+        self._on_new_peer = handler
+        self._drain_early()
+
+    @property
+    def on_peer_gone(self) -> Handler | None:
+        return self._on_peer_gone
+
+    @on_peer_gone.setter
+    def on_peer_gone(self, handler: Handler | None) -> None:
+        self._on_peer_gone = handler
+        self._drain_early()
+
+    _EARLY_PEER_EVENTS_MAX = 256
+
+    def _buffer_early(self, kind: str, args: tuple) -> None:
+        if len(self._early_peer_events) < self._EARLY_PEER_EVENTS_MAX:
+            self._early_peer_events.append((kind, args))
+
+    def _drain_early(self) -> None:
+        """Replay buffered peer events in ARRIVAL order, stopping at the
+        first event whose handler is still unset — a connect/disconnect
+        pair buffered during init must not replay as disconnect-last-wins
+        for a peer that is actually connected.  The node assigns both
+        handlers back to back, so the second assignment drains the rest."""
+        handlers = {"new_peer": self._on_new_peer, "peer_gone": self._on_peer_gone}
+        while self._early_peer_events:
+            kind, args = self._early_peer_events[0]
+            handler = handlers[kind]
+            if handler is None:
+                return
+            self._early_peer_events.pop(0)
+            self._spawn(handler, *args)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -96,7 +170,10 @@ class Port:
             cmd.init.fork_digest = fork_digest.hex()
             cmd.init.attnets = attnets  # SSZ Bitvector[64] bytes (or empty)
             cmd.init.syncnets = syncnets  # SSZ Bitvector[4] bytes (or empty)
-            result = await self._command(cmd)
+            # handshake commands never retry: a re-sent init would bind a
+            # second listener in the sidecar, and a failed handshake tears
+            # the whole Port down anyway (the except below)
+            result = await self._command(cmd, retries=0)
             # payload: "<port>" (bespoke wire) or "<port> <enr>" (libp2p
             # wire, whose init also returns the node's signed discv5 ENR)
             parts = result.payload.decode().split(None, 1)
@@ -104,7 +181,7 @@ class Port:
             self.enr = parts[1] if len(parts) > 1 else None
             ident = port_pb2.Command()
             ident.get_node_identity.SetInParent()
-            self.node_id = (await self._command(ident)).payload
+            self.node_id = (await self._command(ident, retries=0)).payload
         except BaseException:
             # failed handshake must not leak the subprocess / reader task
             await self.close()
@@ -133,7 +210,42 @@ class Port:
 
     # ------------------------------------------------------------- commands
 
-    async def _command(self, cmd: port_pb2.Command, timeout: float = 30) -> port_pb2.Result:
+    async def _command(
+        self,
+        cmd: port_pb2.Command,
+        timeout: float = 30,
+        retries: int | None = None,
+    ) -> port_pb2.Result:
+        """One command with bounded transient-failure retries.
+
+        Every attempt is a full :meth:`_roundtrip` (fresh command id, own
+        span sample); a failed attempt counts on
+        ``port_retry_total{command}`` before the backoff sleep.  Retries
+        stop early when the sidecar is no longer alive — those failures
+        are terminal for this Port instance, the restart supervisor owns
+        what happens next."""
+        if retries is None:
+            retries = _retry_max()
+        attempt = 0
+        while True:
+            try:
+                return await self._roundtrip(cmd, timeout)
+            except PortCommandError:
+                raise  # deterministic rejection: retrying cannot help
+            except (PortError, asyncio.TimeoutError):
+                if attempt >= retries or not self.alive:
+                    raise
+                attempt += 1
+                get_metrics().inc(
+                    "port_retry_total",
+                    command=cmd.WhichOneof("c") or "unknown",
+                )
+                base = PORT_RETRY_BASE_S * (2 ** (attempt - 1))
+                # full jitter: concurrent retriers (66 topic subscriptions
+                # behind one hiccup) must not re-dogpile in lockstep
+                await asyncio.sleep(base * (1.0 + random.random()))
+
+    async def _roundtrip(self, cmd: port_pb2.Command, timeout: float) -> port_pb2.Result:
         if not self.alive:
             raise PortError("sidecar is not running")
         self._counter += 1
@@ -163,7 +275,7 @@ class Port:
             finally:
                 self._pending.pop(cmd_id, None)
         if not result.ok:
-            raise PortError(result.error or "sidecar command failed")
+            raise PortCommandError(result.error or "sidecar command failed")
         return result
 
     async def add_peer(self, addr: str) -> None:
@@ -209,7 +321,11 @@ class Port:
         cmd.send_request.protocol_id = protocol_id
         cmd.send_request.payload = payload
         cmd.send_request.timeout_ms = timeout_ms
-        result = await self._command(cmd, timeout=timeout_ms / 1000 + 5)
+        # no retries: the dominant failure here is the REMOTE peer not
+        # answering, which already burned the full timeout_ms — stacking
+        # the backoff schedule on top would make range sync wait ~3x the
+        # budget per bad peer before trying the next one
+        result = await self._command(cmd, timeout=timeout_ms / 1000 + 5, retries=0)
         return result.payload
 
     async def send_response(self, request_id: bytes, payload: bytes) -> None:
@@ -271,9 +387,13 @@ class Port:
         elif which == "new_peer":
             if self.on_new_peer is not None:
                 self._spawn(self.on_new_peer, n.new_peer.peer_id, n.new_peer.addr)
+            else:
+                self._buffer_early("new_peer", (n.new_peer.peer_id, n.new_peer.addr))
         elif which == "peer_gone":
             if self.on_peer_gone is not None:
                 self._spawn(self.on_peer_gone, n.peer_gone.peer_id)
+            else:
+                self._buffer_early("peer_gone", (n.peer_gone.peer_id,))
 
     @staticmethod
     def _spawn(handler, *args) -> None:

@@ -76,7 +76,16 @@ class Libp2pSidecar:
         self.host.on_peer = self._on_peer
         self.host.on_peer_gone = self._on_peer_gone
         # Gossipsub chains host.on_peer, so construct it after setting ours
-        self.gossip = Gossipsub(self.host, validator=self._validate)
+        self.gossip = Gossipsub(
+            self.host, validator=self._validate, on_px=self._on_px
+        )
+        # peer_id bytes -> last known "host:port", learned from live
+        # connections: the dialable subset of peer-exchange (signed peer
+        # records are not implemented, so PX from peers we have never
+        # met carries no address we could verify).  Bounded LRU — the
+        # addresses we mostly need are of DISCONNECTED peers (PX re-dial
+        # after a prune), so eviction is by age, not by peer_gone
+        self._px_addrs: OrderedDict[bytes, str] = OrderedDict()
         self.listen_port = 0
         # msg_id -> future the gossip validator awaits (host verdict)
         self.pending_validation: OrderedDict[bytes, asyncio.Future] = OrderedDict()
@@ -239,11 +248,34 @@ class Libp2pSidecar:
         except (Libp2pError, ValueError, OSError) as e:
             return False, f"dial {addr}: {e}"
 
+    _PX_ADDRS_CAP = 512
+
     async def _on_peer(self, peer_id: PeerId, addr: str) -> None:
+        if addr:
+            self._px_addrs[peer_id.bytes] = addr
+            self._px_addrs.move_to_end(peer_id.bytes)
+            while len(self._px_addrs) > self._PX_ADDRS_CAP:
+                self._px_addrs.popitem(last=False)
         n = port_pb2.Notification()
         n.new_peer.peer_id = peer_id.bytes
         n.new_peer.addr = addr
         await self.notify(n)
+
+    def _on_px(self, topic: str, infos) -> None:
+        """Peer exchange from a good-standing PRUNE: re-dial offered
+        peers whose address we know from an earlier connection, so a
+        prune-for-oversubscription heals the topic instead of shrinking
+        it.  PX for never-met peers needs signed peer records (their
+        ``signed_peer_record`` field) — not implemented, skipped."""
+        for info in infos:
+            if not info.peer_id:
+                continue
+            peer_id = PeerId(info.peer_id)
+            if peer_id in self.host.connections:
+                continue
+            addr = self._px_addrs.get(info.peer_id)
+            if addr:
+                asyncio.ensure_future(self._dial(addr))
 
     async def _on_peer_gone(self, peer_id: PeerId) -> None:
         n = port_pb2.Notification()

@@ -98,6 +98,11 @@ class NodeConfig:
     # attestations at 1/3 slot, aggregation at 2/3, block proposal at
     # the boundary, all batch-signed through the duty_sign plane
     duty_keys: dict | None = None
+    # chaos seam (round 19): wraps the freshly started Port before the
+    # node wires handlers — chaos/inject.ChaosPort injects seeded faults
+    # here.  Applied on EVERY network (re)build, so a sidecar restart
+    # keeps its fault schedule and partition state.
+    port_wrapper: object | None = None
 
 
 class BeaconNode:
@@ -304,7 +309,7 @@ class BeaconNode:
                 # fail at startup, not inside the sidecar-restart loop
                 raise ValueError(f"attestation subnet id out of range: {i}")
             attnets[i // 8] |= 1 << (i % 8)
-        self.port = await Port.start(
+        port = await Port.start(
             listen_addr=self.config.listen_addr,
             bootnodes=self.config.bootnodes,
             fork_digest=digest,
@@ -314,6 +319,11 @@ class BeaconNode:
             attnets=bytes(attnets),
             syncnets=b"\x00",
         )
+        if self.config.port_wrapper is not None:
+            # chaos seam: the wrapper sees every (re)built port, so fault
+            # schedules and partitions survive sidecar restarts
+            port = self.config.port_wrapper(port)
+        self.port = port
         self.port.on_new_peer = self._on_new_peer
         self.port.on_peer_gone = self._on_peer_gone
         self.port.on_exit = self._on_sidecar_exit

@@ -73,6 +73,12 @@ class IngestScheduler:
         self._total = 0  # queued across lanes
         self._inflight = 0  # dequeued into a flush that has not finished
         self.degraded = DegradedSignal(degraded_window_s)
+        # edge tracker for the transitions counter: enter is counted at
+        # the shed that flips the latch, exit when the drain loop first
+        # observes the latch released (the idle sleep is capped by the
+        # latch expiry, so the exit edge lands on time even with zero
+        # traffic) — one increment per storm edge, both directions
+        self._degraded_active = False
         self._flush_error_logged = False
         self._enqueue_args: dict[str, dict] = {}  # per-lane, see add_lane
         m = get_metrics()
@@ -222,7 +228,17 @@ class IngestScheduler:
         if self.degraded.mark(now):
             # the latch FLIP, not the level: a sub-scrape-interval
             # degraded episode still increments, so it alerts
-            get_metrics().inc("ingest_degraded_transitions_total")
+            if self._degraded_active:
+                # the previous episode expired and re-latched between
+                # drain-loop iterations (the only other exit observer):
+                # emit its exit edge here so enter/exit stay paired and
+                # engaged-time stays computable from counters alone
+                get_metrics().inc(
+                    "ingest_degraded_transitions_total", edge="exit"
+                )
+                get_recorder().record("inst", 0, "ingest_degraded_clear", {})
+            self._degraded_active = True
+            get_metrics().inc("ingest_degraded_transitions_total", edge="enter")
             get_recorder().record(
                 "inst", 0, "ingest_degraded",
                 {"lane": lane.config.name, "reason": reason},
@@ -333,9 +349,16 @@ class IngestScheduler:
         return timeout
 
     def _update_degraded(self, now: float) -> None:
-        self.metrics.set_gauge(
-            "ingest_degraded", 1.0 if self.degraded.active(now) else 0.0
-        )
+        active = self.degraded.active(now)
+        if self._degraded_active and not active:
+            # the RELEASE edge (round-19 satellite): exactly one exit
+            # increment per storm, mirroring the enter flip — the pair
+            # makes "how long was admission control engaged" computable
+            # from counters alone, scrape cadence notwithstanding
+            self._degraded_active = False
+            get_metrics().inc("ingest_degraded_transitions_total", edge="exit")
+            get_recorder().record("inst", 0, "ingest_degraded_clear", {})
+        self.metrics.set_gauge("ingest_degraded", 1.0 if active else 0.0)
 
     async def _flush(self, lane: Lane, batch: list, cause: str, m) -> None:
         """Hand one lane flush to its sources: items group by source (a

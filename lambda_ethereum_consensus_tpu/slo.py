@@ -54,6 +54,7 @@ from .telemetry import Metrics, get_metrics
 __all__ = [
     "DEFAULT_SLOS",
     "DEFAULT_WINDOWS",
+    "SOAK_SLOS",
     "SloDef",
     "SloEngine",
     "estimate_quantile",
@@ -178,6 +179,34 @@ DEFAULT_SLOS = (
         # bench pushes the ACTUAL throughput target (>= 10k proofs/s on
         # the CPU fallback); this gate is the health bound
         "one batched stateless-witness multiproof verification",
+    ),
+)
+
+
+# Soak-specific budget rows (round 19): recovery — not just survival —
+# is the asserted property of every chaos scenario, so the soak gate
+# judges the DEFAULT set PLUS how fast the node comes back.  The budgets
+# are health bounds for the ~seconds-per-slot soak profiles; scenarios
+# tighten per-run copies via soak_check --budget.
+SOAK_SLOS = DEFAULT_SLOS + (
+    SloDef(
+        "chaos_recovery_p95", "chaos_recovery_seconds",
+        0.95, 30.0,
+        # measured from the END of an injected fault window (partition
+        # healed, storm stopped, sidecar restarted) to the instant the
+        # burn rates are back under threshold AND the fleet agrees on
+        # one head — the "returns to SLO within a budgeted slot count"
+        # acceptance, expressed in the engine's own units
+        "post-fault recovery: burn under threshold + fleet reconverged",
+    ),
+    SloDef(
+        "fleet_divergence_p95", "fleet_head_divergence_seconds",
+        0.95, 60.0,
+        # a divergence episode's wall-clock duration (first observation
+        # of >1 distinct head until reconvergence): partitions are
+        # EXPECTED to diverge for their whole window, so the budget is
+        # sized to the scenario windows, not to steady-state operation
+        "fleet head-divergence episodes resolve within the soak window",
     ),
 )
 

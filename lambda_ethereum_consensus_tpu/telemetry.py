@@ -147,7 +147,13 @@ _HELP = {
     "slo_burn_rate": "error-budget burn rate per SLO and window",
     "slo_evaluations_total": "SLO engine evaluation passes",
     "slo_violations_total": "budget violations observed at evaluation, by SLO",
-    "ingest_degraded_transitions_total": "degraded-latch activations (0->1 flips)",
+    "ingest_degraded_transitions_total": "degraded-latch edges, by edge (enter = 0->1 flip, exit = latch release)",
+    "port_retry_total": "sidecar command retries after transient failures, by command",
+    "chaos_fault_injected_total": "chaos faults injected into the transport, by kind",
+    "chaos_partition_active": "1 while a chaos network partition is being enforced",
+    "chaos_recovery_seconds": "post-fault-window recovery: burn rates back under threshold and fleet reconverged",
+    "fleet_head_divergence_seconds": "wall time fleet members spent on divergent heads before reconverging",
+    "fleet_head_lag_slots": "head-slot spread across fleet members (lead head slot minus laggard's)",
     "pipeline_drain_restarts_total": "supervised ingest drain-loop restarts",
     "slot_block_arrival_offset_seconds": "gossip block arrival offset into its slot",
     "attestation_admit_apply_seconds": "attestation gossip admission -> fork-choice apply",

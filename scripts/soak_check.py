@@ -1,0 +1,374 @@
+"""Soak & chaos gate: run the slot-clocked scenario catalogue against
+the real node stack, assert recovery against the SLO burn-rate engine,
+and record a validated pass/fail artifact (``SOAK_r*.json``).
+
+The five scenarios (``chaos/scenarios.py``) exercise REAL components —
+the priority ingest scheduler under seeded message chaos and flood
+storms, multi-node fleets gossiping over the real loopback wire through
+the fault-injecting ``ChaosPort`` (partitions with healing, equivocating
+blocks, malformed/bad-signature aggregates, subnet floods, sidecar
+kill/restart, checkpoint-sync and resume-from-db churn).  The gate then
+evaluates :data:`~lambda_ethereum_consensus_tpu.slo.SOAK_SLOS` (the
+node's budget set plus the round-19 recovery/divergence rows)
+cumulatively, exactly the way ``scripts/slo_check.py`` gates the load
+profile.
+
+Three layers of red:
+
+1. scenario assertions (recovery inside the budgeted slot count, fleet
+   reconvergence, degraded-latch edge counts, fault observability in
+   ``chaos_fault_injected_total``) — each miss is a structured violation;
+2. the cumulative SLO budget evaluation over every exercised row;
+3. the anti-silent-green pass: an exercised SLO with zero observations
+   fails the run, scenarios that cannot drive a row list it UNCHECKED.
+
+``--validate PATH`` audits an existing artifact the way ``bench.py
+--validate`` audits bench artifacts: every scenario the producing run's
+knobs enabled must carry a record with a verdict — a truncated run
+fails loudly.  Scenario knobs: ``SOAK_NO_<SCENARIO>=1`` disables one
+(recorded in the artifact so validation follows the producer's shell,
+not the validator's); ``SOAK_SEED`` sets the default fault seed.
+
+Exit codes: 0 = green, 1 = any violation (one structured line per
+breach on stderr), 2 = usage error.
+
+Usage:
+  python scripts/soak_check.py --smoke --json SOAK_r01.json
+  python scripts/soak_check.py --smoke --scenario storm --seed 11
+  python scripts/soak_check.py --budget chaos_recovery_p95=0.001  # red
+  python scripts/soak_check.py --validate SOAK_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.chaos.scenarios import (  # noqa: E402
+    SCENARIOS,
+    SOAK_SECONDS_PER_SLOT,
+    SOAK_WINDOWS,
+    ScenarioContext,
+    run_scenario,
+)
+from lambda_ethereum_consensus_tpu.slo import SOAK_SLOS, SloEngine  # noqa: E402
+from lambda_ethereum_consensus_tpu.telemetry import get_metrics  # noqa: E402
+from lambda_ethereum_consensus_tpu.tracing import get_recorder  # noqa: E402
+
+SCENARIO_ORDER = ("steady", "storm", "partition", "equivocation", "churn")
+
+# which scenarios drive which SLO rows: a row is EXERCISED (empty ==
+# violation) when any of its driving scenarios ran; otherwise UNCHECKED
+EXERCISED_BY = {
+    "attestation_admit_apply_p95": {"steady", "storm"},
+    "ingest_lane_wait_p95": {"steady", "storm"},
+    "ingest_sched_p99": {"steady", "storm"},
+    "block_arrival_offset_p95": {"steady"},
+    "head_update_delay_p95": {"steady"},
+    "gossip_drain_p95": {"partition", "equivocation", "churn"},
+    "block_transition_p95": {"partition", "equivocation", "churn"},
+    "chaos_recovery_p95": {"storm", "partition", "equivocation", "churn"},
+    "fleet_divergence_p95": {"partition"},
+}
+
+
+def scenario_knob(name: str) -> str:
+    return f"SOAK_NO_{name.upper()}"
+
+
+def _knob_set(env, name: str) -> bool:
+    return (env.get(scenario_knob(name), "") or "").lower() in ("1", "true", "yes")
+
+
+def required_scenarios(env=None) -> tuple[str, ...]:
+    """The scenario set a run under ``env`` must produce records for —
+    the ``SOAK_NO_*`` knob inventory (tests/unit/test_soak_validate.py
+    enumerates these the way the BENCH_NO_* gates are enumerated)."""
+    env = os.environ if env is None else env
+    return tuple(n for n in SCENARIO_ORDER if not _knob_set(env, n))
+
+
+# ------------------------------------------------------------- validation
+
+def validate_artifact(path: str, env=None) -> list[str]:
+    """Audit one SOAK artifact: every scenario the producing run's
+    recorded knobs enabled must carry a record with a verdict, fault
+    scenarios must have observed injected faults, and the headline
+    ``ok`` must agree with the violation list.  Returns problems."""
+    problems: list[str] = []
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"unreadable artifact: {e}"]
+    if not isinstance(data, dict) or "scenarios" not in data:
+        return ["artifact carries no scenario records at all"]
+    soak = data.get("soak") or {}
+    disabled = soak.get("disabled_scenarios")
+    if disabled is not None:
+        required = [n for n in SCENARIO_ORDER if n not in disabled]
+    else:
+        required = list(required_scenarios(env))
+    records = {
+        r.get("scenario"): r
+        for r in data.get("scenarios", ())
+        if isinstance(r, dict)
+    }
+    for name in required:
+        record = records.get(name)
+        if record is None:
+            problems.append(
+                f"scenario {name!r} is missing from the artifact "
+                "(truncated run?)"
+            )
+            continue
+        if "ok" not in record:
+            problems.append(f"scenario {name!r} carries no verdict")
+            continue
+        if name != "steady":
+            faults = record.get("faults") or {}
+            if record.get("ok") and not any(
+                v > 0 for v in faults.values()
+            ):
+                problems.append(
+                    f"scenario {name!r} claims ok with zero observed "
+                    "injected faults — the chaos layer never fired"
+                )
+    if "slo_report" not in data:
+        problems.append("artifact carries no SLO report")
+    if data.get("ok") and data.get("violations"):
+        problems.append("artifact claims ok:true but carries violations")
+    if not data.get("ok") and not data.get("violations"):
+        problems.append("artifact claims ok:false without any violation rows")
+    return problems
+
+
+# ------------------------------------------------------------------- gate
+
+def _usage_error(message: str):
+    print(f"soak_check: {message}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def parse_budget_overrides(pairs: list[str]) -> dict[str, float]:
+    overrides = {}
+    for pair in pairs:
+        name, _, value = pair.partition("=")
+        if not value:
+            _usage_error(f"--budget wants name=value, got {pair!r}")
+        try:
+            overrides[name] = float(value)
+        except ValueError:
+            _usage_error(f"--budget value not a number: {pair!r}")
+    return overrides
+
+
+def build_slos(overrides: dict[str, float]):
+    known = {s.name for s in SOAK_SLOS}
+    unknown = sorted(set(overrides) - known)
+    if unknown:
+        _usage_error(
+            f"unknown SLO name(s): {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(known))})"
+        )
+    try:
+        return tuple(
+            dataclasses.replace(s, budget=overrides[s.name])
+            if s.name in overrides else s
+            for s in SOAK_SLOS
+        )
+    except ValueError as e:
+        _usage_error(str(e))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="short seeded CI profile (~2 min)")
+    ap.add_argument("--scenario", action="append", default=[],
+                    metavar="NAME",
+                    help="run only this scenario (repeatable; default: "
+                         "every scenario the SOAK_NO_* knobs allow)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="fault-schedule seed (default: SOAK_SEED env or 7)")
+    ap.add_argument("--budget", action="append", default=[],
+                    metavar="NAME=SECONDS",
+                    help="override one SLO budget (repeatable)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the artifact to PATH")
+    ap.add_argument("--validate", metavar="PATH", default=None,
+                    help="audit an existing SOAK artifact and exit")
+    ap.add_argument("--list", action="store_true",
+                    help="print the scenario catalogue and exit")
+    args = ap.parse_args()
+
+    if args.list:
+        for name in SCENARIO_ORDER:
+            doc = (SCENARIOS[name].__doc__ or "").strip().splitlines()[0]
+            print(f"{name}: {doc}")
+        return 0
+
+    if args.validate:
+        problems = validate_artifact(args.validate)
+        summary = {
+            "artifact": args.validate,
+            "ok": not problems,
+            "problems": problems,
+        }
+        print(json.dumps(summary))
+        for problem in problems:
+            print(f"SOAK VALIDATE: {problem}", file=sys.stderr)
+        return 1 if problems else 0
+
+    for name in args.scenario:
+        if name not in SCENARIOS:
+            _usage_error(
+                f"unknown scenario {name!r} "
+                f"(known: {', '.join(SCENARIO_ORDER)})"
+            )
+    try:
+        seed = args.seed if args.seed is not None else int(
+            os.environ.get("SOAK_SEED", "") or 7
+        )
+    except ValueError:
+        _usage_error("SOAK_SEED must be an integer")
+
+    chosen = tuple(
+        n for n in SCENARIO_ORDER
+        if (not args.scenario or n in args.scenario)
+        and not _knob_set(os.environ, n)
+    )
+    if not chosen:
+        _usage_error("every scenario is disabled; nothing to run")
+
+    # the gate measures; it must not be silently disabled by the env
+    get_metrics().set_enabled(True)
+    get_recorder().set_enabled(True)
+
+    engine = SloEngine(
+        slos=build_slos(parse_budget_overrides(args.budget)),
+        windows=SOAK_WINDOWS,
+    )
+    t0 = time.monotonic()
+    records = []
+    with tempfile.TemporaryDirectory(prefix="soak_") as base_dir:
+        ctx = ScenarioContext(
+            seed=seed, smoke=args.smoke, engine=engine, base_dir=base_dir
+        )
+        for name in chosen:
+            print(f"soak_check: scenario {name} ...", file=sys.stderr)
+            record = run_scenario(name, ctx)
+            records.append(record)
+            print(
+                f"soak_check: scenario {name} "
+                f"{'ok' if record.get('ok') else 'FAILED'} "
+                f"({record['elapsed_s']}s)",
+                file=sys.stderr,
+            )
+
+    report = engine.evaluate()
+
+    # anti-silent-green: exercised rows must have data; undriveable ones
+    # are surfaced as unchecked rather than omitted.  Budget breaches
+    # only GATE on rows the chosen scenario set exercises — a fleet
+    # scenario's handful of honest catch-up head updates would otherwise
+    # fail a slot-phase row that only the steady profile's recorded
+    # schedule meaningfully populates; breaches on un-exercised rows
+    # still surface, as advisory lines
+    exercised = {
+        slo for slo, drivers in EXERCISED_BY.items()
+        if drivers & set(chosen)
+    }
+    advisory = [
+        v for v in report["violations"] if v["slo"] not in exercised
+    ]
+    violations = [
+        v for v in report["violations"] if v["slo"] in exercised
+    ] + list(ctx.violations)
+    unchecked = []
+    for row in report["slos"]:
+        if row["count"] > 0:
+            continue
+        if row["slo"] in exercised:
+            violations.append({
+                "slo": row["slo"],
+                "series": row["series"],
+                "window": "cumulative",
+                "quantile": row["quantile"],
+                "observed": None,
+                "budget": row["budget"],
+                "count": 0,
+                "reason": "no_data from an exercised scenario set",
+            })
+        else:
+            unchecked.append(row["slo"])
+
+    artifact = {
+        "soak": {
+            "mode": "smoke" if args.smoke else "full",
+            "seed": seed,
+            "seconds_per_slot": SOAK_SECONDS_PER_SLOT,
+            "duration_s": round(time.monotonic() - t0, 3),
+            "scenarios_run": list(chosen),
+            "disabled_scenarios": [
+                n for n in SCENARIO_ORDER if n not in chosen
+            ],
+        },
+        "scenarios": records,
+        "slo_report": report,
+        "violations": violations,
+        "advisory": advisory,
+        "unchecked": unchecked,
+        "ok": not violations,
+    }
+    print(json.dumps(artifact, indent=2))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+
+    for v in violations:
+        observed = (
+            f"{v['observed']:.6f}s" if isinstance(v.get("observed"), float)
+            else "no_data"
+        )
+        reason = f" reason={v['reason']!r}" if v.get("reason") else ""
+        print(
+            "SOAK VIOLATION "
+            f"slo={v['slo']} series={v['series']} window={v['window']} "
+            f"p{int(v['quantile'] * 100)} observed={observed} "
+            f"budget={v['budget']}s count={v['count']}{reason}",
+            file=sys.stderr,
+        )
+    for v in advisory:
+        print(
+            f"soak_check: ADVISORY {v['slo']} breaching "
+            f"(observed={v.get('observed')}, budget={v['budget']}s) but "
+            "not exercised by the chosen scenario set — not gating",
+            file=sys.stderr,
+        )
+    for name in unchecked:
+        print(
+            f"soak_check: UNCHECKED {name} — not driven by the chosen "
+            "scenario set",
+            file=sys.stderr,
+        )
+    if violations:
+        return 1
+    print(
+        f"soak_check: {len(chosen)} scenarios green, "
+        f"{len(report['slos']) - len(unchecked)} SLOs within budget "
+        f"({len(unchecked)} unchecked)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

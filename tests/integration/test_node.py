@@ -8,21 +8,26 @@ strategy (ref: test/unit/libp2p_port_test.exs:30-50) at whole-node scope.
 
 import asyncio
 import json
-import time
 import urllib.request
+from contextlib import AsyncExitStack
 
 import pytest
 
-from lambda_ethereum_consensus_tpu.config import minimal_spec, use_chain_spec
-from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.chaos.faults import FaultSpec
+from lambda_ethereum_consensus_tpu.chaos.fleet import (
+    Fleet,
+    default_keys,
+    make_chain,
+    started_node,
+)
+from lambda_ethereum_consensus_tpu.config import use_chain_spec
 from lambda_ethereum_consensus_tpu.fork_choice import get_head
 from lambda_ethereum_consensus_tpu.network.gossip import publish_ssz, topic_name
-from lambda_ethereum_consensus_tpu.node import BeaconNode, NodeConfig
-from lambda_ethereum_consensus_tpu.state_transition.genesis import build_genesis_state
+from lambda_ethereum_consensus_tpu.node import NodeConfig
 from lambda_ethereum_consensus_tpu.validator import build_signed_block
 
 N = 64
-SKS = [(i + 1).to_bytes(32, "big") for i in range(N)]
+SKS = default_keys(N)
 CHAIN_LEN = 5
 
 # NodeConfig defaults to the real libp2p wire, whose sidecar subprocess
@@ -48,26 +53,15 @@ def run(coro):
 
 @pytest.fixture
 def chain():
-    """Genesis (recent wall-clock genesis_time) + CHAIN_LEN built blocks.
-
-    genesis_time sits just far enough in the past that slots 1..CHAIN_LEN+1
-    are acceptable now — and stays inside the one-epoch gossip window for as
-    long as possible, so slow machines don't flake the gossip assertion.
-    Function-scoped on purpose: each test gets a FRESH wall-clock window
-    (a module-scoped chain ages while earlier tests run, and the gossip
-    acceptance window is only ~51 s on the minimal preset).
-    """
-    with use_chain_spec(minimal_spec()) as spec:
-        genesis_time = int(time.time()) - (CHAIN_LEN + 1) * spec.SECONDS_PER_SLOT - 2
-        genesis = build_genesis_state(
-            [bls.sk_to_pk(sk) for sk in SKS], genesis_time=genesis_time, spec=spec
-        )
-        blocks = []
-        state = genesis
-        for slot in range(1, CHAIN_LEN + 1):
-            signed, state = build_signed_block(state, slot, SKS, spec=spec)
-            blocks.append(signed)
-        yield spec, genesis, blocks, state
+    """The minted chain fixture, now shared verbatim with the chaos
+    harness (``chaos.fleet.make_chain`` — the ISSUE-14 satellite: one
+    source of chain-minting truth, so this test and the soak fleet
+    cannot drift).  Function-scoped on purpose: each test gets a FRESH
+    wall-clock window (a module-scoped chain ages while earlier tests
+    run, and the gossip acceptance window is only ~51 s on the minimal
+    preset)."""
+    bundle = make_chain(n_keys=N, chain_len=CHAIN_LEN)
+    yield bundle.spec, bundle.genesis, bundle.blocks, bundle.tip_state
 
 
 @pytest.mark.parametrize(
@@ -83,180 +77,179 @@ def test_two_nodes_sync_and_gossip(chain, tmp_path, wire):
     spec, genesis, blocks, tip_state = chain
 
     async def main():
-        with use_chain_spec(spec):
-            # the subnet the upcoming attestation (slot CHAIN_LEN, committee
-            # 0) actually maps to — publishing anywhere else is a p2p-spec
-            # REJECT now that subnet validation is on
-            from lambda_ethereum_consensus_tpu.state_transition import (
-                accessors as acc,
-                misc as stm,
-            )
-
-            att_subnet = stm.compute_subnet_for_attestation(
-                acc.get_committee_count_per_slot(
-                    genesis, stm.compute_epoch_at_slot(CHAIN_LEN, spec), spec
-                ),
-                CHAIN_LEN,
-                0,
-                spec,
-            )
-            subnets = (0, 1, att_subnet)
-            node_a = BeaconNode(
-                NodeConfig(
-                    db_path=str(tmp_path / "a.wal"),
-                    genesis_state=genesis,
-                    enable_range_sync=False,
-                    wire=wire,
-                    attnet_subnets=subnets,
-                ),
-                spec,
-            )
-            await node_a.start()
-            # seed A's chain through the real pending-blocks/on_block path
-            for signed in blocks:
-                node_a.pending.add_block(signed)
-            applied = await node_a.pending.process_once()
-            assert applied == CHAIN_LEN
-            head_a = get_head(node_a.store, spec)
-            assert node_a.store.blocks[head_a].slot == CHAIN_LEN
-
-            if wire == "libp2p":
-                assert node_a.port.enr and node_a.port.enr.startswith("enr:")
-                # full ENR: eth2 + attnets/syncnets bitfields (ref:
-                # discovery.go:48-77) — default config subscribes {0, 1}
-                from lambda_ethereum_consensus_tpu.network.discovery.enr import (
-                    ENR,
+        # boot/teardown through the shared chaos-fleet plumbing (the
+        # ISSUE-14 satellite); linear enter here keeps the body flat
+        async with AsyncExitStack() as stack:
+            with use_chain_spec(spec):
+                # the subnet the upcoming attestation (slot CHAIN_LEN, committee
+                # 0) actually maps to — publishing anywhere else is a p2p-spec
+                # REJECT now that subnet validation is on
+                from lambda_ethereum_consensus_tpu.state_transition import (
+                    accessors as acc,
+                    misc as stm,
                 )
 
-                rec = ENR.from_text(node_a.port.enr)
-                expected_attnets = bytearray(8)
-                for i in set(subnets):
-                    expected_attnets[i // 8] |= 1 << (i % 8)
-                assert rec.kv.get(b"attnets") == bytes(expected_attnets)
-                assert rec.kv.get(b"syncnets") == b"\x00"
-                bootnode = node_a.port.enr  # discovery, not an address
-            else:
-                bootnode = f"127.0.0.1:{node_a.port.listen_port}"
-            node_b = BeaconNode(
-                NodeConfig(
-                    db_path=str(tmp_path / "b.wal"),
-                    genesis_state=genesis,
-                    bootnodes=[bootnode],
-                    enable_range_sync=True,
-                    wire=wire,
-                    attnet_subnets=subnets,
-                ),
-                spec,
-            )
-            await node_b.start()
-
-            # wait until B catches up to A's head via range sync
-            for _ in range(200):
-                await node_b.pending.process_once()
-                if get_head(node_b.store, spec) == head_a:
-                    break
-                await asyncio.sleep(0.25)
-            assert get_head(node_b.store, spec) == head_a, "range sync failed"
-
-            # now extend the chain and gossip the new block from A
-            signed6, _ = build_signed_block(tip_state, CHAIN_LEN + 1, SKS, spec=spec)
-            node_a.pending.add_block(signed6)
-            await node_a.pending.process_once()
-            if wire == "libp2p":
-                await asyncio.sleep(1.0)  # meshsub heartbeat grafts the meshes
-            digest = node_a.chain.fork_digest()
-            await publish_ssz(
-                node_a.port, topic_name(digest, "beacon_block"), signed6, spec
-            )
-            root6 = signed6.message.hash_tree_root(spec)
-            for _ in range(200):
-                await node_b.pending.process_once()
-                if get_head(node_b.store, spec) == root6:
-                    break
-                await asyncio.sleep(0.25)
-            assert get_head(node_b.store, spec) == root6, "gossip block not applied"
-
-            # ---- attestation subnet: beacon_attestation_{i} end to end ----
-            # (VERDICT r3 missing #6) an unaggregated committee vote rides
-            # the subnet topic into B's fork choice via the batched verify
-            from lambda_ethereum_consensus_tpu.state_transition import (
-                accessors,
-                misc as st_misc,
-            )
-            from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint
-            from lambda_ethereum_consensus_tpu.validator.duties import (
-                make_attestation,
-            )
-
-            state6 = node_a.store.block_states[root6]
-            att_slot = CHAIN_LEN
-            t_epoch = st_misc.compute_epoch_at_slot(att_slot, spec)
-            vote = make_attestation(
-                state6,
-                att_slot,
-                0,
-                accessors.get_block_root_at_slot(state6, att_slot, spec),
-                Checkpoint(
-                    epoch=t_epoch,
-                    root=accessors.get_block_root(state6, t_epoch, spec),
-                ),
-                Checkpoint(
-                    epoch=state6.current_justified_checkpoint.epoch,
-                    root=bytes(state6.current_justified_checkpoint.root),
-                ),
-                SKS,
-                spec,
-                only_position=0,  # subnets carry single-validator votes
-            )
-            before = len(node_b.store.latest_messages)
-            await publish_ssz(
-                node_a.port,
-                topic_name(digest, f"beacon_attestation_{att_subnet}"),
-                vote,
-                spec,
-            )
-            for _ in range(200):
-                if len(node_b.store.latest_messages) > before:
-                    break
-                await asyncio.sleep(0.25)
-            assert len(node_b.store.latest_messages) > before, (
-                "subnet attestation did not reach B's fork choice"
-            )
-
-            # persistence carried the synced chain
-            assert node_b.blocks_db.highest_slot() == CHAIN_LEN + 1
-
-            if wire is None:  # API checks are wire-independent; run once
-                # ---------------- Beacon API over real HTTP against node A
-                # (urllib blocks, so run it off-loop — the server lives on this loop)
-                base = f"http://127.0.0.1:{node_a.api.port}"
-                loop = asyncio.get_running_loop()
-
-                def get_sync(path):
-                    with urllib.request.urlopen(base + path, timeout=10) as r:
-                        return json.loads(r.read())
-
-                async def get(path):
-                    return await loop.run_in_executor(None, get_sync, path)
-
-                head_resp = await get("/eth/v1/beacon/blocks/head/root")
-                assert head_resp["data"]["root"] == "0x" + root6.hex()
-                by_slot = await get(f"/eth/v1/beacon/blocks/{CHAIN_LEN}/root")
-                assert by_slot["data"]["root"] == (
-                    "0x" + blocks[-1].message.hash_tree_root(spec).hex()
+                att_subnet = stm.compute_subnet_for_attestation(
+                    acc.get_committee_count_per_slot(
+                        genesis, stm.compute_epoch_at_slot(CHAIN_LEN, spec), spec
+                    ),
+                    CHAIN_LEN,
+                    0,
+                    spec,
                 )
-                block_v2 = await get(f"/eth/v2/beacon/blocks/0x{root6.hex()}")
-                assert block_v2["data"]["message"]["slot"] == str(CHAIN_LEN + 1)
-                state_root = await get("/eth/v1/beacon/states/head/root")
-                assert state_root["data"]["root"].startswith("0x")
-                metrics_body = await loop.run_in_executor(
-                    None,
-                    lambda: urllib.request.urlopen(base + "/metrics", timeout=10).read(),
-                )
-                assert b"peers_connection_count" in metrics_body
+                subnets = (0, 1, att_subnet)
+                node_a = await stack.enter_async_context(started_node(
+                    NodeConfig(
+                        db_path=str(tmp_path / "a.wal"),
+                        genesis_state=genesis,
+                        enable_range_sync=False,
+                        wire=wire,
+                        attnet_subnets=subnets,
+                    ),
+                    spec,
+                ))
+                # seed A's chain through the real pending-blocks/on_block path
+                for signed in blocks:
+                    node_a.pending.add_block(signed)
+                applied = await node_a.pending.process_once()
+                assert applied == CHAIN_LEN
+                head_a = get_head(node_a.store, spec)
+                assert node_a.store.blocks[head_a].slot == CHAIN_LEN
 
-            await node_b.stop()
-            await node_a.stop()
+                if wire == "libp2p":
+                    assert node_a.port.enr and node_a.port.enr.startswith("enr:")
+                    # full ENR: eth2 + attnets/syncnets bitfields (ref:
+                    # discovery.go:48-77) — default config subscribes {0, 1}
+                    from lambda_ethereum_consensus_tpu.network.discovery.enr import (
+                        ENR,
+                    )
+
+                    rec = ENR.from_text(node_a.port.enr)
+                    expected_attnets = bytearray(8)
+                    for i in set(subnets):
+                        expected_attnets[i // 8] |= 1 << (i % 8)
+                    assert rec.kv.get(b"attnets") == bytes(expected_attnets)
+                    assert rec.kv.get(b"syncnets") == b"\x00"
+                    bootnode = node_a.port.enr  # discovery, not an address
+                else:
+                    bootnode = f"127.0.0.1:{node_a.port.listen_port}"
+                node_b = await stack.enter_async_context(started_node(
+                    NodeConfig(
+                        db_path=str(tmp_path / "b.wal"),
+                        genesis_state=genesis,
+                        bootnodes=[bootnode],
+                        enable_range_sync=True,
+                        wire=wire,
+                        attnet_subnets=subnets,
+                    ),
+                    spec,
+                ))
+
+                # wait until B catches up to A's head via range sync
+                for _ in range(200):
+                    await node_b.pending.process_once()
+                    if get_head(node_b.store, spec) == head_a:
+                        break
+                    await asyncio.sleep(0.25)
+                assert get_head(node_b.store, spec) == head_a, "range sync failed"
+
+                # now extend the chain and gossip the new block from A
+                signed6, _ = build_signed_block(tip_state, CHAIN_LEN + 1, SKS, spec=spec)
+                node_a.pending.add_block(signed6)
+                await node_a.pending.process_once()
+                if wire == "libp2p":
+                    await asyncio.sleep(1.0)  # meshsub heartbeat grafts the meshes
+                digest = node_a.chain.fork_digest()
+                await publish_ssz(
+                    node_a.port, topic_name(digest, "beacon_block"), signed6, spec
+                )
+                root6 = signed6.message.hash_tree_root(spec)
+                for _ in range(200):
+                    await node_b.pending.process_once()
+                    if get_head(node_b.store, spec) == root6:
+                        break
+                    await asyncio.sleep(0.25)
+                assert get_head(node_b.store, spec) == root6, "gossip block not applied"
+
+                # ---- attestation subnet: beacon_attestation_{i} end to end ----
+                # (VERDICT r3 missing #6) an unaggregated committee vote rides
+                # the subnet topic into B's fork choice via the batched verify
+                from lambda_ethereum_consensus_tpu.state_transition import (
+                    accessors,
+                    misc as st_misc,
+                )
+                from lambda_ethereum_consensus_tpu.types.beacon import Checkpoint
+                from lambda_ethereum_consensus_tpu.validator.duties import (
+                    make_attestation,
+                )
+
+                state6 = node_a.store.block_states[root6]
+                att_slot = CHAIN_LEN
+                t_epoch = st_misc.compute_epoch_at_slot(att_slot, spec)
+                vote = make_attestation(
+                    state6,
+                    att_slot,
+                    0,
+                    accessors.get_block_root_at_slot(state6, att_slot, spec),
+                    Checkpoint(
+                        epoch=t_epoch,
+                        root=accessors.get_block_root(state6, t_epoch, spec),
+                    ),
+                    Checkpoint(
+                        epoch=state6.current_justified_checkpoint.epoch,
+                        root=bytes(state6.current_justified_checkpoint.root),
+                    ),
+                    SKS,
+                    spec,
+                    only_position=0,  # subnets carry single-validator votes
+                )
+                before = len(node_b.store.latest_messages)
+                await publish_ssz(
+                    node_a.port,
+                    topic_name(digest, f"beacon_attestation_{att_subnet}"),
+                    vote,
+                    spec,
+                )
+                for _ in range(200):
+                    if len(node_b.store.latest_messages) > before:
+                        break
+                    await asyncio.sleep(0.25)
+                assert len(node_b.store.latest_messages) > before, (
+                    "subnet attestation did not reach B's fork choice"
+                )
+
+                # persistence carried the synced chain
+                assert node_b.blocks_db.highest_slot() == CHAIN_LEN + 1
+
+                if wire is None:  # API checks are wire-independent; run once
+                    # ---------------- Beacon API over real HTTP against node A
+                    # (urllib blocks, so run it off-loop — the server lives on this loop)
+                    base = f"http://127.0.0.1:{node_a.api.port}"
+                    loop = asyncio.get_running_loop()
+
+                    def get_sync(path):
+                        with urllib.request.urlopen(base + path, timeout=10) as r:
+                            return json.loads(r.read())
+
+                    async def get(path):
+                        return await loop.run_in_executor(None, get_sync, path)
+
+                    head_resp = await get("/eth/v1/beacon/blocks/head/root")
+                    assert head_resp["data"]["root"] == "0x" + root6.hex()
+                    by_slot = await get(f"/eth/v1/beacon/blocks/{CHAIN_LEN}/root")
+                    assert by_slot["data"]["root"] == (
+                        "0x" + blocks[-1].message.hash_tree_root(spec).hex()
+                    )
+                    block_v2 = await get(f"/eth/v2/beacon/blocks/0x{root6.hex()}")
+                    assert block_v2["data"]["message"]["slot"] == str(CHAIN_LEN + 1)
+                    state_root = await get("/eth/v1/beacon/states/head/root")
+                    assert state_root["data"]["root"].startswith("0x")
+                    metrics_body = await loop.run_in_executor(
+                        None,
+                        lambda: urllib.request.urlopen(base + "/metrics", timeout=10).read(),
+                    )
+                    assert b"peers_connection_count" in metrics_body
+
 
     run(main())
 
@@ -270,30 +263,26 @@ def test_checkpoint_sync_from_our_own_api(chain, tmp_path):
 
     async def main():
         with use_chain_spec(spec):
-            node_a = BeaconNode(
+            async with started_node(
                 NodeConfig(
                     db_path=str(tmp_path / "ca.wal"),
                     genesis_state=genesis,
                     enable_range_sync=False,
                 ),
                 spec,
-            )
-            await node_a.start()
-            node_c = BeaconNode(
-                NodeConfig(
-                    db_path=str(tmp_path / "cc.wal"),
-                    checkpoint_sync_url=f"http://127.0.0.1:{node_a.api.port}",
-                    enable_range_sync=False,
-                ),
-                spec,
-            )
-            await node_c.start()
-            # C anchored on A's finalized state (genesis here)
-            head_c = get_head(node_c.store, spec)
-            state_c = node_c.store.block_states[head_c]
-            assert state_c.hash_tree_root(spec) == genesis.hash_tree_root(spec)
-            await node_c.stop()
-            await node_a.stop()
+            ) as node_a:
+                async with started_node(
+                    NodeConfig(
+                        db_path=str(tmp_path / "cc.wal"),
+                        checkpoint_sync_url=f"http://127.0.0.1:{node_a.api.port}",
+                        enable_range_sync=False,
+                    ),
+                    spec,
+                ) as node_c:
+                    # C anchored on A's finalized state (genesis here)
+                    head_c = get_head(node_c.store, spec)
+                    state_c = node_c.store.block_states[head_c]
+                    assert state_c.hash_tree_root(spec) == genesis.hash_tree_root(spec)
 
     run(main())
 
@@ -304,31 +293,105 @@ def test_node_restart_resumes_from_db(chain, tmp_path):
 
     async def main():
         with use_chain_spec(spec):
-            node = BeaconNode(
+            async with started_node(
                 NodeConfig(
                     db_path=str(tmp_path / "resume.wal"),
                     genesis_state=genesis,
                     enable_range_sync=False,
                 ),
                 spec,
-            )
-            await node.start()
-            for signed in blocks[:3]:
-                node.pending.add_block(signed)
-            await node.pending.process_once()
-            head = get_head(node.store, spec)
-            await node.stop()
+            ) as node:
+                for signed in blocks[:3]:
+                    node.pending.add_block(signed)
+                await node.pending.process_once()
+                head = get_head(node.store, spec)
 
-            node2 = BeaconNode(
+            async with started_node(
                 NodeConfig(
                     db_path=str(tmp_path / "resume.wal"),
                     enable_range_sync=False,
                 ),
                 spec,
+            ) as node2:
+                assert get_head(node2.store, spec) == head
+                assert node2.store.blocks[head].slot == 3
+
+    run(main())
+
+
+@pytest.mark.slow
+def test_three_node_fleet_partition_and_heal(tmp_path):
+    """The chaos-harness Fleet at integration scope: three nodes over the
+    real bespoke wire, a seeded partition isolates one member while the
+    majority extends the chain, and after healing the fleet reconverges
+    on ONE head — the ISSUE-14 acceptance scenario, asserted here in the
+    tier-1 lane (the soak gate replays it slot-clocked with link faults).
+
+    Runs on the 2 s soak slot length: post-heal convergence needs a FRESH
+    block (a partition-dropped message id sits in the sidecar seen-cache
+    for its whole TTL, so the isolated member can only recover through a
+    new descendant whose ancestors back-fill over req/resp), and a fresh
+    block means waiting out a slot boundary.
+    """
+    from lambda_ethereum_consensus_tpu.chaos.scenarios import soak_spec
+
+    bundle = make_chain(n_keys=N, chain_len=3, spec=soak_spec())
+    spec = bundle.spec
+
+    async def main():
+        async def wait_for_slot(node, min_slot):
+            while node.store.current_slot(spec) < min_slot:
+                await asyncio.sleep(0.1)
+            return int(node.store.current_slot(spec))
+
+        with use_chain_spec(spec):
+            # inert FaultSpec: chaos-wrapped (so partitions are
+            # enforceable) but no link faults — determinism belongs to
+            # the seeded soak profiles, speed belongs here
+            fleet = await Fleet.boot(
+                3, bundle, str(tmp_path), fault_spec=FaultSpec(), seed=3
             )
-            await node2.start()
-            assert get_head(node2.store, spec) == head
-            assert node2.store.blocks[head].slot == 3
-            await node2.stop()
+            try:
+                seed_head = bundle.blocks[-1].message.hash_tree_root(spec)
+                assert await fleet.wait_converged(30.0, root=seed_head), (
+                    "fleet did not range-sync the seed chain"
+                )
+                fleet.partition([[0, 1], [2]])
+                cur = await wait_for_slot(
+                    fleet.nodes[0], int(bundle.tip_state.slot) + 1
+                )
+                signed, post = build_signed_block(
+                    bundle.tip_state, cur, bundle.sks, spec=spec
+                )
+                root = await fleet.publish_block(0, signed)
+                # the majority side applies it; the isolated member must not
+                for _ in range(40):
+                    await fleet.nodes[1].pending.process_once()
+                    if get_head(fleet.nodes[1].store, spec) == root:
+                        break
+                    await asyncio.sleep(0.25)
+                assert get_head(fleet.nodes[1].store, spec) == root, (
+                    "majority-side gossip did not survive the partition"
+                )
+                assert get_head(fleet.nodes[2].store, spec) == seed_head, (
+                    "the partition leaked the new block to the isolated node"
+                )
+                assert fleet.sample_heads()["distinct"] == 2
+                assert fleet.chaos[2].port.fault_counts["partition_drop"] >= 1, (
+                    "the cut was never enforced by the chaos layer"
+                )
+                fleet.heal()
+                # a FRESH post-heal block: its gossip arrival hands the
+                # laggard a descendant whose missing ancestors it fetches
+                # through the (now unblocked) req/resp path
+                cur = await wait_for_slot(fleet.nodes[0], int(post.slot) + 1)
+                signed2, _ = build_signed_block(post, cur, bundle.sks, spec=spec)
+                root2 = await fleet.publish_block(0, signed2)
+                assert await fleet.wait_converged(30.0, root=root2), (
+                    f"fleet did not reconverge after healing "
+                    f"(heads={[h.hex()[:12] for h in fleet.heads()]})"
+                )
+            finally:
+                await fleet.stop()
 
     run(main())

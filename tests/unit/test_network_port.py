@@ -231,3 +231,169 @@ def test_mesh_grafts_between_subscribers():
         await b.close()
 
     run(main())
+
+
+# ------------------------------------------------- round 19: robustness
+
+class _FakeProc:
+    returncode = None
+
+
+def _stub_port():
+    """A Port with a live-looking process and no subprocess behind it —
+    _roundtrip is replaced per test, so the retry policy is exercised
+    in isolation from the wire."""
+    port = Port()
+    port._proc = _FakeProc()
+    return port
+
+
+def test_command_absorbs_one_transient_error():
+    """The ISSUE-14 satellite pin: a single injected transient failure is
+    retried away (and counted on port_retry_total{command}); the caller
+    never sees it."""
+    from lambda_ethereum_consensus_tpu.network.port import PortError
+    from lambda_ethereum_consensus_tpu.network.proto import port_pb2
+    from lambda_ethereum_consensus_tpu.telemetry import get_metrics
+
+    async def main():
+        m = get_metrics()
+        m.set_enabled(True)
+        before = m.get("port_retry_total", command="publish")
+        port = _stub_port()
+        attempts = []
+
+        async def flaky(cmd, timeout):
+            attempts.append(cmd.WhichOneof("c"))
+            if len(attempts) == 1:
+                raise PortError("transient sidecar hiccup")
+            result = port_pb2.Result()
+            result.ok = True
+            return result
+
+        port._roundtrip = flaky
+        cmd = port_pb2.Command()
+        cmd.publish.topic = "t"
+        cmd.publish.payload = b"x"
+        result = await port._command(cmd)
+        assert result.ok
+        assert attempts == ["publish", "publish"]
+        assert m.get("port_retry_total", command="publish") == before + 1
+
+    run(main())
+
+
+def test_command_persistent_error_still_raises():
+    """Bounded: a failure on every attempt surfaces after the retry
+    budget — the supervisor must see real outages."""
+    from lambda_ethereum_consensus_tpu.network.port import (
+        PortError,
+        _retry_max,
+    )
+    from lambda_ethereum_consensus_tpu.network.proto import port_pb2
+
+    async def main():
+        port = _stub_port()
+        attempts = []
+
+        async def broken(cmd, timeout):
+            attempts.append(1)
+            raise PortError("sidecar is wedged")
+
+        port._roundtrip = broken
+        cmd = port_pb2.Command()
+        cmd.publish.topic = "t"
+        cmd.publish.payload = b"x"
+        with pytest.raises(PortError):
+            await port._command(cmd)
+        assert len(attempts) == 1 + _retry_max()
+
+    run(main())
+
+
+def test_command_dead_sidecar_skips_retries():
+    """Once the sidecar is gone the failure is terminal for this Port:
+    re-sending into a corpse would just burn the backoff schedule."""
+    from lambda_ethereum_consensus_tpu.network.port import PortError
+    from lambda_ethereum_consensus_tpu.network.proto import port_pb2
+
+    async def main():
+        port = _stub_port()
+        attempts = []
+
+        async def dies(cmd, timeout):
+            attempts.append(1)
+            port._dead = True  # the read loop noticed the exit
+            raise PortError("sidecar exited")
+
+        port._roundtrip = dies
+        cmd = port_pb2.Command()
+        cmd.publish.topic = "t"
+        cmd.publish.payload = b"x"
+        with pytest.raises(PortError):
+            await port._command(cmd)
+        assert len(attempts) == 1  # no retry against a dead sidecar
+
+    run(main())
+
+
+def test_early_peer_events_replay_on_handler_assignment():
+    """new_peer/peer_gone notifications that arrive before the node wires
+    its handlers (the sidecar dials bootnodes during init — on loopback
+    the handshake can win that race) must replay on assignment, not drop:
+    a dropped new_peer left the host-side peerbook empty and range sync
+    idle while the sidecar was happily connected (found by the ISSUE-14
+    chaos fleet)."""
+    from lambda_ethereum_consensus_tpu.network.proto import port_pb2
+
+    async def main():
+        port = _stub_port()
+        n = port_pb2.Notification()
+        n.new_peer.peer_id = b"p1"
+        n.new_peer.addr = "127.0.0.1:9"
+        await port._dispatch(n)
+        gone = port_pb2.Notification()
+        gone.peer_gone.peer_id = b"p2"
+        await port._dispatch(gone)
+
+        seen = []
+        port.on_new_peer = lambda pid, addr: seen.append(("new", pid, addr))
+        port.on_peer_gone = lambda pid: seen.append(("gone", pid))
+        assert seen == [("new", b"p1", "127.0.0.1:9"), ("gone", b"p2")]
+
+        # live path unchanged: the next notification dispatches directly
+        n2 = port_pb2.Notification()
+        n2.new_peer.peer_id = b"p3"
+        n2.new_peer.addr = "127.0.0.1:10"
+        await port._dispatch(n2)
+        assert seen[-1] == ("new", b"p3", "127.0.0.1:10")
+        assert port._early_peer_events == []
+
+    run(main())
+
+
+def test_early_peer_events_replay_preserves_cross_kind_order():
+    """A connect/disconnect/reconnect burst buffered during init must
+    replay in ARRIVAL order once both handlers attach — per-kind replay
+    would deliver the disconnect last and ghost a live peer."""
+    from lambda_ethereum_consensus_tpu.network.proto import port_pb2
+
+    async def main():
+        port = _stub_port()
+        for kind in ("new", "gone", "new"):
+            n = port_pb2.Notification()
+            if kind == "new":
+                n.new_peer.peer_id = b"p"
+                n.new_peer.addr = "127.0.0.1:9"
+            else:
+                n.peer_gone.peer_id = b"p"
+            await port._dispatch(n)
+
+        seen = []
+        port.on_new_peer = lambda pid, addr: seen.append("new")
+        # only the ordered prefix drains until the gone handler exists
+        assert seen == ["new"]
+        port.on_peer_gone = lambda pid: seen.append("gone")
+        assert seen == ["new", "gone", "new"]  # the peer ends CONNECTED
+
+    run(main())

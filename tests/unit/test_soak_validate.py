@@ -1,0 +1,185 @@
+"""soak_check.py artifact self-check (round 19 satellite): the
+SOAK_NO_* knob inventory, the truncated-artifact audit, and the
+gate-map/SLO-set contract — a SOAK_r*.json that silently lost a
+scenario (rc-124 truncation, a crashed runner) must fail --validate
+loudly, the way bench.py --validate audits bench artifacts."""
+
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+import soak_check  # noqa: E402
+
+from lambda_ethereum_consensus_tpu.chaos.scenarios import SCENARIOS  # noqa: E402
+from lambda_ethereum_consensus_tpu.slo import DEFAULT_SLOS, SOAK_SLOS  # noqa: E402
+
+ALL = ("steady", "storm", "partition", "equivocation", "churn")
+
+
+# ------------------------------------------------------------- inventory
+
+def test_scenario_knob_inventory():
+    """Every scenario in the catalogue has a SOAK_NO_* knob, and the
+    gate's required set honors each one — the same discipline the
+    BENCH_NO_* gates are pinned under."""
+    assert set(SCENARIOS) == set(ALL)
+    assert tuple(soak_check.SCENARIO_ORDER) == ALL
+    assert soak_check.required_scenarios(env={}) == ALL
+    for name in ALL:
+        knob = soak_check.scenario_knob(name)
+        assert knob == f"SOAK_NO_{name.upper()}"
+        remaining = soak_check.required_scenarios(env={knob: "1"})
+        assert name not in remaining
+        assert set(remaining) == set(ALL) - {name}
+
+
+def test_exercised_map_is_a_subset_of_the_soak_slos():
+    """The anti-silent-green map may only name rows the engine will
+    actually evaluate, and only scenarios that exist."""
+    slo_names = {s.name for s in SOAK_SLOS}
+    for slo, drivers in soak_check.EXERCISED_BY.items():
+        assert slo in slo_names, f"EXERCISED_BY names unknown SLO {slo!r}"
+        assert drivers <= set(ALL)
+    # the round-19 recovery rows ride on top of the full node budget set
+    assert {s.name for s in DEFAULT_SLOS} <= slo_names
+    assert "chaos_recovery_p95" in slo_names
+    assert "fleet_divergence_p95" in slo_names
+
+
+# ------------------------------------------------------------- artifacts
+
+def _artifact(tmp_path, mutate=None, disabled=()):
+    data = {
+        "soak": {
+            "mode": "smoke",
+            "seed": 7,
+            "disabled_scenarios": list(disabled),
+        },
+        "scenarios": [
+            {
+                "scenario": name,
+                "ok": True,
+                "faults": {} if name == "steady" else {"drop": 3.0},
+            }
+            for name in ALL
+            if name not in disabled
+        ],
+        "slo_report": {"slos": [], "violations": []},
+        "violations": [],
+        "ok": True,
+    }
+    if mutate is not None:
+        mutate(data)
+    path = tmp_path / "SOAK_test.json"
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_validate_green_artifact_passes(tmp_path):
+    assert soak_check.validate_artifact(_artifact(tmp_path)) == []
+
+
+def test_validate_flags_missing_scenario(tmp_path):
+    def drop_one(data):
+        data["scenarios"] = [
+            r for r in data["scenarios"] if r["scenario"] != "partition"
+        ]
+
+    problems = soak_check.validate_artifact(_artifact(tmp_path, drop_one))
+    assert any("partition" in p and "missing" in p for p in problems)
+
+
+def test_validate_follows_producer_knobs_not_validator_env(tmp_path):
+    """A scenario the PRODUCING run disabled is not required — the
+    recorded knobs travel with the artifact."""
+    path = _artifact(tmp_path, disabled=("churn",))
+    assert soak_check.validate_artifact(path, env={}) == []
+    # and without the recorded knob, the same record set fails
+    def forget_knobs(data):
+        del data["soak"]["disabled_scenarios"]
+        data["scenarios"] = [
+            r for r in data["scenarios"] if r["scenario"] != "churn"
+        ]
+
+    problems = soak_check.validate_artifact(
+        _artifact(tmp_path, forget_knobs), env={}
+    )
+    assert any("churn" in p for p in problems)
+
+
+def test_validate_flags_verdictless_record(tmp_path):
+    def strip_verdict(data):
+        del data["scenarios"][1]["ok"]
+
+    problems = soak_check.validate_artifact(_artifact(tmp_path, strip_verdict))
+    assert any("verdict" in p for p in problems)
+
+
+def test_validate_flags_green_fault_scenario_with_zero_faults(tmp_path):
+    """A chaos scenario claiming ok with nothing in the fault counters
+    means the injection layer never fired — a silent-green soak."""
+
+    def zero_faults(data):
+        for record in data["scenarios"]:
+            if record["scenario"] == "storm":
+                record["faults"] = {"drop": 0.0}
+
+    problems = soak_check.validate_artifact(_artifact(tmp_path, zero_faults))
+    assert any("storm" in p and "zero observed" in p for p in problems)
+
+
+def test_validate_flags_verdict_violation_mismatch(tmp_path):
+    def ok_with_violations(data):
+        data["violations"] = [{"slo": "x"}]
+
+    problems = soak_check.validate_artifact(
+        _artifact(tmp_path, ok_with_violations)
+    )
+    assert any("ok:true" in p for p in problems)
+
+    def red_without_violations(data):
+        data["ok"] = False
+
+    problems = soak_check.validate_artifact(
+        _artifact(tmp_path, red_without_violations)
+    )
+    assert any("without any violation" in p for p in problems)
+
+
+def test_validate_flags_unreadable_and_empty(tmp_path):
+    bad = tmp_path / "nope.json"
+    assert soak_check.validate_artifact(str(bad))
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    problems = soak_check.validate_artifact(str(empty))
+    assert any("no scenario records" in p for p in problems)
+
+
+def test_validate_flags_missing_slo_report(tmp_path):
+    def strip_report(data):
+        del data["slo_report"]
+
+    problems = soak_check.validate_artifact(_artifact(tmp_path, strip_report))
+    assert any("SLO report" in p for p in problems)
+
+
+def test_recorded_soak_artifact_is_green():
+    """The checked-in SOAK_r01.json must itself audit clean — the same
+    self-check discipline BENCH_r*.json artifacts live under."""
+    path = os.path.join(REPO_ROOT, "SOAK_r01.json")
+    assert soak_check.validate_artifact(path) == []
+    with open(path) as fh:
+        data = json.load(fh)
+    assert data["ok"] is True
+    by_name = {r["scenario"]: r for r in data["scenarios"]}
+    assert set(by_name) == set(ALL)
+    # recovery is the asserted property: every fault scenario recorded it
+    for name in ("storm", "partition", "equivocation", "churn"):
+        assert by_name[name]["recovered"] is True
+        assert any(v > 0 for v in by_name[name]["faults"].values())

@@ -350,7 +350,7 @@ def test_end_to_end_trace_admission_through_apply_with_shed():
 def test_degraded_transitions_counter_counts_flips_not_sheds():
     async def main():
         m = get_metrics()
-        before = m.get("ingest_degraded_transitions_total")
+        before = m.get("ingest_degraded_transitions_total", edge="enter")
         sched = IngestScheduler(
             metrics=Metrics(enabled=True), degraded_window_s=60.0
         )
@@ -364,7 +364,7 @@ def test_degraded_transitions_counter_counts_flips_not_sheds():
         sched.submit("l", "a", src)
         sched.submit("l", "b", src)  # shed -> latch flips on
         sched.submit("l", "c", src)  # shed again -> still latched
-        assert m.get("ingest_degraded_transitions_total") == before + 1
+        assert m.get("ingest_degraded_transitions_total", edge="enter") == before + 1
 
     run(main())
     # the flip landed on the flight recorder too

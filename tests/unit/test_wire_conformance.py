@@ -1,0 +1,432 @@
+"""Wire-conformance spec-MUST checklist: yamux keepalive/GoAway +
+gossipsub v1.1 prune-backoff / peer exchange (VERDICT r5 item 7).
+
+These are the session-health behaviors a real go-libp2p peer exercises
+the moment it joins the soak: go-yamux pings every session and kills it
+on an unanswered keepalive; go-libp2p-pubsub enforces the prune backoff
+on BOTH sides of a pruned link and carries PX on every good-standing
+PRUNE.  Pure-frame tests — no sockets, no crypto stack — so they run in
+every environment (the libp2p loopback tests in test_yamux.py /
+test_gossipsub_wire.py still need the optional 'cryptography' module).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.network.libp2p import gossipsub as gs_mod
+from lambda_ethereum_consensus_tpu.network.libp2p import varint, yamux
+from lambda_ethereum_consensus_tpu.network.libp2p.gossipsub import (
+    GRAFT_FLOOD_GRACE_S,
+    GRAFT_FLOOD_PENALTY,
+    MAX_PX_PEERS,
+    PRUNE_BACKOFF_S,
+    Gossipsub,
+    _PeerState,
+)
+from lambda_ethereum_consensus_tpu.network.libp2p.identity import PeerId
+from lambda_ethereum_consensus_tpu.network.libp2p.yamux import (
+    FLAG_ACK,
+    FLAG_RST,
+    FLAG_SYN,
+    TYPE_GOAWAY,
+    TYPE_PING,
+    TYPE_WINDOW,
+    Yamux,
+    YamuxError,
+    encode_frame,
+)
+from lambda_ethereum_consensus_tpu.network.proto import gossipsub_pb2 as pb
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+# --------------------------------------------------------------- yamux
+
+class _Pipe:
+    """In-memory duplex channel half with the channel interface."""
+
+    def __init__(self):
+        self._reader = asyncio.StreamReader()
+        self.other: "_Pipe" = None
+
+    def write(self, data: bytes) -> None:
+        self.other._reader.feed_data(data)
+
+    async def drain(self) -> None:
+        pass
+
+    async def readexactly(self, n: int) -> bytes:
+        return await self._reader.readexactly(n)
+
+    def close(self) -> None:
+        self._reader.feed_eof()
+        self.other._reader.feed_eof()
+
+
+def _pipe_pair():
+    a, b = _Pipe(), _Pipe()
+    a.other, b.other = b, a
+    return a, b
+
+
+def test_ping_roundtrip_and_stale_ack_ignored():
+    """ping() resolves on the ACK echoing ITS opaque value (spec MUST);
+    an ACK carrying an unknown value resolves nothing."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        ma = Yamux(ca, initiator=True)
+        mb = Yamux(cb, initiator=False)
+        ta = asyncio.ensure_future(ma.run())
+        tb = asyncio.ensure_future(mb.run())
+        # a stale/forged ACK first: no waiter for 0xbad, must be ignored
+        await mb._send(encode_frame(TYPE_PING, FLAG_ACK, 0, 0xBAD))
+        await asyncio.sleep(0.05)
+        rtt = await asyncio.wait_for(ma.ping(), 5)
+        assert rtt >= 0.0
+        assert not ma._ping_waiters  # waiter cleaned up
+        ca.close()
+        await asyncio.gather(ta, tb, return_exceptions=True)
+
+    run(scenario())
+
+
+def test_unanswered_keepalive_kills_session():
+    """go-yamux semantics: a keepalive ping nobody ACKs tears the whole
+    session down (a half-dead TCP path must not linger)."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        # no muxer on the cb side: pings go unanswered
+        ma = Yamux(ca, initiator=True, keepalive_s=0.05)
+        ma.KEEPALIVE_TIMEOUT_S = 0.2
+        ta = asyncio.ensure_future(ma.run())
+        await asyncio.wait_for(ta, 5)  # keepalive failure closes the channel
+        assert ma._closed
+        with pytest.raises(YamuxError):
+            await ma.open_stream()
+
+    run(scenario())
+
+
+def test_goaway_normal_refuses_new_streams_and_drains_inflight():
+    """Normal (code 0) GoAway: no NEW streams on either side (spec MUST),
+    while in-flight streams finish their exchange."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        served = {}
+
+        async def handler(stream):
+            served["req"] = await stream.read_all()
+            stream.write(b"resp")
+            await stream.close_write()
+
+        ma = Yamux(ca, initiator=True)
+        mb = Yamux(cb, on_stream=handler, initiator=False)
+        ta = asyncio.ensure_future(ma.run())
+        tb = asyncio.ensure_future(mb.run())
+
+        # genuinely in-flight before the goaway: the SYN rides the first
+        # data frame, so the request must reach the peer first (an unsent
+        # SYN arriving after GoAway is correctly refused with RST — see
+        # test_inbound_syn_after_goaway_is_rst)
+        stream = await ma.open_stream()
+        stream.write(b"req")
+        await stream.drain()
+        await asyncio.sleep(0.05)  # mb accepts the stream
+        await mb.goaway()
+        for _ in range(100):
+            if ma.remote_goaway is not None:
+                break
+            await asyncio.sleep(0.01)
+        assert ma.remote_goaway == Yamux.GOAWAY_NORMAL
+        # both sides now refuse NEW streams
+        with pytest.raises(YamuxError):
+            await ma.open_stream()
+        with pytest.raises(YamuxError):
+            await mb.open_stream()
+        # ...but the in-flight stream still completes
+        await stream.close_write()
+        assert await asyncio.wait_for(stream.read_all(), 5) == b"resp"
+        assert served["req"] == b"req"
+        ca.close()
+        await asyncio.gather(ta, tb, return_exceptions=True)
+
+    run(scenario())
+
+
+def test_goaway_error_code_tears_session_down():
+    """Any non-zero GoAway code is session-fatal immediately."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        ma = Yamux(ca, initiator=True)
+        ta = asyncio.ensure_future(ma.run())
+        # raw error goaway from the remote side
+        ca.other.write(
+            encode_frame(TYPE_GOAWAY, 0, 0, Yamux.GOAWAY_PROTOCOL_ERROR)
+        )
+        await asyncio.wait_for(ta, 5)  # read loop exits at once
+        assert ma._closed
+        assert ma.remote_goaway == Yamux.GOAWAY_PROTOCOL_ERROR
+
+    run(scenario())
+
+
+def test_inbound_syn_after_goaway_is_rst():
+    """A SYN racing our GoAway is refused with RST instead of silently
+    opening a post-shutdown stream."""
+
+    async def scenario():
+        ca, cb = _pipe_pair()
+        mb = Yamux(cb, on_stream=lambda s: asyncio.sleep(0), initiator=False)
+        tb = asyncio.ensure_future(mb.run())
+        await mb.goaway()
+        head = await asyncio.wait_for(ca.readexactly(12), 5)
+        _, typ, _, _, code = yamux._HEADER.unpack(head)
+        assert typ == TYPE_GOAWAY and code == Yamux.GOAWAY_NORMAL
+        ca.write(encode_frame(TYPE_WINDOW, FLAG_SYN, 1, 0))
+        head = await asyncio.wait_for(ca.readexactly(12), 5)
+        _, typ, flags, stream_id, _ = yamux._HEADER.unpack(head)
+        assert typ == TYPE_WINDOW and stream_id == 1
+        assert flags & FLAG_RST
+        assert not mb._streams  # nothing accumulated post-goaway
+        ca.close()
+        await asyncio.gather(tb, return_exceptions=True)
+
+    run(scenario())
+
+
+# ----------------------------------------------------------- gossipsub
+
+class _FakeStream:
+    def __init__(self):
+        self.sent = bytearray()
+
+    def write(self, data: bytes) -> None:
+        self.sent += data
+
+    async def drain(self) -> None:
+        pass
+
+
+class _FakeHost:
+    """Just enough host for the router's control plane: stream capture,
+    no sockets."""
+
+    def __init__(self):
+        self.on_peer = None
+        self.handlers = {}
+        self.streams: dict[PeerId, _FakeStream] = {}
+
+    def set_stream_handler(self, protocol, cb):
+        self.handlers[protocol] = cb
+
+    async def new_stream(self, peer_id, protocols):
+        stream = self.streams.setdefault(peer_id, _FakeStream())
+        return stream, protocols[0]
+
+
+def _decode_rpcs(raw: bytes) -> list:
+    out, pos = [], 0
+    data = bytes(raw)
+    while pos < len(data):
+        shift = length = 0
+        while True:  # varint prefix
+            b = data[pos]
+            pos += 1
+            length |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        out.append(pb.RPC.FromString(data[pos : pos + length]))
+        pos += length
+    return out
+
+
+def _pid(tag: bytes) -> PeerId:
+    return PeerId(b"\x00\x02" + tag)
+
+
+def _router_with_peer(topic="t", score=0.0, on_px=None):
+    host = _FakeHost()
+    router = Gossipsub(host, on_px=on_px)
+    router.subscriptions.add(topic)
+    state = _PeerState(_pid(b"p1"))
+    state.topics.add(topic)
+    state.score = score
+    router.peers[state.peer_id] = state
+    router.mesh[topic] = {state.peer_id}
+    return host, router, state
+
+
+def test_inbound_prune_sets_backoff_and_blocks_regraft():
+    async def scenario():
+        host, router, state = _router_with_peer()
+        ctl = pb.ControlMessage()
+        entry = ctl.prune.add()
+        entry.topic_id = "t"
+        await router._on_control(state, ctl)
+        assert state.peer_id not in router.mesh["t"]
+        # their default backoff applies when the field is unset (spec)
+        assert router._in_backoff("t", state.peer_id)
+        expiry = router.backoff[("t", state.peer_id)]
+        assert expiry - time.monotonic() == pytest.approx(
+            PRUNE_BACKOFF_S, abs=1.0
+        )
+        # the heartbeat's graft pass MUST skip the link while backed off
+        await router._maintain("t")
+        assert state.peer_id not in router.mesh["t"]
+        # ...and grafts again the moment the window expires
+        router.backoff.clear()
+        await router._maintain("t")
+        assert state.peer_id in router.mesh["t"]
+
+    run(scenario())
+
+
+def test_inbound_prune_announced_backoff_honored():
+    async def scenario():
+        host, router, state = _router_with_peer()
+        ctl = pb.ControlMessage()
+        entry = ctl.prune.add()
+        entry.topic_id = "t"
+        entry.backoff = 7  # the peer's announced window, seconds
+        await router._on_control(state, ctl)
+        expiry = router.backoff[("t", state.peer_id)]
+        assert expiry - time.monotonic() == pytest.approx(7.0, abs=1.0)
+
+    run(scenario())
+
+
+def test_graft_inside_backoff_penalized_and_repruned():
+    """The graft-flood defense: a GRAFT during the backoff window is
+    refused with a fresh PRUNE, costs a behavioral penalty, and restarts
+    the backoff clock (gossipsub v1.1 spec §prune-backoff)."""
+
+    async def scenario():
+        host, router, state = _router_with_peer()
+        router.mesh["t"].clear()
+        router._note_backoff("t", state.peer_id, 60.0)
+        key = ("t", state.peer_id)
+        ctl = pb.ControlMessage()
+        ctl.graft.add().topic_id = "t"
+
+        # inside the grace window the GRAFT legally crossed our PRUNE on
+        # the wire: refused with a fresh PRUNE, but NOT penalized
+        score0 = state.score
+        await router._on_control(state, ctl)
+        assert state.peer_id not in router.mesh["t"]
+        assert state.score == score0
+        rpcs = _decode_rpcs(host.streams[state.peer_id].sent)
+        assert any(
+            p.topic_id == "t" for rpc in rpcs for p in rpc.control.prune
+        )
+
+        # past the grace it is graft-flood: penalized, backoff restarted
+        # — and the grace stays anchored to the EPISODE's first prune
+        # (a refusal must not re-open it, or a flood costs one penalty)
+        router.backoff_noted[key] -= GRAFT_FLOOD_GRACE_S + 1.0
+        noted_before = router.backoff_noted[key]
+        expiry_before = router.backoff[key]
+        await router._on_control(state, ctl)
+        assert state.peer_id not in router.mesh["t"]
+        assert state.score == score0 - GRAFT_FLOOD_PENALTY
+        assert router.backoff_noted[key] == noted_before  # anchor kept
+        assert router.backoff[key] >= expiry_before  # expiry restarted
+        await router._on_control(state, ctl)  # keep flooding...
+        assert state.score == score0 - 2 * GRAFT_FLOOD_PENALTY  # ...keep paying
+        # refusal PRUNEs never carry PX: a backoff violator must not be
+        # able to poll our mesh membership for free
+        rpcs = _decode_rpcs(host.streams[state.peer_id].sent)
+        for rpc in rpcs:
+            for p in rpc.control.prune:
+                assert not p.peers
+        assert router._in_backoff("t", state.peer_id)
+
+        # outside the window a GRAFT from a good peer lands normally
+        state.score = 0.0
+        router.backoff.clear()
+        await router._on_control(state, ctl)
+        assert state.peer_id in router.mesh["t"]
+
+    run(scenario())
+
+
+def test_sent_prune_carries_backoff_and_px():
+    """Every PRUNE we emit announces our backoff (spec MUST) and, for a
+    peer in good standing, carries bounded peer exchange so pruning
+    heals the topic instead of shrinking it."""
+
+    async def scenario():
+        host, router, state = _router_with_peer()
+        others = [_pid(bytes([i])) for i in range(2, 5)]
+        for pid in others:
+            other = _PeerState(pid)
+            other.topics.add("t")
+            router.peers[pid] = other
+            router.mesh["t"].add(pid)
+        await router._send_control(state, prune=["t"])
+        rpcs = _decode_rpcs(host.streams[state.peer_id].sent)
+        (entry,) = [p for rpc in rpcs for p in rpc.control.prune]
+        assert entry.topic_id == "t"
+        assert entry.backoff == int(PRUNE_BACKOFF_S)
+        exchanged = {info.peer_id for info in entry.peers}
+        assert exchanged  # PX present for a good-standing peer
+        assert state.peer_id.bytes not in exchanged  # never itself
+        assert len(exchanged) <= MAX_PX_PEERS
+        # we must honor our own announced backoff too
+        assert router._in_backoff("t", state.peer_id)
+
+    run(scenario())
+
+
+def test_no_px_for_negative_score_peer():
+    async def scenario():
+        host, router, state = _router_with_peer(score=-1.0)
+        other = _PeerState(_pid(b"p2"))
+        other.topics.add("t")
+        router.peers[other.peer_id] = other
+        router.mesh["t"].add(other.peer_id)
+        await router._send_control(state, prune=["t"])
+        rpcs = _decode_rpcs(host.streams[state.peer_id].sent)
+        (entry,) = [p for rpc in rpcs for p in rpc.control.prune]
+        assert entry.backoff == int(PRUNE_BACKOFF_S)  # backoff always
+        assert not entry.peers  # PX withheld below zero
+
+    run(scenario())
+
+
+def test_inbound_px_honored_bounded_and_gated():
+    """PX from a good-standing PRUNE reaches the on_px hook, capped at
+    MAX_PX_PEERS; a negative-score pruner gets no dials out of us."""
+
+    async def scenario():
+        received = []
+
+        def on_px(topic, infos):
+            received.append((topic, list(infos)))
+
+        host, router, state = _router_with_peer(on_px=on_px)
+        ctl = pb.ControlMessage()
+        entry = ctl.prune.add()
+        entry.topic_id = "t"
+        for i in range(MAX_PX_PEERS + 9):
+            entry.peers.add().peer_id = bytes([i])
+        await router._on_control(state, ctl)
+        assert len(received) == 1
+        topic, infos = received[0]
+        assert topic == "t" and len(infos) == MAX_PX_PEERS
+
+        received.clear()
+        state.score = -1.0
+        router.backoff.clear()
+        await router._on_control(state, ctl)
+        assert not received  # adversarial PX never drives our dials
+
+    run(scenario())
