@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke soak-smoke soak-validate lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke soak-smoke soak-validate crash-smoke crash-validate lint clean
 
 all: native
 
@@ -37,6 +37,7 @@ test: native
 	python scripts/bench_compare.py --report-only
 	$(MAKE) serve-gate
 	$(MAKE) soak-smoke
+	$(MAKE) crash-smoke
 
 # The SLO budget gate alone (round 12): a recorded load profile through
 # the real ingest pipeline + API, evaluated against slo.DEFAULT_SLOS —
@@ -74,6 +75,26 @@ soak-validate:
 	  echo "soak-validate: no SOAK_r*.json artifact found" >&2; exit 1; \
 	fi; \
 	python scripts/soak_check.py --validate "$$artifact"
+
+# The crash-safety gate (round 20): >=20 seeded SIGKILL trials against a
+# live WAL writer (killed at deterministic byte offsets) + a corruption
+# fuzz sweep on the closed log, each recovering to a ROOT-VERIFIED
+# resume anchor with zero finalized-data loss, judged against the
+# storage_recovery_p95 SLO row — plus an every-run red self-check: a bit
+# flip planted inside the finalized prefix must be DETECTED or the gate
+# exits 1 (no silent green).  Knobs: CRASH_SEED, CRASH_TRIALS,
+# CRASH_NO_{KILL,FUZZ,REDCHECK}.
+crash-smoke: native
+	python scripts/crash_check.py --smoke
+
+# Audit a recorded crash artifact (truncation fails loudly, like
+# soak-validate).  CRASH_ARTIFACT overrides the newest CRASH_r*.json.
+crash-validate:
+	@artifact="$${CRASH_ARTIFACT:-$$(ls -t CRASH_r*.json 2>/dev/null | head -1)}"; \
+	if [ -z "$$artifact" ]; then \
+	  echo "crash-validate: no CRASH_r*.json artifact found" >&2; exit 1; \
+	fi; \
+	python scripts/crash_check.py --validate "$$artifact"
 
 # The 10k-key duty deadline gate (round 16): every attestation duty of
 # a full mainnet-spec epoch (10,240 keys, 32 slots) fired at 1/3 slot

@@ -788,10 +788,14 @@ class BeaconApiServer:
         polling client can neither inflate the evaluation/violation
         counters nor shorten the snapshot deque's window."""
         from ..slo import get_engine
+        from ..telemetry import device_fault_state
 
-        return self._json(
-            {"data": get_engine().evaluate(emit=False, snapshot=False)}
-        )
+        report = get_engine().evaluate(emit=False, snapshot=False)
+        # round-20 health flag: contained device faults stay visible here
+        # after the batch they hit (host fallbacks are correct but slow —
+        # a latched plane is an operator page, not a log line)
+        report["device_health"] = device_fault_state()
+        return self._json({"data": report})
 
     def _debug_lanes(self) -> tuple[str, str, bytes]:
         """Live ingest scheduler snapshot (404 when the node runs the

@@ -1,0 +1,492 @@
+"""Seeded storage-fault injection (round 20): SIGKILL a live WAL writer
+at deterministic offsets, fuzz the closed file, verify recovery.
+
+Three fault surfaces, all seeded through the same hash stream the
+transport chaos layer uses (:mod:`.faults`), so one seed reproduces one
+storage-fault schedule:
+
+- **crash trials** — a subprocess (this module run as a script, so the
+  writer boots in ~0.3 s without the node runtime) streams a real minted
+  chain's block/state records plus checksummable filler into a
+  :class:`~..store.kv.KvStore`, fsync-barriers each "finalized window"
+  and acks the barrier ON STDOUT ONLY AFTER ``fsync`` returns.  The
+  parent watches the WAL grow and SIGKILLs the writer the moment it
+  crosses a seeded byte offset — a power cut at a deterministic point in
+  the log.  Recovery then opens the store (checksummed replay + torn-tail
+  truncation), adopts a resume anchor through the same state-root
+  verification the node boots with, and asserts ZERO finalized-data
+  loss: every record covered by an acked barrier must be present and
+  byte-identical.
+- **fuzz sweep** — seeded tail truncations and tail bit-flips on a
+  closed log carrying an unsynced tail: recovery must keep the whole
+  finalized prefix and a root-verified anchor every time, and no
+  surviving record may be SILENTLY corrupt (the CRC must catch flips).
+- **red self-check** — a bit flip INSIDE the finalized prefix must be
+  *detected* (lost anchor, failed verification, or missing finalized
+  records — never a silently served wrong byte).  The gate runs this
+  every time: a detector that stops firing turns the whole gate into
+  silent green, so the self-check failing IS a gate failure.
+
+``scripts/crash_check.py`` drives trials + sweep + self-check, gates on
+the ``storage_recovery_p95`` SLO row through the real engine, and
+records the validated ``CRASH_r*.json`` artifact.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import shutil
+import signal
+import struct
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+if __package__ in (None, ""):  # running as the writer script
+    sys.path.insert(
+        0,
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    )
+
+from lambda_ethereum_consensus_tpu.store.kv import (  # noqa: E402
+    KvStore,
+    WAL_HEADER,
+    _FRAME,
+)
+from lambda_ethereum_consensus_tpu.store.state_store import (  # noqa: E402
+    FINALIZED_ANCHOR_KEY,
+)
+
+__all__ = [
+    "build_workload",
+    "build_fuzz_db",
+    "kill_offset",
+    "red_self_check",
+    "run_fuzz_case",
+    "run_kill_trial",
+    "verify_recovered",
+    "writer_main",
+]
+
+#: Barrier ack protocol: one line per fsynced window, written AFTER the
+#: fsync returned — everything the parent reads here is durable by
+#: construction, which is exactly what "zero finalized-data loss" means.
+ACK = "CRASH_BARRIER"
+
+_FILL = b"fill|"
+
+
+def filler_key(window: int, j: int) -> bytes:
+    return _FILL + struct.pack(">II", window, j)
+
+
+def filler_value(seed: int, window: int, j: int, nbytes: int) -> bytes:
+    """Deterministic, checksum-friendly payload: recomputable by the
+    verifier from ``(seed, window, j)`` alone, so a single silently
+    flipped bit anywhere in a surviving record is caught by equality."""
+    out = b""
+    counter = 0
+    while len(out) < nbytes:
+        out += hashlib.sha256(
+            f"{seed}|{window}|{j}|{counter}".encode()
+        ).digest()
+        counter += 1
+    return out[:nbytes]
+
+
+def _frame_len(key: bytes, val_len: int) -> int:
+    return _FRAME.size + len(key) + val_len
+
+
+# ----------------------------------------------------------------- writer
+
+
+def writer_main(workload_path: str, db_path: str) -> int:
+    """The subprocess body: stream windows until killed (or the window
+    cap, which a healthy trial never reaches)."""
+    with open(workload_path) as fh:
+        w = json.load(fh)
+    records = [
+        (base64.b64decode(k), base64.b64decode(v)) for k, v in w["records"]
+    ]
+    anchor = base64.b64decode(w["anchor_root"])
+    fillers = int(w["fillers_per_window"])
+    nbytes = int(w["filler_bytes"])
+    seed = int(w["seed"])
+    kv = KvStore(db_path)
+    for win in range(int(w["max_windows"])):
+        for key, val in records:
+            kv.put(key, val)
+        for j in range(fillers):
+            kv.put(filler_key(win, j), filler_value(seed, win, j, nbytes))
+        kv.put(FINALIZED_ANCHOR_KEY, anchor)
+        kv.sync()
+        print(f"{ACK} {win} {os.path.getsize(db_path)}", flush=True)
+    kv.close()
+    return 0
+
+
+# --------------------------------------------------------------- workload
+
+
+@dataclass
+class Workload:
+    """Everything the parent needs to drive and verify trials."""
+
+    path: str  # the JSON the writer reads
+    seed: int
+    spec: object
+    anchor_root: bytes
+    records: list = field(default_factory=list)  # [(key, val)] one window
+    fillers_per_window: int = 8
+    filler_bytes: int = 256
+    max_windows: int = 64
+    window_bytes: int = 0  # exact framed bytes one window appends
+
+
+def build_workload(
+    seed: int,
+    base_dir: str,
+    n_keys: int = 16,
+    chain_len: int = 4,
+    fillers_per_window: int = 8,
+    filler_bytes: int = 256,
+) -> Workload:
+    """Mint one real devnet chain (blocks + states, minimal spec) and
+    encode it as the per-window record set — the expensive BLS work
+    happens ONCE here; the writer subprocess only streams bytes."""
+    from ..config import minimal_spec, use_chain_spec
+    from ..store.block_store import _BLOCK, _slot_key as _block_slot_key
+    from ..store.state_store import _STATE, _slot_key as _state_slot_key
+    from .fleet import make_chain
+
+    spec = minimal_spec()
+    bundle = make_chain(n_keys=n_keys, chain_len=chain_len, spec=spec)
+    records: list[tuple[bytes, bytes]] = []
+    with use_chain_spec(spec):
+        from ..state_transition.core import state_transition
+
+        state = bundle.genesis
+        anchor_root = None
+        for signed in bundle.blocks:
+            state = state_transition(state, signed, spec=spec)
+            root = signed.message.hash_tree_root(spec)
+            records.append((_BLOCK + root, signed.encode(spec)))
+            records.append(
+                (_block_slot_key(int(signed.message.slot)), root)
+            )
+            records.append((_STATE + root, state.encode(spec)))
+            records.append(
+                (_state_slot_key(int(state.slot)), root)
+            )
+            anchor_root = root
+    window_bytes = sum(_frame_len(k, len(v)) for k, v in records)
+    window_bytes += fillers_per_window * _frame_len(
+        filler_key(0, 0), filler_bytes
+    )
+    window_bytes += _frame_len(FINALIZED_ANCHOR_KEY, 32)
+    path = os.path.join(base_dir, "crash_workload.json")
+    with open(path, "w") as fh:
+        json.dump({
+            "seed": seed,
+            "records": [
+                [base64.b64encode(k).decode(), base64.b64encode(v).decode()]
+                for k, v in records
+            ],
+            "anchor_root": base64.b64encode(anchor_root).decode(),
+            "fillers_per_window": fillers_per_window,
+            "filler_bytes": filler_bytes,
+            "max_windows": 64,
+        }, fh)
+    return Workload(
+        path=path, seed=seed, spec=spec, anchor_root=anchor_root,
+        records=records, fillers_per_window=fillers_per_window,
+        filler_bytes=filler_bytes, max_windows=64,
+        window_bytes=window_bytes,
+    )
+
+
+def kill_offset(seed: int, trial: int, window_bytes: int, windows: int = 30) -> int:
+    """The seeded SIGKILL byte offset for one trial: uniform over the
+    first ``windows`` windows of log growth, derived from the same hash
+    stream as every other chaos decision (pure function of seed/trial —
+    ``tests/unit/test_chaos.py`` pins the reproducibility)."""
+    from .faults import FaultScheduler, FaultSpec
+
+    u = FaultScheduler(seed, FaultSpec()).uniform("wal", trial, "kill_offset")
+    return len(WAL_HEADER) + int(u * window_bytes * windows) + 1
+
+
+# ------------------------------------------------------------ crash trial
+
+
+def run_kill_trial(
+    workload: Workload, trial: int, base_dir: str,
+    timeout_s: float = 60.0,
+) -> dict:
+    """One seeded kill -> recover -> verify trial."""
+    db_path = os.path.join(base_dir, f"crash_{trial}.wal")
+    out_path = os.path.join(base_dir, f"crash_{trial}.out")
+    target = kill_offset(workload.seed, trial, workload.window_bytes)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_repo_root()] + env.get("PYTHONPATH", "").split(os.pathsep)
+    ).rstrip(os.pathsep)
+    with open(out_path, "wb") as out:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--writer", workload.path, db_path],
+            stdout=out, stderr=subprocess.DEVNULL, env=env,
+        )
+        deadline = time.monotonic() + timeout_s
+        killed = False
+        while proc.poll() is None:
+            size = os.path.getsize(db_path) if os.path.exists(db_path) else 0
+            if size >= target:
+                proc.send_signal(signal.SIGKILL)
+                killed = True
+                break
+            if time.monotonic() >= deadline:
+                proc.send_signal(signal.SIGKILL)
+                break
+            time.sleep(0.001)
+        proc.wait()
+    acked = _parse_acks(out_path)
+    result = verify_recovered(db_path, workload, acked)
+    result.update({
+        "trial": trial,
+        "target_offset": target,
+        "killed": killed,
+        "acked_windows": len(acked),
+    })
+    if not killed:
+        result["ok"] = False
+        result.setdefault("problems", []).append(
+            "writer exited before reaching the seeded kill offset"
+        )
+    return result
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _parse_acks(out_path: str) -> list[int]:
+    acked = []
+    try:
+        with open(out_path, "rb") as fh:
+            for line in fh.read().decode(errors="replace").splitlines():
+                parts = line.split()
+                if len(parts) == 3 and parts[0] == ACK:
+                    acked.append(int(parts[1]))
+    except OSError:
+        pass
+    return acked
+
+
+def verify_recovered(db_path: str, workload: Workload, acked: list[int]) -> dict:
+    """Open the (possibly torn) WAL the way the node would and assert
+    zero finalized-data loss + a root-verified anchor.
+
+    Everything up to the highest ACKED barrier was fsynced before the
+    ack was printed, so it MUST survive byte-identical; anything past it
+    is the legitimately-lost unfinalized window."""
+    from ..config import use_chain_spec
+    from ..store.block_store import BlockStore
+    from ..store.state_store import StateStore, get_finalized_anchor
+    from ..telemetry import get_metrics
+
+    t0 = time.monotonic()
+    problems: list[str] = []
+    kv = KvStore(db_path)
+    try:
+        last = max(acked) if acked else None
+        if last is not None:
+            for w in range(last + 1):
+                for j in range(workload.fillers_per_window):
+                    got = kv.get(filler_key(w, j))
+                    exp = filler_value(
+                        workload.seed, w, j, workload.filler_bytes
+                    )
+                    if got is None:
+                        problems.append(
+                            f"finalized filler {w}/{j} lost (acked window)"
+                        )
+                    elif got != exp:
+                        problems.append(
+                            f"finalized filler {w}/{j} SILENTLY corrupt"
+                        )
+            for key, val in workload.records:
+                got = kv.get(key)
+                if got is None:
+                    problems.append(
+                        f"finalized chain record {key[:16]!r} lost"
+                    )
+                elif got != val:
+                    problems.append(
+                        f"finalized chain record {key[:16]!r} SILENTLY corrupt"
+                    )
+            anchor = get_finalized_anchor(kv)
+            if anchor is None:
+                problems.append("finalized anchor pointer lost")
+            elif anchor != workload.anchor_root:
+                problems.append("finalized anchor pointer corrupt")
+            else:
+                with use_chain_spec(workload.spec):
+                    state = StateStore(kv).verified_state(
+                        anchor, BlockStore(kv), workload.spec
+                    )
+                if state is None:
+                    problems.append(
+                        "anchor failed state-root verification on resume"
+                    )
+        # silent-corruption sweep over EVERY surviving filler, acked or
+        # not: an unfinalized record may be truncated away, but one that
+        # SURVIVES replay must be byte-exact (the CRC's whole job)
+        for key, val in kv.iterate_prefix(_FILL):
+            w, j = struct.unpack(">II", key[len(_FILL):])
+            if val != filler_value(workload.seed, w, j, workload.filler_bytes):
+                problems.append(f"surviving filler {w}/{j} SILENTLY corrupt")
+        recovery = dict(kv.recovery)
+    finally:
+        kv.close()
+    elapsed = time.monotonic() - t0
+    get_metrics().observe("storage_recovery_seconds", elapsed)
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "recovery": recovery,
+        "recovery_s": round(elapsed, 4),
+    }
+
+
+# ------------------------------------------------------------- fuzz sweep
+
+
+def build_fuzz_db(
+    workload: Workload, base_dir: str, windows: int = 3
+) -> tuple[str, int]:
+    """A clean log with ``windows`` fsync-barriered windows plus an
+    UNSYNCED tail window (written, flushed to the OS, never barriered):
+    returns ``(path, finalized_end)`` where ``finalized_end`` is the file
+    size at the last barrier — the byte boundary the fuzz green cases
+    must never damage."""
+    path = os.path.join(base_dir, "fuzz_base.wal")
+    if os.path.exists(path):
+        os.remove(path)
+    kv = KvStore(path)
+    for w in range(windows):
+        for key, val in workload.records:
+            kv.put(key, val)
+        for j in range(workload.fillers_per_window):
+            kv.put(
+                filler_key(w, j),
+                filler_value(workload.seed, w, j, workload.filler_bytes),
+            )
+        kv.put(FINALIZED_ANCHOR_KEY, workload.anchor_root)
+        kv.sync()
+    finalized_end = os.path.getsize(path)
+    # the unfinalized tail: flushed but never fsynced — after a real
+    # power cut any suffix of it may be missing or torn
+    for j in range(workload.fillers_per_window):
+        kv.put(
+            filler_key(windows, j),
+            filler_value(workload.seed, windows, j, workload.filler_bytes),
+        )
+    kv.flush()
+    kv.close()
+    return path, finalized_end
+
+
+def run_fuzz_case(
+    workload: Workload, base_path: str, finalized_end: int,
+    base_dir: str, case: int, windows: int = 3,
+) -> dict:
+    """One seeded mutation of the closed log's unfinalized tail —
+    truncation (even cases) or a bit flip (odd cases) — then recover and
+    hold the green bar: finalized prefix intact, anchor root-verified."""
+    from .faults import FaultScheduler, FaultSpec
+
+    draws = FaultScheduler(workload.seed, FaultSpec())
+    path = os.path.join(base_dir, f"fuzz_{case}.wal")
+    shutil.copyfile(base_path, path)
+    size = os.path.getsize(path)
+    tail = size - finalized_end
+    assert tail > 0, "fuzz base carries no unfinalized tail"
+    kind = "truncate" if case % 2 == 0 else "bit_flip"
+    if kind == "truncate":
+        cut = 1 + int(draws.uniform("fuzz", case, "cut") * (tail - 1))
+        os.truncate(path, size - cut)
+        mutation = {"kind": kind, "cut_bytes": cut}
+    else:
+        at = finalized_end + int(
+            draws.uniform("fuzz", case, "flip_at") * tail
+        )
+        bit = int(draws.uniform("fuzz", case, "flip_bit") * 8) & 7
+        with open(path, "r+b") as fh:
+            fh.seek(at)
+            byte = fh.read(1)[0]
+            fh.seek(at)
+            fh.write(bytes([byte ^ (1 << bit)]))
+        mutation = {"kind": kind, "offset": at, "bit": bit}
+    result = verify_recovered(path, workload, acked=list(range(windows)))
+    result["case"] = case
+    result["mutation"] = mutation
+    return result
+
+
+def red_self_check(
+    workload: Workload, base_path: str, finalized_end: int, base_dir: str
+) -> dict:
+    """Flip one seeded bit INSIDE the finalized prefix and prove the
+    verifier DETECTS it.  Every green run re-proves the detector fires —
+    a gate whose corruption check went dead would otherwise stay green
+    forever (the no-silent-green acceptance)."""
+    from .faults import FaultScheduler, FaultSpec
+
+    draws = FaultScheduler(workload.seed, FaultSpec())
+    path = os.path.join(base_dir, "fuzz_red.wal")
+    shutil.copyfile(base_path, path)
+    # exclude the trailing finalized|anchor frame: its VALUE is repeated
+    # by every earlier window, so truncating only it loses nothing
+    # unique and a healthy verifier correctly reports no damage — a flip
+    # anywhere else in the prefix drops at least one window's unique
+    # filler and MUST be detected
+    span = (
+        finalized_end - len(WAL_HEADER) - 1
+        - _frame_len(FINALIZED_ANCHOR_KEY, 32)
+    )
+    at = len(WAL_HEADER) + int(
+        draws.uniform("fuzz", 0, "red_at") * span
+    )
+    with open(path, "r+b") as fh:
+        fh.seek(at)
+        byte = fh.read(1)[0]
+        fh.seek(at)
+        fh.write(bytes([byte ^ 0x40]))
+    result = verify_recovered(path, workload, acked=[0, 1, 2])
+    detected = not result["ok"]
+    return {
+        "detected": detected,
+        "offset": at,
+        "problems": result["problems"][:4],
+        "recovery": result["recovery"],
+    }
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 4 and sys.argv[1] == "--writer":
+        sys.exit(writer_main(sys.argv[2], sys.argv[3]))
+    print(
+        "usage: crash.py --writer WORKLOAD.json DB.wal "
+        "(the crash-trial writer subprocess; drive trials via "
+        "scripts/crash_check.py)",
+        file=sys.stderr,
+    )
+    sys.exit(2)

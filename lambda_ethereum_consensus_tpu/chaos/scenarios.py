@@ -27,6 +27,7 @@ and writes the pass/fail artifact; the final budget gate is one
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -739,13 +740,19 @@ async def _churn(ctx: ScenarioContext) -> dict:
     """Sidecar stall/restart + checkpoint-sync + resume-from-db churn:
     the supervisor restarts the dead sidecar, the restarted member keeps
     following the chain, a checkpoint-synced joiner anchors off a live
-    member's API, and a full node restart resumes from its WAL."""
+    member's API, a full node restart resumes from its WAL, and a
+    POWER-LOSS variant (round 20) reboots a member on a torn copy of its
+    live WAL — the unclean-kill path: checksummed replay truncates the
+    torn tail, the anchor is adopted only after state-root verification,
+    and the member still converges with the fleet."""
+    import shutil
+
     from ..node import BeaconNode, NodeConfig
     from ..validator import build_signed_block
 
     bundle = make_chain(n_keys=64, chain_len=3, spec=soak_spec())
     spec = bundle.spec
-    before = _fault_totals(("sidecar_stall",))
+    before = _fault_totals(("sidecar_stall", "power_loss"))
     with use_chain_spec(spec):
         fleet = await Fleet.boot(
             2, bundle, ctx.base_dir + "/churn", fault_spec=FaultSpec(),
@@ -810,8 +817,22 @@ async def _churn(ctx: ScenarioContext) -> dict:
             if not anchored:
                 ok = False
                 ctx.violation("churn", "checkpoint-synced joiner did not anchor")
-            # resume-from-db churn: restart the follower outright
+            # resume-from-db churn: restart the follower outright.  The
+            # power-loss snapshot is taken FIRST, while the member is
+            # still live: copying the file sees exactly the bytes a
+            # SIGKILL would leave on disk (synced prefix + kernel-cached
+            # writes, minus the userspace buffer we deliberately drain
+            # the way a finalization tick would) — then a torn tail is
+            # sheared off to make it a power cut, not a clean kill
             db_path = fleet.nodes[1].config.db_path
+            pl_path = db_path + ".powerloss"
+            fleet.nodes[1].kv.flush()
+            shutil.copyfile(db_path, pl_path)
+            pl_size = os.path.getsize(pl_path)
+            torn_cut = min(9, max(pl_size - 64, 0))
+            if torn_cut:
+                os.truncate(pl_path, pl_size - torn_cut)
+            _count_fault("power_loss")
             head_before = fleet.heads()[1]
             await fleet.nodes[1].stop()
             fleet.nodes = fleet.nodes[:1]  # already stopped; skip in stop()
@@ -835,19 +856,89 @@ async def _churn(ctx: ScenarioContext) -> dict:
                 ctx.violation(
                     "churn", "restart-from-db did not resume at the same head"
                 )
+            # power-loss churn (round 20 satellite): reboot on the torn
+            # WAL copy — SAME db_path lineage, NO genesis fallback, so a
+            # fresh-genesis boot cannot fake the pass — and converge
+            # with the still-live bootstrap member over the wire
+            from ..fork_choice import get_head as _get_head
+
+            pl_node = BeaconNode(
+                NodeConfig(
+                    db_path=pl_path,
+                    bootnodes=[
+                        f"127.0.0.1:{fleet.nodes[0].port.listen_port}"
+                    ],
+                    enable_range_sync=True,
+                    wire=None,
+                ),
+                spec,
+            )
+            await pl_node.start()
+            try:
+                pl_report = dict(pl_node.resume_report)
+                pl_torn = bool(
+                    pl_report.get("recovery", {}).get("truncated")
+                )
+                # graftlint: disable=async-blocking — devnet-sized head
+                # walks, harness-only convergence polling
+                target = _get_head(fleet.nodes[0].store, spec)
+                pl_converged = False
+                deadline = time.monotonic() + 8 * float(
+                    SOAK_SECONDS_PER_SLOT
+                )
+                while time.monotonic() < deadline:
+                    await pl_node.pending.process_once()
+                    await pl_node.pending.download_once()
+                    # graftlint: disable=async-blocking — see above
+                    if _get_head(pl_node.store, spec) == target:
+                        pl_converged = True
+                        break
+                    await asyncio.sleep(0.2)
+            finally:
+                await pl_node.stop()
+            if not (
+                pl_report.get("verified")
+                and str(pl_report.get("source", "")).startswith("db")
+            ):
+                ok = False
+                ctx.violation(
+                    "churn",
+                    "power-loss reboot did not resume from a verified "
+                    f"WAL anchor (report={pl_report})",
+                )
+            if not pl_torn:
+                ok = False
+                ctx.violation(
+                    "churn",
+                    "power-loss WAL copy reported no torn-tail "
+                    "truncation — the fault never landed",
+                )
+            if not pl_converged:
+                ok = False
+                ctx.violation(
+                    "churn",
+                    "power-loss member did not reconverge with the fleet",
+                )
         finally:
             await fleet.stop()
     injected = {
-        "sidecar_stall": get_metrics().get(
-            _FAULT_COUNTER, kind="sidecar_stall"
-        ) - before["sidecar_stall"],
+        kind: get_metrics().get(_FAULT_COUNTER, kind=kind) - before[kind]
+        for kind in ("sidecar_stall", "power_loss")
     }
     if injected["sidecar_stall"] <= 0:
         ok = False
         ctx.violation("churn", "sidecar stall fault not observed in counters")
+    if injected["power_loss"] <= 0:
+        ok = False
+        ctx.violation("churn", "power-loss fault not observed in counters")
     return {
         "scenario": "churn", "ok": ok, "faults": injected,
-        "sidecar_restarts": restarts, **recovery,
+        "sidecar_restarts": restarts,
+        "power_loss": {
+            "resume": pl_report, "torn": pl_torn,
+            "converged": pl_converged,
+        },
+        **recovery,
     }
 
 

@@ -33,10 +33,12 @@ exponentiation (already how :func:`..pairing.pairing_check` works).
 
 from __future__ import annotations
 
+import logging
 import os
 import secrets
 from typing import Sequence
 
+from ...telemetry import device_fault
 from ...utils.env import device_default
 from . import curve as C
 from .curve import DeserializationError
@@ -50,6 +52,8 @@ __all__ = [
     "shard_active",
     "verify_points",
 ]
+
+log = logging.getLogger("bls_batch")
 
 _COEFF_BITS = int(os.environ.get("BLS_RLC_BITS", "64"))
 
@@ -124,6 +128,25 @@ def _device_chain_verify(checks) -> list[bool]:
     return chain_verify(checks)
 
 
+def _contained_chain_verify(checks) -> list[bool] | None:
+    """Device dispatch with the round-20 fault containment: an
+    ``XlaRuntimeError`` (or any device-runtime death) mid-dispatch
+    returns ``None`` — the caller re-runs the SAME check on the
+    bit-exact host path — instead of escaping and dropping the whole
+    gossip batch.  The fault is counted per plane and latches the
+    ``/debug/slo`` health flag, so a permanently dead tunnel degrading
+    every drain to host speed cannot hide."""
+    try:
+        return _device_chain_verify(checks)
+    except Exception:
+        log.exception(
+            "device verify plane failed for %d check(s); host fallback",
+            len(checks),
+        )
+        device_fault("bls_verify")
+        return None
+
+
 def _pack_check(entry_list, dst, message_points):
     """(entries, dst) -> a chain_verify check tuple, memoizing hash_to_g2
     through ``message_points`` — the ONE place the check format and
@@ -189,7 +212,23 @@ def verify_points(
     if message_points is None:
         message_points = {}
     if _chain_enabled(len(entries)):
-        return _device_chain_verify([_pack_check(entries, dst, message_points)])[0]
+        got = _contained_chain_verify(
+            [_pack_check(entries, dst, message_points)]
+        )
+        if got is not None:
+            return got[0]
+        # contained device fault: fall through to the host path below
+    return _verify_points_host(entries, dst, message_points)
+
+
+def _verify_points_host(
+    entries: Sequence[PointEntry],
+    dst: bytes,
+    message_points: dict,
+) -> bool:
+    """The host tail of :func:`verify_points` (native C++ RLC, else the
+    pure-Python pairing) — also the containment target when the device
+    plane faults mid-dispatch."""
     from . import native
 
     if native.rlc_available() and not env_flag("BLS_NO_NATIVE_RLC"):
@@ -255,8 +294,17 @@ def batch_verify_each_points(
                 _pack_check([entries[i] for i in r], dst, message_points)
                 for _, r in live_ranges
             ]
-            for (k, _), ok in zip(live_ranges, _device_chain_verify(checks)):
-                results[k] = ok
+            oks = _contained_chain_verify(checks)
+            if oks is not None:
+                for (k, _), ok in zip(live_ranges, oks):
+                    results[k] = ok
+                return [results[k] for k in range(len(ranges))]
+            # contained device fault: this level re-verifies on host
+            # (fresh coefficients — the packed ones were never checked)
+            for k, r in live_ranges:
+                results[k] = _verify_points_host(
+                    [entries[i] for i in r], dst, message_points
+                )
             return [results[k] for k in range(len(ranges))]
         return [
             verify_points([entries[i] for i in r], dst, message_points)

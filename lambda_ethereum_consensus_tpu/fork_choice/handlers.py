@@ -20,7 +20,7 @@ from ..state_transition.predicates import (
     is_slashable_attestation_data,
     is_valid_indexed_attestation,
 )
-from ..telemetry import get_metrics, span
+from ..telemetry import device_fault, get_metrics, span
 from ..types.beacon import Attestation, AttesterSlashing, Checkpoint, SignedBeaconBlock
 from .store import ForkChoiceError, LatestMessage, Store, checkpoint_key
 
@@ -455,6 +455,38 @@ def _attestation_batch_host(
     return results
 
 
+def _host_verify_group(ctx, group, contain, results):
+    """Bit-exact host re-verify of one cached-drain context group after a
+    contained device fault: aggregate each item's pubkey from the context
+    state's registry (the sparse path's recipe) and run the host-routed
+    batch check.  Returns per-item flags aligned with ``group``, or
+    ``None`` after writing verdicts when even host prep fails."""
+    from ..crypto.bls.api import _pubkey_point
+    from ..crypto.bls.batch import batch_verify_each_points
+    from ..crypto.bls.curve import g1
+
+    try:
+        entries = []
+        for _i, attestation, attesting, (cid, _miss, signing_root, sig) in group:
+            agg_pk = None
+            for v in attesting:
+                pt = _pubkey_point(bytes(ctx.state.validators[v].pubkey))
+                if pt is None:
+                    raise ForkChoiceError("identity pubkey in committee")
+                agg_pk = pt if agg_pk is None else g1.affine_add(agg_pk, pt)
+            entries.append((agg_pk, signing_root, sig))
+        return batch_verify_each_points(entries)
+    except ForkChoiceError as e:
+        for i, _, _, _ in group:
+            results[i] = e
+        return None
+    except Exception as e:  # the fallback itself died: contain per item
+        v = contain.verdict(e, count=len(group), stage="context")
+        for i, _, _, _ in group:
+            results[i] = v
+        return None
+
+
 def _attestation_batch_cached(
     store, attestations, is_from_block, spec, results
 ) -> None:
@@ -573,11 +605,21 @@ def _attestation_batch_cached(
             for i, _, _, _ in group:
                 results[i] = ForkChoiceError(str(e))
             continue
-        except Exception as e:  # unexpected device failure: same blast radius
-            v = contain.verdict(e, count=len(group), stage="context")
-            for i, _, _, _ in group:
-                results[i] = v
-            continue
+        except Exception:
+            # device-runtime fault (XlaRuntimeError, dead PJRT tunnel)
+            # mid-dispatch: round 20 containment — re-verify this
+            # context's items on the bit-exact HOST path (aggregate from
+            # the context state's registry pubkeys, the same recipe the
+            # sparse path runs) instead of dropping the whole group.
+            # Counted + latched so the fallback stays operator-visible.
+            log.exception(
+                "device verify fault on a %d-item context group; "
+                "host fallback", len(group),
+            )
+            device_fault("bls_verify")
+            flags = _host_verify_group(ctx, group, contain, results)
+            if flags is None:
+                continue
         for (i, attestation, attesting, _), ok in zip(group, flags):
             if ok:
                 accepted.append((i, ctx, attestation, attesting))

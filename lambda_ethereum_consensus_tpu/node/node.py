@@ -32,7 +32,13 @@ from ..network.reqresp import BlockDownloader, ReqRespServer
 from ..pipeline import IngestScheduler, LaneConfig
 from ..slo import get_engine
 from ..state_transition import misc
-from ..store import BlockStore, KvStore, StateStore
+from ..store import (
+    BlockStore,
+    KvStore,
+    StateStore,
+    get_finalized_anchor,
+    set_finalized_anchor,
+)
 from ..tracing import (
     SlotClock,
     get_recorder,
@@ -132,6 +138,12 @@ class BeaconNode:
         self._subs: list[TopicSubscription] = []
         self.ingest: IngestScheduler | None = None
         self._stopping = False
+        # durability plane (round 20): the finalized epoch whose snapshot
+        # pointer + fsync barrier have been persisted, and how the boot
+        # anchor was chosen (source, verification, WAL recovery report)
+        self._persisted_finalized_epoch = -1
+        self._finality_warned_epoch = -1
+        self.resume_report: dict = {}
         self.device_backend = None
         self._prev_hash_backend = None
         # subnet gossip validation state: committees-per-slot + shuffling
@@ -248,17 +260,18 @@ class BeaconNode:
         Returns ``(state, block, root_override)`` — the override is set when
         only the block *header* is known (checkpoint sync), so the store is
         keyed by the real block root rather than a reconstructed block's.
+
+        Round 20: DB resume is VERIFIED — the finalized snapshot pointer
+        is tried first, then the bounded highest-slot scan, and every
+        candidate must Merkle-root to the ``state_root`` its stored block
+        committed to before it is adopted.  A store whose candidates all
+        fail verification falls through to checkpoint sync (or provided
+        genesis) instead of booting on bad data.
         """
         spec = self.spec
-        latest = self.states_db.get_latest_state(spec)
-        if latest is not None:
-            root, state = latest
-            stored = self.blocks_db.get_block(root, spec)
-            if stored is not None:
-                log.info("resuming from stored state at slot %d", state.slot)
-                # the stored key is authoritative (a checkpoint anchor's
-                # reconstructed block hashes differently from its real root)
-                return state, stored.message, root
+        resumed = self._resume_from_db()
+        if resumed is not None:
+            return resumed
         if self.config.checkpoint_sync_url:
             from ..api.checkpoint_sync import sync_from_checkpoint
 
@@ -275,10 +288,12 @@ class BeaconNode:
                 state_root=bytes(header.state_root),
                 body=BeaconBlockBody(),
             )
+            self.resume_report["source"] = "checkpoint"
             # the header root IS the finalized block's root; descendants
             # reference it as parent_root
             return state, anchor, header.hash_tree_root(spec)
         if self.config.genesis_state is not None:
+            self.resume_report["source"] = "genesis"
             state = self.config.genesis_state
             anchor = self.config.anchor_block or BeaconBlock(
                 slot=state.slot,
@@ -292,6 +307,126 @@ class BeaconNode:
             return state, anchor, None
         raise RuntimeError(
             "no anchor available: provide genesis_state or checkpoint_sync_url"
+        )
+
+    def _resume_from_db(
+        self,
+    ) -> tuple[BeaconState, BeaconBlock, bytes] | None:
+        """Verified DB resume: newest verified state first (the node
+        resumes at its head), the fsync-barriered finalized snapshot
+        pointer as the durable floor when nothing recent verifies.
+
+        Resume = (checksummed WAL replay, done by KvStore on open) +
+        state-root verification of the candidate against its stored
+        block.  The WAL recovery report and the verification outcome
+        land in ``self.resume_report`` so harnesses (chaos churn, the
+        crash gate) can assert HOW the node booted, not just that it
+        did."""
+        import time as _time
+
+        spec = self.spec
+        t0 = _time.monotonic()
+        report = self.resume_report = {
+            "source": None,
+            "verified": False,
+            "recovery": dict(self.kv.recovery),
+        }
+        anchor_root = get_finalized_anchor(self.kv)
+        candidate = None
+        # newest verified state first (the node resumes at its head);
+        # the fsync-barriered finalized snapshot is the durable FLOOR —
+        # tried when every recent candidate fails verification, before
+        # giving up on the DB entirely
+        got = self.states_db.get_latest_verified_state(self.blocks_db, spec)
+        if got is not None:
+            candidate = (got[0], got[1], "db_scan")
+        elif anchor_root is not None:
+            state = self.states_db.verified_state(
+                anchor_root, self.blocks_db, spec
+            )
+            if state is not None:
+                log.warning(
+                    "no recent state verified; resuming from the "
+                    "finalized snapshot %s", anchor_root.hex()[:16],
+                )
+                candidate = (anchor_root, state, "db_finalized")
+        had_data = anchor_root is not None or (
+            self.states_db.get_latest_state(spec) is not None
+        )
+        if candidate is None:
+            if had_data:
+                # data exists but nothing verifies: the fall-through to
+                # checkpoint sync / provided genesis is the POINT —
+                # booting on an unverified anchor is how a corrupt store
+                # becomes a consensus fault
+                log.error(
+                    "DB resume rejected: no stored state passed state-root "
+                    "verification; falling back to checkpoint sync/genesis"
+                )
+                report["source"] = "db_rejected"
+            return None
+        root, state, source = candidate
+        block = self.blocks_db.get_block(root, spec)
+        report.update(source=source, verified=True)
+        self._persisted_finalized_epoch = int(
+            state.finalized_checkpoint.epoch
+        )
+        elapsed = _time.monotonic() - t0
+        # process-wide registry: the storage_recovery_p95 SLO row (crash
+        # gate, churn power-loss scenario) reads the default registry the
+        # engine aggregates, not this node's identity gauges
+        from .telemetry import get_metrics as _get_proc_metrics
+
+        _get_proc_metrics().observe("storage_recovery_seconds", elapsed)
+        log.info(
+            "resuming from verified stored state at slot %d (%s, %.3fs)",
+            state.slot, source, elapsed,
+        )
+        # the stored key is authoritative (a checkpoint anchor's
+        # reconstructed block hashes differently from its real root)
+        return state, block.message, root
+
+    def _persist_finality(self) -> None:
+        """The fsync barrier at finalization (round 20 tentpole b): when
+        the finalized checkpoint advances, make sure its state snapshot
+        is stored, point ``finalized|anchor`` at it, and push one batched
+        durability barrier — so an unclean kill loses at most the
+        unfinalized window, never a finalized record.  Also the
+        satellite-2 fix: the WAL's userspace buffer now drains every
+        finalization tick, not only on clean ``stop()``."""
+        if self.kv is None or self.store is None:
+            return
+        fin = self.store.finalized_checkpoint
+        epoch = int(fin.epoch)
+        if epoch <= self._persisted_finalized_epoch:
+            return
+        root = bytes(fin.root)
+        state = self.store.block_states.get(root)
+        if state is not None and not self.states_db.has_state(root):
+            self.states_db.store_state(root, state, self.spec)
+        if not (
+            self.blocks_db.has_block(root)
+            and (state is not None or self.states_db.has_state(root))
+        ):
+            # the snapshot cannot be written yet (state not materialized,
+            # block unknown): drain the buffer but do NOT latch the
+            # epoch — the pointer write retries on the next tick, and
+            # the gauge keeps telling the truth about what is durable
+            self.kv.flush()
+            if self._finality_warned_epoch != epoch:
+                self._finality_warned_epoch = epoch
+                log.warning(
+                    "finalized epoch %d root %s has no stored snapshot "
+                    "yet; anchor pointer deferred", epoch, root.hex()[:16],
+                )
+            return
+        set_finalized_anchor(self.kv, root)
+        self.kv.barrier(reason="finality")
+        self._persisted_finalized_epoch = epoch
+        self.metrics.set_gauge("storage_finalized_epoch", float(epoch))
+        get_recorder().record(
+            "inst", 0, "finality_barrier",
+            {"epoch": epoch, "root": root.hex()[:16]},
         )
 
     async def _start_network(self) -> None:
@@ -691,6 +826,9 @@ class BeaconNode:
         self.blocks_db.store_block(signed, self.spec)
         self.states_db.store_state(root, self.store.block_states[root], self.spec)
         self.metrics.set_gauge("sync_store_slot", signed.message.slot)
+        # a block apply can advance finality mid-slot; barrier now rather
+        # than waiting for the next tick (still batched per epoch)
+        self._persist_finality()
         self._observe_head_transition()
 
     def _observe_head_transition(self) -> None:
@@ -748,6 +886,9 @@ class BeaconNode:
             await asyncio.sleep(1.0 - (now % 1.0))
             try:
                 on_tick(self.store, int(time.time()), self.spec)
+                # durability barrier: one batched fsync when the
+                # finalized checkpoint advanced this tick (never per-put)
+                self._persist_finality()
                 self._sample_device_telemetry()
                 # one SLO evaluation per tick: publishes the slo_* gauges
                 # and appends the burn-rate snapshot the multi-window
@@ -991,5 +1132,8 @@ class BeaconNode:
         if self.port is not None:
             await self.port.close()
         if self.kv is not None:
-            self.kv.flush()
+            # a clean stop is itself a durability barrier: everything
+            # applied this run survives the next power cut, not just the
+            # finalized prefix
+            self.kv.barrier(reason="close")
             self.kv.close()

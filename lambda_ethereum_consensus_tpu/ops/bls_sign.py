@@ -43,7 +43,7 @@ from ..crypto.bls import curve as C
 from ..crypto.bls.api import BlsError
 from ..crypto.bls.fields import P, R
 from ..crypto.bls.hash_to_curve import DST_POP, hash_to_g2_many
-from ..telemetry import inc, span
+from ..telemetry import device_fault, inc, span
 from ..utils.env import env_flag
 from .aot import aot_jit, compile_context, register_shape_bucket, shape_buckets
 from .bls_g1 import SCALAR_BITS, _ints_batch, _scalar_bits_batch, batch_inv_mod
@@ -348,11 +348,13 @@ def sign_batch(
                 # a dead device tunnel mid-slot must cost latency, not
                 # correctness or the duty: host math is the oracle.
                 # LOUD: a permanently broken plane degrading every slot
-                # to the comb must not hide behind a counter
+                # to the comb must not hide behind a counter — the
+                # round-20 latch keeps it visible at /debug/slo
                 log.exception(
                     "device signing plane failed for %d entries; "
                     "host fallback", n,
                 )
+                device_fault("duty_sign")
                 inc("duty_signatures_total", n, path="host_fallback")
                 out = _sign_points_host(points, scalars)
         else:

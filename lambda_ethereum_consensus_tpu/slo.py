@@ -55,6 +55,7 @@ __all__ = [
     "DEFAULT_SLOS",
     "DEFAULT_WINDOWS",
     "SOAK_SLOS",
+    "STORAGE_SLOS",
     "SloDef",
     "SloEngine",
     "estimate_quantile",
@@ -183,12 +184,27 @@ DEFAULT_SLOS = (
 )
 
 
+# Storage-durability row (round 20): crash/restart to a ROOT-VERIFIED
+# resume anchor — checksummed WAL replay, torn-tail truncation, state
+# decode and the hash-tree-root check against the stored block.  The
+# crash gate (scripts/crash_check.py) judges every seeded SIGKILL
+# trial's recovery against it; the churn power-loss scenario feeds the
+# same family from a live fleet member.
+STORAGE_SLOS = (
+    SloDef(
+        "storage_recovery_p95", "storage_recovery_seconds",
+        0.95, 5.0,
+        "crash -> root-verified resume anchor (WAL replay + verification)",
+    ),
+)
+
+
 # Soak-specific budget rows (round 19): recovery — not just survival —
 # is the asserted property of every chaos scenario, so the soak gate
 # judges the DEFAULT set PLUS how fast the node comes back.  The budgets
 # are health bounds for the ~seconds-per-slot soak profiles; scenarios
 # tighten per-run copies via soak_check --budget.
-SOAK_SLOS = DEFAULT_SLOS + (
+SOAK_SLOS = DEFAULT_SLOS + STORAGE_SLOS + (
     SloDef(
         "chaos_recovery_p95", "chaos_recovery_seconds",
         0.95, 30.0,

@@ -292,33 +292,47 @@ def _verify_deferred_attestations(state, deferred, spec) -> bool:
     if use_cached:
         from ..fork_choice.attestation import get_state_attestation_context
 
-        frozen = state.freeze()
-        by_ctx: dict[int, tuple] = {}
-        host_entries = []
-        for (att, ind, _pubkeys, signing_root), sig in zip(deferred, sigs):
-            ctx = get_state_attestation_context(
-                frozen, int(att.data.target.epoch), spec
-            )
-            cid, attesting, missing = ctx.participation(att)
-            if len(missing) <= ctx.device_cache().mmax:
-                by_ctx.setdefault(id(ctx), (ctx, []))[1].append(
-                    (cid, missing.tolist(), signing_root, sig)
+        try:
+            frozen = state.freeze()
+            by_ctx: dict[int, tuple] = {}
+            host_entries = []
+            for (att, ind, _pubkeys, signing_root), sig in zip(deferred, sigs):
+                ctx = get_state_attestation_context(
+                    frozen, int(att.data.target.epoch), spec
                 )
-            else:
-                agg = None
-                for v in attesting:
-                    pt = _pubkey_point(bytes(frozen.validators[v].pubkey))
-                    if pt is None:
-                        return False
-                    agg = pt if agg is None else g1.affine_add(agg, pt)
-                host_entries.append((agg, signing_root, sig))
-        for ctx, entries in by_ctx.values():
-            flags = batch_verify_each_cached(
-                ctx.device_cache(), entries, message_points=ctx.message_points
-            )
-            if not all(flags):
-                return False
-        return not host_entries or verify_points(host_entries)
+                cid, attesting, missing = ctx.participation(att)
+                if len(missing) <= ctx.device_cache().mmax:
+                    by_ctx.setdefault(id(ctx), (ctx, []))[1].append(
+                        (cid, missing.tolist(), signing_root, sig)
+                    )
+                else:
+                    agg = None
+                    for v in attesting:
+                        pt = _pubkey_point(bytes(frozen.validators[v].pubkey))
+                        if pt is None:
+                            return False
+                        agg = pt if agg is None else g1.affine_add(agg, pt)
+                    host_entries.append((agg, signing_root, sig))
+            for ctx, entries in by_ctx.values():
+                flags = batch_verify_each_cached(
+                    ctx.device_cache(), entries,
+                    message_points=ctx.message_points,
+                )
+                if not all(flags):
+                    return False
+            return not host_entries or verify_points(host_entries)
+        except ValueError:
+            # a real validation failure (SpecError subclasses ValueError:
+            # invalid registry pubkey, shape contract breach) fails on
+            # host just the same — propagate
+            raise
+        except Exception:
+            # device-runtime fault (XlaRuntimeError & co) mid block
+            # verify: contained — the bit-exact host RLC below answers
+            # instead, and the latched /debug/slo flag keeps it visible
+            from ..telemetry import device_fault
+
+            device_fault("bls_verify")
 
     entries = []
     for (att, ind, pubkeys, signing_root), sig in zip(deferred, sigs):

@@ -5,10 +5,25 @@ store/{db.ex,block_store.ex,state_store.ex}) with a C++ ordered KV engine
 (``native/kvstore``) bound via ctypes, plus the same key schemes:
 ``block|root``, ``blockslot|slot -> root``, ``beacon_state|root``,
 ``stateslot|slot -> root`` and the highest-slot resume seek.
+
+Round 20: the WAL is framed + checksummed (crash-consistent, torn tails
+truncated and reported), ``finalized|anchor`` marks the fsync-barriered
+finality snapshot, and resume candidates are state-root-verified before
+adoption (see ARCHITECTURE.md "Durability & crash recovery").
 """
 
 from .block_store import BlockStore
 from .kv import KvStore
-from .state_store import StateStore
+from .state_store import (
+    StateStore,
+    get_finalized_anchor,
+    set_finalized_anchor,
+)
 
-__all__ = ["KvStore", "BlockStore", "StateStore"]
+__all__ = [
+    "KvStore",
+    "BlockStore",
+    "StateStore",
+    "get_finalized_anchor",
+    "set_finalized_anchor",
+]

@@ -45,6 +45,8 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "BoundSpan",
     "Metrics",
+    "device_fault",
+    "device_fault_state",
     "get_metrics",
     "inc",
     "observe",
@@ -161,6 +163,15 @@ _HELP = {
     "trace_recorder_events": "ring entries held by the flight recorder (one per terminated item trace / batch span / instant)",
     "trace_recorder_capacity": "flight recorder ring capacity (entries)",
     "trace_recorder_dropped_total": "flight recorder ring entries overwritten (overwrite-oldest)",
+    "storage_fsync_total": "WAL durability barriers that reached fsync, by reason (finality|close|...)",
+    "storage_wal_truncated_total": "WAL opens that truncated a torn/corrupt tail",
+    "storage_wal_dropped_bytes_total": "bytes dropped by torn/corrupt-tail truncation at WAL open",
+    "storage_wal_migrated_total": "legacy unframed WALs migrated to the framed format at open",
+    "storage_resume_rejected_total": "resume candidates rejected before anchor adoption, by reason (decode|missing|root)",
+    "storage_recovery_seconds": "crash/restart -> root-verified resume anchor wall time",
+    "storage_finalized_epoch": "finalized epoch whose snapshot pointer + fsync barrier are persisted",
+    "device_fault_total": "device runtime faults contained by host fallbacks, by plane",
+    "device_fault_latched": "1 after any contained device fault on this plane this process (see /debug/slo)",
 }
 
 
@@ -606,3 +617,34 @@ def observe(name: str, value: float, **labels) -> None:
 
 def set_gauge(name: str, value: float, **labels) -> None:
     get_metrics().set_gauge(name, value, **labels)
+
+
+# ----------------------------------------------------- device-fault health
+#
+# Round-20 satellite: a device runtime fault (XlaRuntimeError, a dead
+# PJRT tunnel) contained by a host fallback must stay VISIBLE after the
+# batch it hit — operators diagnose "every drain is quietly 10x slower"
+# from the latched flag at /debug/slo, not from grepping one traceback.
+
+_DEVICE_FAULT_LOCK = threading.Lock()
+_DEVICE_FAULTS: dict[str, int] = {}
+
+
+def device_fault(plane: str) -> None:
+    """Record one contained device fault on ``plane`` (``bls_verify``,
+    ``duty_sign``, ...): counts ``device_fault_total{plane}``, latches
+    the per-plane health gauge, and feeds :func:`device_fault_state` —
+    the ``/debug/slo`` health block."""
+    with _DEVICE_FAULT_LOCK:
+        _DEVICE_FAULTS[plane] = _DEVICE_FAULTS.get(plane, 0) + 1
+    m = get_metrics()
+    m.inc("device_fault_total", plane=plane)
+    m.set_gauge("device_fault_latched", 1.0, plane=plane)
+
+
+def device_fault_state() -> dict:
+    """The latched health view served at ``/debug/slo``: which planes
+    have ever fallen back to host this process, and how often."""
+    with _DEVICE_FAULT_LOCK:
+        planes = dict(_DEVICE_FAULTS)
+    return {"faulted": bool(planes), "planes": planes}

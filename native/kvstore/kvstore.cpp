@@ -1,4 +1,4 @@
-// kvstore: ordered key-value store with write-ahead log persistence.
+// kvstore: ordered key-value store with a crash-consistent write-ahead log.
 //
 // The native storage engine behind the framework's block/state stores —
 // the role LevelDB-via-NIF plays in the reference client (ref:
@@ -9,7 +9,25 @@
 // (e.g. get_latest_state seeks the highest slot key — ref:
 // lib/.../store/state_store.ex:36-49).
 //
+// WAL format v2 (round 20, interchangeable with the Python engine in
+// store/kv.py): an 8-byte header ("KVWL" + version byte + 3 reserved)
+// then framed records
+//
+//     op(u8) | klen(u32 LE) | vlen(u32 LE) | crc32c(u32 LE) | key | value
+//
+// with the CRC32C (Castagnoli) over op||klen||vlen||key||value.  Replay
+// verifies every frame; a torn or corrupt tail is TRUNCATED at the last
+// verified frame and reported through kv_recovery(), never replayed and
+// never fatal.  Legacy unframed logs are detected (no magic) and
+// migrated in place.  kv_sync() is the fsync barrier (kv_flush stays the
+// cheap userspace drain); compact/migrate fsync the rewritten file AND
+// its parent directory around the rename — POSIX orders neither with the
+// rename on its own.
+//
 // C ABI for ctypes consumption; all buffers are copied at the boundary.
+
+#include <fcntl.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <cstdint>
@@ -23,11 +41,10 @@
 
 namespace {
 
-struct Record {
-    uint8_t op;  // 1 = put, 2 = del
-    std::string key;
-    std::string val;
-};
+constexpr char kMagic[4] = {'K', 'V', 'W', 'L'};
+constexpr uint8_t kWalVersion = 2;
+constexpr size_t kHeaderSize = 8;
+constexpr size_t kFrameSize = 13;  // op + klen + vlen + crc
 
 struct KvStore {
     std::map<std::string, std::string> table;
@@ -35,40 +52,177 @@ struct KvStore {
     std::string path;
     std::mutex mu;
     uint64_t log_records = 0;
+    // recovery report (filled by kv_open, read via kv_recovery)
+    uint64_t recovered_records = 0;
+    uint64_t dropped_bytes = 0;
+    int truncated = 0;
+    int migrated = 0;
 };
+
+// CRC32C (Castagnoli, reflected 0x82F63B78) — same table recipe as
+// store/kv.py so the two backends verify each other's files.
+uint32_t crc32c_table[256];
+
+struct CrcInit {
+    CrcInit() {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t crc = i;
+            for (int j = 0; j < 8; j++)
+                crc = (crc & 1) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+            crc32c_table[i] = crc;
+        }
+    }
+} crc_init;
+
+uint32_t frame_crc(uint8_t op, uint32_t klen, uint32_t vlen, const char* key,
+                   const char* val) {
+    uint8_t head[9];
+    head[0] = op;
+    memcpy(head + 1, &klen, 4);
+    memcpy(head + 5, &vlen, 4);
+    uint32_t crc = 0xFFFFFFFFu;
+    // inline the running CRC instead of concatenating buffers
+    for (size_t i = 0; i < sizeof(head); i++)
+        crc = (crc >> 8) ^ crc32c_table[(crc ^ head[i]) & 0xFF];
+    for (uint32_t i = 0; i < klen; i++)
+        crc = (crc >> 8) ^ crc32c_table[(crc ^ (uint8_t)key[i]) & 0xFF];
+    for (uint32_t i = 0; i < vlen; i++)
+        crc = (crc >> 8) ^ crc32c_table[(crc ^ (uint8_t)val[i]) & 0xFF];
+    return crc ^ 0xFFFFFFFFu;
+}
 
 bool read_exact(FILE* f, void* buf, size_t n) {
     return fread(buf, 1, n, f) == n;
 }
 
+bool write_header(FILE* f) {
+    uint8_t header[kHeaderSize] = {0};
+    memcpy(header, kMagic, 4);
+    header[4] = kWalVersion;
+    return fwrite(header, 1, kHeaderSize, f) == kHeaderSize;
+}
+
 bool write_record(FILE* f, uint8_t op, const char* key, uint32_t klen,
                   const char* val, uint32_t vlen) {
+    uint32_t crc = frame_crc(op, klen, vlen, key, val);
     if (fputc(op, f) == EOF) return false;
     if (fwrite(&klen, 4, 1, f) != 1) return false;
     if (fwrite(&vlen, 4, 1, f) != 1) return false;
+    if (fwrite(&crc, 4, 1, f) != 1) return false;
     if (klen && fwrite(key, 1, klen, f) != klen) return false;
     if (vlen && fwrite(val, 1, vlen, f) != vlen) return false;
     return true;
 }
 
-bool replay_log(KvStore* kv, FILE* f) {
+bool sync_file(FILE* f) {
+    if (fflush(f) != 0) return false;
+    return fsync(fileno(f)) == 0;
+}
+
+// fsync the parent directory of `path` so a rename's dirent write is on
+// the platter too (the other half of the durable-rename discipline).
+bool sync_parent_dir(const std::string& path) {
+    std::string dir = ".";
+    size_t slash = path.find_last_of('/');
+    if (slash != std::string::npos) dir = path.substr(0, slash + 1);
+    int fd = open(dir.c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    bool ok = fsync(fd) == 0;
+    close(fd);
+    return ok;
+}
+
+long file_size(FILE* f) {
+    long pos = ftell(f);
+    if (pos < 0) return -1;
+    if (fseek(f, 0, SEEK_END) != 0) return -1;
+    long size = ftell(f);
+    fseek(f, pos, SEEK_SET);
+    return size;
+}
+
+// Framed replay: verify every record, remember the end of the last good
+// frame; the caller truncates anything past it.
+long replay_framed(KvStore* kv, FILE* f) {
+    long good_end = (long)kHeaderSize;
+    fseek(f, good_end, SEEK_SET);
     for (;;) {
-        int op = fgetc(f);
-        if (op == EOF) return true;  // clean end
-        uint32_t klen = 0, vlen = 0;
-        if (!read_exact(f, &klen, 4) || !read_exact(f, &vlen, 4)) return false;
+        uint8_t head[kFrameSize];
+        if (!read_exact(f, head, kFrameSize)) break;
+        uint8_t op = head[0];
+        uint32_t klen, vlen, crc;
+        memcpy(&klen, head + 1, 4);
+        memcpy(&vlen, head + 5, 4);
+        memcpy(&crc, head + 9, 4);
+        if (op != 1 && op != 2) break;
         std::string key(klen, '\0'), val(vlen, '\0');
-        if (klen && !read_exact(f, key.data(), klen)) return false;
-        if (vlen && !read_exact(f, val.data(), vlen)) return false;
+        if (klen && !read_exact(f, key.data(), klen)) break;
+        if (vlen && !read_exact(f, val.data(), vlen)) break;
+        if (frame_crc(op, klen, vlen, key.data(), val.data()) != crc) break;
         if (op == 1) {
             kv->table[std::move(key)] = std::move(val);
-        } else if (op == 2) {
-            kv->table.erase(key);
         } else {
-            return false;  // corrupt opcode
+            kv->table.erase(key);
         }
-        kv->log_records++;
+        kv->recovered_records++;
+        good_end = ftell(f);
     }
+    return good_end;
+}
+
+// Legacy (pre-v2) unframed replay: op|klen|vlen|key|val, no checksums; a
+// short read ends replay (the old torn-tail rule).
+long replay_legacy(KvStore* kv, FILE* f) {
+    long good_end = 0;
+    fseek(f, 0, SEEK_SET);
+    for (;;) {
+        int op = fgetc(f);
+        if (op == EOF) break;
+        if (op != 1 && op != 2) break;
+        uint32_t klen = 0, vlen = 0;
+        if (!read_exact(f, &klen, 4) || !read_exact(f, &vlen, 4)) break;
+        std::string key(klen, '\0'), val(vlen, '\0');
+        if (klen && !read_exact(f, key.data(), klen)) break;
+        if (vlen && !read_exact(f, val.data(), vlen)) break;
+        if (op == 1) {
+            kv->table[std::move(key)] = std::move(val);
+        } else {
+            kv->table.erase(key);
+        }
+        kv->recovered_records++;
+        good_end = ftell(f);
+    }
+    return good_end;
+}
+
+// Durable snapshot rewrite (compaction AND legacy migration): write tmp,
+// fsync tmp, rename over, fsync parent dir.  Caller holds the lock and
+// has closed/reopens kv->log around this as needed.
+bool write_snapshot(KvStore* kv, const std::string& tmp) {
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    if (!write_header(f)) {
+        fclose(f);
+        remove(tmp.c_str());
+        return false;
+    }
+    for (const auto& [key, val] : kv->table) {
+        if (!write_record(f, 1, key.data(), (uint32_t)key.size(), val.data(),
+                          (uint32_t)val.size())) {
+            fclose(f);
+            remove(tmp.c_str());
+            return false;
+        }
+    }
+    if (!sync_file(f)) {
+        fclose(f);
+        remove(tmp.c_str());
+        return false;
+    }
+    fclose(f);
+    if (rename(tmp.c_str(), kv->path.c_str()) != 0) return false;
+    sync_parent_dir(kv->path);
+    return true;
 }
 
 }  // namespace
@@ -78,10 +232,60 @@ extern "C" {
 KvStore* kv_open(const char* path) {
     auto* kv = new KvStore();
     kv->path = path;
+    bool fresh = true;
     if (FILE* f = fopen(path, "rb")) {
-        // A torn tail (crash mid-write) stops replay at the damage point;
-        // everything before it is kept.
-        replay_log(kv, f);
+        long size = file_size(f);
+        if (size > 0) {
+            fresh = false;
+            uint8_t head[kHeaderSize] = {0};
+            bool framed = (size_t)size >= kHeaderSize &&
+                          read_exact(f, head, kHeaderSize) &&
+                          memcmp(head, kMagic, 4) == 0;
+            if (framed && head[4] != kWalVersion) {
+                fclose(f);
+                delete kv;
+                return nullptr;  // unknown future format: refuse, don't guess
+            }
+            if (framed) {
+                long good_end = replay_framed(kv, f);
+                fclose(f);
+                if (good_end < size) {
+                    // torn/corrupt tail: truncate at the last verified
+                    // frame — everything past it was never durable
+                    kv->dropped_bytes = (uint64_t)(size - good_end);
+                    kv->truncated = 1;
+                    if (truncate(path, good_end) != 0) {
+                        delete kv;
+                        return nullptr;
+                    }
+                }
+            } else {
+                long good_end = replay_legacy(kv, f);
+                fclose(f);
+                if (good_end < size) {
+                    kv->dropped_bytes = (uint64_t)(size - good_end);
+                    kv->truncated = 1;
+                }
+                // migrate the snapshot to the framed format in place
+                if (!write_snapshot(kv, kv->path + ".migrate")) {
+                    delete kv;
+                    return nullptr;
+                }
+                kv->migrated = 1;
+            }
+        } else {
+            fclose(f);
+        }
+    }
+    if (fresh) {
+        // brand-new (or zero-length) log: persist the header up front so
+        // the format marker itself survives a crash
+        FILE* f = fopen(path, "wb");
+        if (!f || !write_header(f) || !sync_file(f)) {
+            if (f) fclose(f);
+            delete kv;
+            return nullptr;
+        }
         fclose(f);
     }
     kv->log = fopen(path, "ab");
@@ -89,6 +293,7 @@ KvStore* kv_open(const char* path) {
         delete kv;
         return nullptr;
     }
+    kv->log_records = kv->recovered_records;
     return kv;
 }
 
@@ -127,34 +332,39 @@ int kv_flush(KvStore* kv) {
     return fflush(kv->log) == 0 ? 0 : -1;
 }
 
+// The power-loss barrier: userspace drain + fsync.  kv_flush stays the
+// cheap option for readers-of-our-own-writes; this one is for finality.
+int kv_sync(KvStore* kv) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    return sync_file(kv->log) ? 0 : -1;
+}
+
+// What open() found: replayed record count, torn/corrupt bytes dropped
+// (already truncated from the file), legacy migration.
+void kv_recovery(KvStore* kv, uint64_t* records, uint64_t* dropped_bytes,
+                 int* truncated, int* migrated) {
+    std::lock_guard<std::mutex> lock(kv->mu);
+    *records = kv->recovered_records;
+    *dropped_bytes = kv->dropped_bytes;
+    *truncated = kv->truncated;
+    *migrated = kv->migrated;
+}
+
 uint64_t kv_count(KvStore* kv) {
     std::lock_guard<std::mutex> lock(kv->mu);
     return kv->table.size();
 }
 
-// Rewrite the log as a snapshot of live entries (drops tombstones/overwrites).
+// Rewrite the log as a snapshot of live entries (drops tombstones/
+// overwrites) through the durable-rename discipline.
 int kv_compact(KvStore* kv) {
     std::lock_guard<std::mutex> lock(kv->mu);
-    std::string tmp = kv->path + ".compact";
-    FILE* f = fopen(tmp.c_str(), "wb");
-    if (!f) return -1;
-    for (const auto& [key, val] : kv->table) {
-        if (!write_record(f, 1, key.data(), (uint32_t)key.size(), val.data(),
-                          (uint32_t)val.size())) {
-            fclose(f);
-            remove(tmp.c_str());
-            return -1;
-        }
-    }
-    fclose(f);
     fclose(kv->log);
-    if (rename(tmp.c_str(), kv->path.c_str()) != 0) {
-        kv->log = fopen(kv->path.c_str(), "ab");
-        return -1;
-    }
+    kv->log = nullptr;
+    bool ok = write_snapshot(kv, kv->path + ".compact");
     kv->log = fopen(kv->path.c_str(), "ab");
-    kv->log_records = kv->table.size();
-    return kv->log ? 0 : -1;
+    if (ok) kv->log_records = kv->table.size();
+    return (ok && kv->log) ? 0 : -1;
 }
 
 void kv_close(KvStore* kv) {

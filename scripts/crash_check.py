@@ -1,0 +1,376 @@
+"""Crash-safety gate: seeded SIGKILL trials + corruption fuzz against the
+WAL persistence plane, gated on zero finalized-data loss and the
+``storage_recovery_p95`` SLO row, recording ``CRASH_r*.json``.
+
+Three phases (``lambda_ethereum_consensus_tpu/chaos/crash.py``):
+
+1. **kill** — N seeded trials: a writer subprocess streams a real minted
+   chain + checksummable filler through the framed WAL, fsync-barriers
+   each finalized window (acked on stdout only after the fsync
+   returned), and is SIGKILLed the moment the log crosses a seeded byte
+   offset.  Recovery must keep every acked record byte-identical and
+   adopt a ROOT-VERIFIED resume anchor — zero finalized-data loss.
+2. **fuzz** — seeded truncations and bit flips on a closed log's
+   unfinalized tail: the finalized prefix and the verified anchor must
+   survive every mutation, and nothing may be SILENTLY corrupt.
+3. **redcheck** — a bit flip inside the finalized prefix must be
+   DETECTED (the no-silent-green acceptance): the detector failing to
+   fire fails the gate, every run.
+
+Recovery wall time feeds ``storage_recovery_seconds``; the gate is one
+:class:`~lambda_ethereum_consensus_tpu.slo.SloEngine` evaluation over
+:data:`~lambda_ethereum_consensus_tpu.slo.STORAGE_SLOS` plus the
+structured per-trial verdicts.  ``--validate PATH`` audits a recorded
+artifact the way ``soak_check.py --validate`` does: the producing run's
+recorded knobs say which phases must carry records — a truncated run
+fails loudly.  Knobs: ``CRASH_SEED``, ``CRASH_TRIALS``,
+``CRASH_NO_KILL`` / ``CRASH_NO_FUZZ`` / ``CRASH_NO_REDCHECK``.
+
+Exit codes: 0 = green, 1 = any violation, 2 = usage error.
+
+Usage:
+  python scripts/crash_check.py --smoke --json CRASH_r01.json
+  python scripts/crash_check.py --trials 50 --seed 11
+  python scripts/crash_check.py --validate CRASH_r01.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.slo import STORAGE_SLOS, SloEngine  # noqa: E402
+from lambda_ethereum_consensus_tpu.telemetry import get_metrics  # noqa: E402
+
+#: Phase inventory — every phase has a CRASH_NO_* knob, enumerated by
+#: tests/unit/test_crash_validate.py the way the SOAK_NO_* knobs are.
+PHASE_ORDER = ("kill", "fuzz", "redcheck")
+
+#: The acceptance floor: `make crash-smoke` must run at least this many
+#: seeded SIGKILL trials.
+DEFAULT_TRIALS = 20
+DEFAULT_FUZZ_CASES = 12
+
+# storage_recovery burn windows, sized like the soak engine's (the node
+# 60/300 s SRE windows cannot move inside a CI smoke run)
+CRASH_WINDOWS = (("fast", 2.0), ("slow", 6.0))
+
+
+def phase_knob(name: str) -> str:
+    return f"CRASH_NO_{name.upper()}"
+
+
+def _knob_set(env, name: str) -> bool:
+    return (env.get(phase_knob(name), "") or "").lower() in ("1", "true", "yes")
+
+
+def required_phases(env=None) -> tuple[str, ...]:
+    """The phase set a run under ``env`` must produce records for."""
+    env = os.environ if env is None else env
+    return tuple(n for n in PHASE_ORDER if not _knob_set(env, n))
+
+
+# ------------------------------------------------------------- validation
+
+def validate_artifact(path: str, env=None) -> list[str]:
+    """Audit one CRASH artifact: every phase the producing run's recorded
+    knobs enabled must carry records with verdicts, the red self-check
+    must have DETECTED its planted corruption, kill trials must actually
+    have killed, and the headline must agree with the violations."""
+    problems: list[str] = []
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"unreadable artifact: {e}"]
+    crash = data.get("crash")
+    if not isinstance(crash, dict):
+        return ["artifact carries no crash header at all"]
+    disabled = crash.get("disabled_phases")
+    if disabled is not None:
+        required = [n for n in PHASE_ORDER if n not in disabled]
+    else:
+        required = list(required_phases(env))
+    if "kill" in required:
+        trials = data.get("trials")
+        want = crash.get("trials")
+        if not isinstance(trials, list) or not trials:
+            problems.append("kill phase enabled but no trial records")
+        else:
+            if isinstance(want, int) and len(trials) < want:
+                problems.append(
+                    f"only {len(trials)} of {want} recorded kill trials "
+                    "present (truncated run?)"
+                )
+            for t in trials:
+                if not isinstance(t, dict) or "ok" not in t:
+                    problems.append("a kill trial carries no verdict")
+                    break
+            if data.get("ok") and not any(
+                t.get("killed") for t in trials if isinstance(t, dict)
+            ):
+                problems.append(
+                    "artifact claims ok with zero actual SIGKILLs — the "
+                    "injector never fired"
+                )
+    if "fuzz" in required:
+        fuzz = data.get("fuzz")
+        if not isinstance(fuzz, list) or not fuzz:
+            problems.append("fuzz phase enabled but no fuzz records")
+        elif any("ok" not in c for c in fuzz if isinstance(c, dict)):
+            problems.append("a fuzz case carries no verdict")
+    if "redcheck" in required:
+        red = data.get("red_self_check")
+        if not isinstance(red, dict) or "detected" not in red:
+            problems.append("red self-check record missing")
+        elif data.get("ok") and not red["detected"]:
+            problems.append(
+                "artifact claims ok but the planted finalized-record "
+                "corruption went UNDETECTED — silent green"
+            )
+    if "slo_report" not in data:
+        problems.append("artifact carries no SLO report")
+    if data.get("ok") and data.get("violations"):
+        problems.append("artifact claims ok:true but carries violations")
+    if not data.get("ok") and not data.get("violations"):
+        problems.append("artifact claims ok:false without any violation rows")
+    return problems
+
+
+# ------------------------------------------------------------------- gate
+
+def _usage_error(message: str):
+    print(f"crash_check: {message}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def parse_budget_overrides(pairs: list[str]) -> dict[str, float]:
+    overrides = {}
+    for pair in pairs:
+        name, _, value = pair.partition("=")
+        if not value:
+            _usage_error(f"--budget wants name=value, got {pair!r}")
+        try:
+            overrides[name] = float(value)
+        except ValueError:
+            _usage_error(f"--budget value not a number: {pair!r}")
+    return overrides
+
+
+def build_slos(overrides: dict[str, float]):
+    known = {s.name for s in STORAGE_SLOS}
+    unknown = sorted(set(overrides) - known)
+    if unknown:
+        _usage_error(
+            f"unknown SLO name(s): {', '.join(unknown)} "
+            f"(known: {', '.join(sorted(known))})"
+        )
+    try:
+        return tuple(
+            dataclasses.replace(s, budget=overrides[s.name])
+            if s.name in overrides else s
+            for s in STORAGE_SLOS
+        )
+    except ValueError as e:
+        _usage_error(str(e))
+
+
+def _violation(slo: str, reason: str, observed=None, budget=None) -> dict:
+    return {
+        "slo": slo,
+        "series": "storage_recovery_seconds",
+        "window": "gate",
+        "quantile": 1.0,
+        "observed": observed,
+        "budget": budget,
+        "count": 0,
+        "reason": reason,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="the CI profile (identical phases, default sizes)")
+    ap.add_argument("--trials", type=int, default=None,
+                    help=f"seeded SIGKILL trials (default: CRASH_TRIALS "
+                         f"env or {DEFAULT_TRIALS})")
+    ap.add_argument("--fuzz-cases", type=int, default=DEFAULT_FUZZ_CASES,
+                    help="seeded tail-corruption cases")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="fault-schedule seed (default: CRASH_SEED env or 7)")
+    ap.add_argument("--budget", action="append", default=[],
+                    metavar="NAME=SECONDS",
+                    help="override one SLO budget (repeatable)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the artifact to PATH")
+    ap.add_argument("--validate", metavar="PATH", default=None,
+                    help="audit an existing CRASH artifact and exit")
+    args = ap.parse_args()
+
+    if args.validate:
+        problems = validate_artifact(args.validate)
+        print(json.dumps({
+            "artifact": args.validate, "ok": not problems,
+            "problems": problems,
+        }))
+        for problem in problems:
+            print(f"CRASH VALIDATE: {problem}", file=sys.stderr)
+        return 1 if problems else 0
+
+    try:
+        seed = args.seed if args.seed is not None else int(
+            os.environ.get("CRASH_SEED", "") or 7
+        )
+        trials = args.trials if args.trials is not None else int(
+            os.environ.get("CRASH_TRIALS", "") or DEFAULT_TRIALS
+        )
+    except ValueError:
+        _usage_error("CRASH_SEED/CRASH_TRIALS must be integers")
+    if trials < 1 or args.fuzz_cases < 1:
+        _usage_error("--trials and --fuzz-cases must be positive")
+
+    phases = required_phases()
+    if not phases:
+        _usage_error("every phase is disabled; nothing to run")
+
+    # the gate measures; it must not be silently disabled by the env
+    get_metrics().set_enabled(True)
+
+    from lambda_ethereum_consensus_tpu.chaos import crash as crash_mod
+
+    engine = SloEngine(
+        slos=build_slos(parse_budget_overrides(args.budget)),
+        windows=CRASH_WINDOWS,
+    )
+    t0 = time.monotonic()
+    violations: list[dict] = []
+    trial_records: list[dict] = []
+    fuzz_records: list[dict] = []
+    red_record: dict | None = None
+    with tempfile.TemporaryDirectory(prefix="crash_") as base_dir:
+        print("crash_check: minting workload chain ...", file=sys.stderr)
+        workload = crash_mod.build_workload(seed, base_dir)
+        if "kill" in phases:
+            for trial in range(trials):
+                record = crash_mod.run_kill_trial(workload, trial, base_dir)
+                trial_records.append(record)
+                engine.tick()
+                tag = "ok" if record["ok"] else "FAILED"
+                print(
+                    f"crash_check: trial {trial} {tag} "
+                    f"(killed_at>={record['target_offset']}B, "
+                    f"{record['acked_windows']} windows finalized, "
+                    f"recovered in {record['recovery_s']}s)",
+                    file=sys.stderr,
+                )
+                for problem in record["problems"]:
+                    violations.append(_violation(
+                        "storage_recovery_p95",
+                        f"trial {trial}: {problem}",
+                    ))
+        if "fuzz" in phases or "redcheck" in phases:
+            base_path, finalized_end = crash_mod.build_fuzz_db(
+                workload, base_dir
+            )
+        if "fuzz" in phases:
+            for case in range(args.fuzz_cases):
+                record = crash_mod.run_fuzz_case(
+                    workload, base_path, finalized_end, base_dir, case
+                )
+                fuzz_records.append(record)
+                engine.tick()
+                for problem in record["problems"]:
+                    violations.append(_violation(
+                        "storage_recovery_p95",
+                        f"fuzz case {case} "
+                        f"({record['mutation']['kind']}): {problem}",
+                    ))
+            ok_n = sum(1 for r in fuzz_records if r["ok"])
+            print(
+                f"crash_check: fuzz sweep {ok_n}/{len(fuzz_records)} green",
+                file=sys.stderr,
+            )
+        if "redcheck" in phases:
+            red_record = crash_mod.red_self_check(
+                workload, base_path, finalized_end, base_dir
+            )
+            if not red_record["detected"]:
+                violations.append(_violation(
+                    "storage_recovery_p95",
+                    "planted finalized-record corruption went UNDETECTED "
+                    "— the gate's verifier is dead (silent green)",
+                ))
+            print(
+                "crash_check: red self-check "
+                + ("detected (good)" if red_record["detected"]
+                   else "UNDETECTED — gate cannot be trusted"),
+                file=sys.stderr,
+            )
+
+    report = engine.evaluate()
+    violations.extend(report["violations"])
+    # anti-silent-green: the recovery row must have observations when any
+    # recovery-driving phase ran
+    for row in report["slos"]:
+        if row["count"] == 0 and ("kill" in phases or "fuzz" in phases):
+            violations.append(_violation(
+                row["slo"],
+                "no recovery observations from an exercised phase set",
+                budget=row["budget"],
+            ))
+
+    artifact = {
+        "crash": {
+            "mode": "smoke" if args.smoke else "full",
+            "seed": seed,
+            "trials": trials if "kill" in phases else 0,
+            "fuzz_cases": args.fuzz_cases if "fuzz" in phases else 0,
+            "phases_run": list(phases),
+            "disabled_phases": [n for n in PHASE_ORDER if n not in phases],
+            "duration_s": round(time.monotonic() - t0, 3),
+        },
+        "trials": trial_records,
+        "fuzz": fuzz_records,
+        "red_self_check": red_record,
+        "slo_report": report,
+        "violations": violations,
+        "ok": not violations,
+    }
+    print(json.dumps(artifact, indent=2))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+
+    for v in violations:
+        observed = (
+            f"{v['observed']:.6f}s" if isinstance(v.get("observed"), float)
+            else "no_data"
+        )
+        reason = f" reason={v['reason']!r}" if v.get("reason") else ""
+        print(
+            "CRASH VIOLATION "
+            f"slo={v['slo']} series={v['series']} window={v['window']} "
+            f"observed={observed} budget={v['budget']}s{reason}",
+            file=sys.stderr,
+        )
+    if violations:
+        return 1
+    print(
+        f"crash_check: {len(trial_records)} kill trials + "
+        f"{len(fuzz_records)} fuzz cases green, red self-check fired, "
+        "storage_recovery_p95 within budget",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,9 @@ EXERCISED_BY = {
     "block_transition_p95": {"partition", "equivocation", "churn"},
     "chaos_recovery_p95": {"storm", "partition", "equivocation", "churn"},
     "fleet_divergence_p95": {"partition"},
+    # round 20: every DB resume (incl. the churn power-loss reboot)
+    # observes its WAL-replay + root-verification wall time
+    "storage_recovery_p95": {"churn"},
 }
 
 

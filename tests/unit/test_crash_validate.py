@@ -1,0 +1,217 @@
+"""crash_check.py artifact self-check (round 20 satellite): the
+CRASH_NO_* knob inventory, the truncated-artifact audit, the red
+self-check contract, and the SLO-row wiring — a CRASH_r*.json that
+silently lost its trials, its fuzz sweep, or its corruption detector
+must fail --validate loudly, the way soak/bench artifacts are audited."""
+
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+
+import crash_check  # noqa: E402
+
+from lambda_ethereum_consensus_tpu.slo import SOAK_SLOS, STORAGE_SLOS  # noqa: E402
+
+ALL = ("kill", "fuzz", "redcheck")
+
+
+# ------------------------------------------------------------- inventory
+
+def test_phase_knob_inventory():
+    """Every phase has a CRASH_NO_* knob and the gate's required set
+    honors each one — the SOAK_NO_*/BENCH_NO_* discipline."""
+    assert tuple(crash_check.PHASE_ORDER) == ALL
+    assert crash_check.required_phases(env={}) == ALL
+    for name in ALL:
+        knob = crash_check.phase_knob(name)
+        assert knob == f"CRASH_NO_{name.upper()}"
+        remaining = crash_check.required_phases(env={knob: "1"})
+        assert name not in remaining
+        assert set(remaining) == set(ALL) - {name}
+
+
+def test_trial_floor_meets_the_acceptance():
+    """`make crash-smoke` runs the default trial count — the acceptance
+    demands at least 20 seeded SIGKILL trials."""
+    assert crash_check.DEFAULT_TRIALS >= 20
+
+
+def test_storage_slo_row_is_wired():
+    """The gate's SLO set carries the storage_recovery_p95 row, and the
+    soak engine evaluates the same row (the churn power-loss scenario
+    feeds it)."""
+    names = {s.name for s in STORAGE_SLOS}
+    assert "storage_recovery_p95" in names
+    assert {s.family for s in STORAGE_SLOS} == {"storage_recovery_seconds"}
+    assert names <= {s.name for s in SOAK_SLOS}
+
+
+# ------------------------------------------------------------- artifacts
+
+def _artifact(tmp_path, mutate=None, disabled=()):
+    data = {
+        "crash": {
+            "mode": "smoke",
+            "seed": 7,
+            "trials": 3 if "kill" not in disabled else 0,
+            "fuzz_cases": 2 if "fuzz" not in disabled else 0,
+            "disabled_phases": list(disabled),
+        },
+        "trials": [
+            {"trial": t, "ok": True, "killed": True, "acked_windows": 4,
+             "problems": []}
+            for t in range(3)
+        ] if "kill" not in disabled else [],
+        "fuzz": [
+            {"case": c, "ok": True, "problems": [],
+             "mutation": {"kind": "truncate"}}
+            for c in range(2)
+        ] if "fuzz" not in disabled else [],
+        "red_self_check": (
+            {"detected": True, "offset": 1234}
+            if "redcheck" not in disabled else None
+        ),
+        "slo_report": {"slos": [], "violations": []},
+        "violations": [],
+        "ok": True,
+    }
+    if mutate is not None:
+        mutate(data)
+    path = tmp_path / "CRASH_test.json"
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_validate_green_artifact_passes(tmp_path):
+    assert crash_check.validate_artifact(_artifact(tmp_path)) == []
+
+
+def test_validate_follows_producer_knobs_not_validator_env(tmp_path):
+    path = _artifact(tmp_path, disabled=("fuzz",))
+    assert crash_check.validate_artifact(path, env={}) == []
+
+    def forget_knobs(data):
+        del data["crash"]["disabled_phases"]
+        data["fuzz"] = []
+
+    problems = crash_check.validate_artifact(
+        _artifact(tmp_path, forget_knobs), env={}
+    )
+    assert any("fuzz" in p for p in problems)
+
+
+def test_validate_flags_truncated_trials(tmp_path):
+    def drop_trials(data):
+        data["trials"] = data["trials"][:1]
+
+    problems = crash_check.validate_artifact(_artifact(tmp_path, drop_trials))
+    assert any("truncated" in p for p in problems)
+
+    def no_trials(data):
+        data["trials"] = []
+
+    problems = crash_check.validate_artifact(_artifact(tmp_path, no_trials))
+    assert any("no trial records" in p for p in problems)
+
+
+def test_validate_flags_verdictless_records(tmp_path):
+    def strip(data):
+        del data["trials"][1]["ok"]
+
+    problems = crash_check.validate_artifact(_artifact(tmp_path, strip))
+    assert any("verdict" in p for p in problems)
+
+    def strip_fuzz(data):
+        del data["fuzz"][0]["ok"]
+
+    problems = crash_check.validate_artifact(_artifact(tmp_path, strip_fuzz))
+    assert any("fuzz" in p and "verdict" in p for p in problems)
+
+
+def test_validate_flags_injector_that_never_fired(tmp_path):
+    """Green trials with zero actual SIGKILLs mean the injector never
+    ran — the crash-layer version of the soak zero-faults audit."""
+
+    def no_kills(data):
+        for t in data["trials"]:
+            t["killed"] = False
+
+    problems = crash_check.validate_artifact(_artifact(tmp_path, no_kills))
+    assert any("never fired" in p for p in problems)
+
+
+def test_validate_flags_dead_corruption_detector(tmp_path):
+    """ok:true with red_self_check.detected false is the silent-green
+    failure mode the acceptance names — a deliberately corrupted
+    finalized record MUST make the gate red."""
+
+    def dead_detector(data):
+        data["red_self_check"]["detected"] = False
+
+    problems = crash_check.validate_artifact(
+        _artifact(tmp_path, dead_detector)
+    )
+    assert any("UNDETECTED" in p for p in problems)
+
+    def missing_red(data):
+        data["red_self_check"] = None
+
+    problems = crash_check.validate_artifact(_artifact(tmp_path, missing_red))
+    assert any("self-check record missing" in p for p in problems)
+
+
+def test_validate_flags_headline_mismatch_and_unreadable(tmp_path):
+    def ok_with_violations(data):
+        data["violations"] = [{"slo": "x"}]
+
+    problems = crash_check.validate_artifact(
+        _artifact(tmp_path, ok_with_violations)
+    )
+    assert any("ok:true" in p for p in problems)
+
+    def red_without_violations(data):
+        data["ok"] = False
+
+    problems = crash_check.validate_artifact(
+        _artifact(tmp_path, red_without_violations)
+    )
+    assert any("without any violation" in p for p in problems)
+
+    assert crash_check.validate_artifact(str(tmp_path / "nope.json"))
+    empty = tmp_path / "empty.json"
+    empty.write_text("{}")
+    problems = crash_check.validate_artifact(str(empty))
+    assert any("no crash header" in p for p in problems)
+
+
+def test_validate_flags_missing_slo_report(tmp_path):
+    def strip_report(data):
+        del data["slo_report"]
+
+    problems = crash_check.validate_artifact(_artifact(tmp_path, strip_report))
+    assert any("SLO report" in p for p in problems)
+
+
+def test_recorded_crash_artifact_is_green():
+    """The checked-in CRASH_r01.json must itself audit clean, report
+    every trial green with a fired red self-check, and meet the >=20
+    trial acceptance floor."""
+    path = os.path.join(REPO_ROOT, "CRASH_r01.json")
+    assert crash_check.validate_artifact(path) == []
+    with open(path) as fh:
+        data = json.load(fh)
+    assert data["ok"] is True
+    assert data["crash"]["trials"] >= 20
+    assert len(data["trials"]) >= 20
+    assert all(t["ok"] and t["killed"] for t in data["trials"])
+    assert data["fuzz"] and all(c["ok"] for c in data["fuzz"])
+    assert data["red_self_check"]["detected"] is True
+    rows = {r["slo"]: r for r in data["slo_report"]["slos"]}
+    assert rows["storage_recovery_p95"]["count"] > 0
+    assert rows["storage_recovery_p95"]["ok"] is True

@@ -1150,12 +1150,153 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
     assert rc == 0
 
 
-def test_list_rules_names_five_active_rules(capsys):
+# ----------------------------------------------------------- durable-rename
+
+
+def test_durable_rename_fires_on_bare_replace_in_store(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "store/engine.py": """
+            import os
+
+            def compact(path):
+                tmp = path + ".compact"
+                with open(tmp, "wb") as f:
+                    f.write(b"snapshot")
+                os.replace(tmp, path)
+            """
+        },
+        rules=["durable-rename"],
+    )
+    assert len(findings) == 1
+    assert "os.replace" in findings[0].message
+    assert "BEFORE" in findings[0].message and "AFTER" in findings[0].message
+
+
+def test_durable_rename_fires_on_missing_dir_fsync_only(tmp_path):
+    """File fsynced, directory not: the rename's dirent write is still
+    unordered — half the discipline is no discipline."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "store/engine.py": """
+            import os
+
+            def compact(path):
+                tmp = path + ".compact"
+                with open(tmp, "wb") as f:
+                    f.write(b"snapshot")
+                    os.fsync(f.fileno())
+                os.rename(tmp, path)
+            """
+        },
+        rules=["durable-rename"],
+    )
+    assert len(findings) == 1
+    assert "parent directory AFTER" in findings[0].message
+    assert "BEFORE" not in findings[0].message
+
+
+def test_durable_rename_passes_with_full_discipline(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "store/engine.py": """
+            import os
+
+            def compact(path):
+                tmp = path + ".compact"
+                with open(tmp, "wb") as f:
+                    f.write(b"snapshot")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                dirfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dirfd)
+                finally:
+                    os.close(dirfd)
+            """
+        },
+        rules=["durable-rename"],
+    )
+    assert findings == []
+
+
+def test_durable_rename_blesses_the_helper_and_its_callers(tmp_path):
+    """The fsync_replace helper only needs the directory barrier (its
+    contract says callers fsync the file first); routing a rewrite
+    through it satisfies the rule with no local fsyncs."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "store/engine.py": """
+            import os
+
+            def fsync_replace(tmp_path, dst_path):
+                os.replace(tmp_path, dst_path)
+                dirfd = os.open(os.path.dirname(dst_path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dirfd)
+                finally:
+                    os.close(dirfd)
+
+            def migrate(path):
+                tmp = path + ".migrate"
+                with open(tmp, "wb") as f:
+                    f.write(b"framed")
+                    os.fsync(f.fileno())
+                fsync_replace(tmp, path)
+            """
+        },
+        rules=["durable-rename"],
+    )
+    assert findings == []
+
+
+def test_durable_rename_flags_a_helper_without_dir_fsync(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "store/engine.py": """
+            import os
+
+            def fsync_replace(tmp_path, dst_path):
+                os.replace(tmp_path, dst_path)
+            """
+        },
+        rules=["durable-rename"],
+    )
+    assert len(findings) == 1
+    assert "fsync the parent directory" in findings[0].message
+
+
+def test_durable_rename_scoped_to_store_paths(tmp_path):
+    """The same bare replace OUTSIDE store/ is not this rule's business
+    (AOT cache files etc. have their own trade-offs)."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "ops/cache.py": """
+            import os
+
+            def swap(path):
+                os.replace(path + ".tmp", path)
+            """
+        },
+        rules=["durable-rename"],
+    )
+    assert findings == []
+
+
+def test_list_rules_names_six_active_rules(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for name in (
         "async-blocking",
         "await-under-lock",
+        "durable-rename",
         "exception-containment",
         "retrace-hazard",
         "metric-contract",
@@ -1165,7 +1306,7 @@ def test_list_rules_names_five_active_rules(capsys):
 
 def test_repo_lints_clean():
     """The whole package (and the Grafana dashboards) must stay clean
-    under all five rules with the checked-in (empty) baseline — real
+    under all six rules with the checked-in (empty) baseline — real
     defects get fixed, intended patterns get inline suppressions."""
     rc = cli_main(
         [

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from .async_blocking import AsyncBlockingRule
 from .await_under_lock import AwaitUnderLockRule
+from .durable_rename import DurableRenameRule
 from .exception_containment import ExceptionContainmentRule
 from .metric_contract import MetricContractRule
 from .retrace_hazard import RetraceHazardRule
@@ -17,6 +18,7 @@ from .retrace_hazard import RetraceHazardRule
 ALL_RULES = [
     AsyncBlockingRule,
     AwaitUnderLockRule,
+    DurableRenameRule,
     ExceptionContainmentRule,
     RetraceHazardRule,
     MetricContractRule,

@@ -1,0 +1,113 @@
+"""durable-rename: atomic-replace without the fsync-file-then-dir discipline.
+
+The persistence layer's compaction/migration pattern — write a tmp file,
+``os.replace`` it over the live WAL — is only crash-safe when BOTH halves
+of the durable-rename discipline are present (store/kv.py
+``fsync_replace`` documents it):
+
+1. the written tmp FILE is fsynced before the rename (otherwise the
+   rename can land while the data is still in the page cache: a crash
+   yields a complete-looking file of garbage — worse than a torn tail,
+   because nothing detects it as damage at the filesystem level);
+2. the parent DIRECTORY is fsynced after the rename (POSIX does not
+   order the dirent update with anything: a crash can resurrect the old
+   file, or leave neither name).
+
+Scope: modules under a ``store/`` directory — the layer whose renames
+guard consensus-critical data.  A bare ``os.rename``/``os.replace``
+there must either live inside the blessed ``fsync_replace`` helper
+(which carries the dir-fsync itself and documents that callers fsync the
+file first) or be accompanied, in the same function, by an ``os.fsync``
+BEFORE the call (the file barrier) and an ``os.fsync`` AFTER it (the
+directory barrier).  Everything else is a finding.  ``tempfile``-based
+write-then-rename helpers hit the same check through their rename call.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Project
+from .common import dotted, walk_excluding_nested
+
+_RENAMES = {"os.replace", "os.rename"}
+
+#: The blessed helper: performs the rename + directory fsync itself; its
+#: contract (callers fsync the written file first) is checked by the
+#: store's torn-write tests rather than this syntactic rule.
+_HELPER = "fsync_replace"
+
+
+def _in_store(rel: str) -> bool:
+    return "/store/" in rel or rel.startswith("store/")
+
+
+class DurableRenameRule:
+    name = "durable-rename"
+    description = "os.replace in store/ without fsync-file-then-dir"
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for module in project.modules:
+            if not _in_store(module.rel):
+                continue
+            findings.extend(self._check_module(module))
+        return findings
+
+    def _check_module(self, module: Module) -> list[Finding]:
+        findings: list[Finding] = []
+        funcs: list[tuple[str, ast.AST]] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.append((node.name, node))
+        for name, func in funcs:
+            calls = [
+                n for n in walk_excluding_nested(func)
+                if isinstance(n, ast.Call)
+            ]
+            fsync_lines = [
+                c.lineno for c in calls if dotted(c.func) == "os.fsync"
+            ]
+            for call in calls:
+                cname = dotted(call.func)
+                if cname not in _RENAMES:
+                    continue
+                if name == _HELPER:
+                    # the helper itself only needs the directory barrier
+                    if any(line > call.lineno for line in fsync_lines):
+                        continue
+                    findings.append(Finding(
+                        rule=self.name,
+                        path=module.rel,
+                        line=call.lineno,
+                        message=(
+                            f"{_HELPER} must fsync the parent directory "
+                            f"after {cname} (the rename's dirent write is "
+                            "unordered without it)"
+                        ),
+                    ))
+                    continue
+                has_file_barrier = any(
+                    line < call.lineno for line in fsync_lines
+                )
+                has_dir_barrier = any(
+                    line > call.lineno for line in fsync_lines
+                )
+                if has_file_barrier and has_dir_barrier:
+                    continue
+                missing = []
+                if not has_file_barrier:
+                    missing.append("os.fsync of the written file BEFORE it")
+                if not has_dir_barrier:
+                    missing.append("os.fsync of the parent directory AFTER it")
+                findings.append(Finding(
+                    rule=self.name,
+                    path=module.rel,
+                    line=call.lineno,
+                    message=(
+                        f"{cname} in store/ without the durable-rename "
+                        f"discipline: missing {' and '.join(missing)} "
+                        f"(or route it through {_HELPER})"
+                    ),
+                ))
+        return findings
