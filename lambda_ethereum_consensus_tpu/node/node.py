@@ -1011,9 +1011,18 @@ class BeaconNode:
                 from ..ops import profile as ops_profile
 
                 total = float(sum(getattr(a, "nbytes", 0) for a in arrays))
+                # round 21: sharded planes report PER-DEVICE bytes (the
+                # logical total divided by the live buffer spread) with
+                # sharded="1", so the watermark panel proves the <= 1/N
+                # residency claim instead of summing replicas
+                spread = ops_profile.plane_shard_devices()
                 for plane, nbytes in ops_profile.plane_bytes(total).items():
+                    ndev = spread.get(plane, 1)
                     proc_m.set_gauge(
-                        "device_plane_bytes", float(nbytes), plane=plane
+                        "device_plane_bytes",
+                        float(nbytes) / ndev,
+                        plane=plane,
+                        sharded="1" if ndev > 1 else "0",
                     )
                 proc_m.set_gauge(
                     "device_plane_bytes_watermark",

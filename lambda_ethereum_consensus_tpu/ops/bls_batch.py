@@ -653,22 +653,28 @@ class RegistryPlaneStore:
 
         self._sharded = shard_plane_store_enabled()
 
-    def _place(self, arr):
-        """Pin a (32, capacity) plane buffer in the store's layout —
-        column-sharded over the mesh when enabled (capacity is pow2, so
-        it always divides the pow2 ``dp`` axis), resident-as-is
-        otherwise."""
+    def _place(self, name: str, arr):
+        """Pin a (32, capacity) plane buffer in the layout the round-21
+        partition-rule table legislates for ``name`` (``registry/rx`` /
+        ``registry/ry`` — column-sharded over the mesh; capacity is pow2
+        so it always divides the pow2 ``dp`` axis), resident-as-is when
+        the store is unsharded."""
         if not self._sharded:
             return arr
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from . import shard_rules
 
-        from .mesh import default_mesh
+        return shard_rules.place(name, arr)
 
-        mesh = default_mesh()
-        if arr.shape[1] % mesh.devices.size:
-            return arr  # sub-mesh capacity: keep unsharded
-        return jax.device_put(arr, NamedSharding(mesh, P(None, "dp")))
+    def shard_devices(self) -> int:
+        """Live mesh-device spread of the resident planes (1 =
+        replicated/unsharded) — read from the buffer's sharding, never
+        the construction-time intent."""
+        if self.rx is None:
+            return 1
+        try:
+            return max(1, len(self.rx.sharding.device_set))
+        except AttributeError:
+            return 1
 
     @property
     def resident_bytes(self) -> int:
@@ -710,18 +716,24 @@ class RegistryPlaneStore:
             from jax import lax
 
             self.rx = self._place(
-                lax.dynamic_update_slice(self.rx, new_x, (0, self.count))
+                "registry/rx",
+                lax.dynamic_update_slice(self.rx, new_x, (0, self.count)),
             )
             self.ry = self._place(
-                lax.dynamic_update_slice(self.ry, new_y, (0, self.count))
+                "registry/ry",
+                lax.dynamic_update_slice(self.ry, new_y, (0, self.count)),
             )
         else:
             cap = _pow2(max(n, self._min_cap))
             zx = jnp.zeros((32, cap - n), new_x.dtype)
             prefix_x = [self.rx[:, : self.count]] if self.count else []
             prefix_y = [self.ry[:, : self.count]] if self.count else []
-            self.rx = self._place(jnp.concatenate(prefix_x + [new_x, zx], axis=1))
-            self.ry = self._place(jnp.concatenate(prefix_y + [new_y, zx], axis=1))
+            self.rx = self._place(
+                "registry/rx", jnp.concatenate(prefix_x + [new_x, zx], axis=1)
+            )
+            self.ry = self._place(
+                "registry/ry", jnp.concatenate(prefix_y + [new_y, zx], axis=1)
+            )
             self.capacity = cap
         self.uploaded_cols += n - self.count
         self.count = n
@@ -767,7 +779,11 @@ def plane_store_stats() -> dict:
 from .profile import register_plane as _register_plane  # noqa: E402
 
 _register_plane(
-    "registry_planes", lambda: plane_store_stats()["resident_bytes"]
+    "registry_planes",
+    lambda: plane_store_stats()["resident_bytes"],
+    devices=lambda: max(
+        (s.shard_devices() for s in _PLANE_STORES.values()), default=1
+    ),
 )
 
 

@@ -29,6 +29,7 @@ __all__ = [
     "shard_enabled",
     "shard_map_compat",
     "shard_plane_store_enabled",
+    "state_shard_enabled",
 ]
 
 _DEFAULT_MESH = None
@@ -127,6 +128,22 @@ def shard_plane_store_enabled() -> bool:
     if env_flag("BLS_NO_SHARD"):
         return False
     if env_flag("BLS_SHARD_PLANES"):
+        return True
+    return _multi_device_tpu(None)
+
+
+def state_shard_enabled() -> bool:
+    """Should the per-validator STATE planes (resident epoch columns,
+    SSZ chunk rows — round 21) be placed sharded across the mesh?
+
+    Same polarity ladder as ``BLS_SHARD``: ``GRAFT_STATE_NO_SHARD=1``
+    always wins (single-device residency, identical results),
+    ``GRAFT_STATE_SHARD=1`` force-enables (CI's virtual 8-CPU mesh),
+    default on exactly for a multi-device TPU backend something already
+    proved alive — never dials an uninitialized backend."""
+    if env_flag("GRAFT_STATE_NO_SHARD"):
+        return False
+    if env_flag("GRAFT_STATE_SHARD"):
         return True
     return _multi_device_tpu(None)
 

@@ -79,6 +79,7 @@ __all__ = [
     "entry_plane_bytes",
     "live_device_bytes",
     "plane_bytes",
+    "plane_shard_devices",
     "plane_watermark",
     "profile_report",
     "record_entry_cost",
@@ -382,14 +383,22 @@ class PlaneRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._planes: dict[str, tuple] = {}  # name -> (provider, device)
+        # name -> (provider, device, devices); ``devices`` is an optional
+        # callable answering how many mesh devices the plane's buffers
+        # are SPREAD over (1 = replicated/unsharded) — read from live
+        # buffer shardings, so the round-21 per-device accounting never
+        # claims a split that placement fell back from
+        self._planes: dict[str, tuple] = {}
         self._watermark = 0.0
 
-    def register(self, name: str, provider, device: bool = True) -> None:
+    def register(self, name: str, provider, device: bool = True,
+                 devices=None) -> None:
         if not callable(provider):
             raise TypeError(f"plane {name!r} provider must be callable")
+        if devices is not None and not callable(devices):
+            raise TypeError(f"plane {name!r} devices must be callable")
         with self._lock:
-            self._planes[name] = (provider, bool(device))
+            self._planes[name] = (provider, bool(device), devices)
 
     def unregister(self, name: str) -> None:
         with self._lock:
@@ -404,7 +413,7 @@ class PlaneRegistry:
             items = list(self._planes.items())
         out: dict[str, float] = {}
         attributed = 0.0
-        for name, (provider, device) in items:
+        for name, (provider, device, _devices) in items:
             try:
                 nbytes = float(provider() or 0.0)
             except Exception:
@@ -417,6 +426,24 @@ class PlaneRegistry:
             out["unattributed"] = max(0.0, total - attributed)
             with self._lock:
                 self._watermark = max(self._watermark, total)
+        return out
+
+    def shard_devices(self) -> dict[str, int]:
+        """name -> live device spread for every plane that registered a
+        ``devices`` provider (others report 1).  A provider that raises
+        reports 1 — same never-take-down-the-tick contract as byte
+        providers."""
+        with self._lock:
+            items = list(self._planes.items())
+        out: dict[str, int] = {}
+        for name, (_provider, _device, devices) in items:
+            n = 1
+            if devices is not None:
+                try:
+                    n = max(1, int(devices() or 1))
+                except Exception:
+                    n = 1
+            out[name] = n
         return out
 
     @property
@@ -433,9 +460,12 @@ _REGISTRY = PlaneRegistry()
 _ENTRY_PLANES: dict[str, str] = {}  # plane name -> entry prefix
 
 
-def register_plane(name: str, provider, device: bool = True) -> None:
-    """Register a retained-bytes provider on the default registry."""
-    _REGISTRY.register(name, provider, device=device)
+def register_plane(name: str, provider, device: bool = True,
+                   devices=None) -> None:
+    """Register a retained-bytes provider on the default registry;
+    ``devices`` optionally reports how many mesh devices the plane's
+    buffers are spread over (round-21 sharded residency)."""
+    _REGISTRY.register(name, provider, device=device, devices=devices)
 
 
 def unregister_plane(name: str) -> None:
@@ -479,6 +509,12 @@ def plane_bytes(total_bytes: float | None = None) -> dict[str, float]:
     live total is supplied) — the node tick's ``device_plane_bytes``
     source."""
     return _REGISTRY.snapshot(total_bytes)
+
+
+def plane_shard_devices() -> dict[str, int]:
+    """name -> live mesh-device spread per plane (1 = unsharded) — the
+    shard-aware ``device_plane_bytes`` divisor."""
+    return _REGISTRY.shard_devices()
 
 
 def plane_watermark() -> float:

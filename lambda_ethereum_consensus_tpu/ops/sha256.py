@@ -351,11 +351,11 @@ def merkle_root_words_sharded(words, mesh=None) -> jax.Array:
     assert m % d == 0 and m // d >= 1, (m, d)
     depth_global = d.bit_length() - 1
     depth_local = (m // d).bit_length() - 1
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    # placement through the round-21 partition-rule table: the chunk
+    # rows are a legislated plane, not an ad-hoc device_put
+    from . import shard_rules
 
-    words = jax.device_put(
-        jnp.asarray(words), NamedSharding(mesh, P("dp", None))
-    )
+    words = shard_rules.place("ssz/chunk_rows", jnp.asarray(words), mesh)
     return _sharded_tree_fn(mesh, depth_local, depth_global)(words)[0]
 
 

@@ -1,0 +1,101 @@
+"""Declarative partition-rule table for mesh-sharded state residency.
+
+Round 21 replaces per-plane ad-hoc ``jax.device_put(...,
+NamedSharding(...))`` calls — the resident epoch columns
+(state_transition/resident.py), the registry pubkey planes
+(ops/bls_batch.RegistryPlaneStore) and the SSZ chunk rows feeding the
+sharded Merkle plane (ops/sha256.py) — with ONE placement code path
+driven by this table: plane-name regex -> partition spec, the
+``match_partition_rules`` idiom from the t5x/flax partitioning
+lineage.  A plane that wants mesh placement names itself; the table
+decides the layout.
+
+The contract is deliberately stricter than first-match: every placed
+plane name must match EXACTLY ONE rule (zero means someone forgot to
+legislate a layout for a new plane; two means the table is ambiguous
+and the winner would be accidental), and no rule may be dead.  The
+``shard-rules`` graftlint check enforces both statically across the
+repo, so the table and its call sites cannot drift apart silently.
+
+Specs are stored as plain tuples of mesh-axis names (``None`` =
+replicated along that array axis) so importing the table — which the
+linter's fixtures and the routing tests do — never dials a jax
+backend; :func:`place` builds the real ``PartitionSpec`` lazily.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = [
+    "PARTITION_RULES",
+    "match_partition_rule",
+    "place",
+    "sharded_axis",
+]
+
+# plane-name regex -> partition spec (tuple of mesh axis names per array
+# axis; None = replicated).  The validator/registry-column axis is the
+# one data-parallel axis every rule deals over ``dp``:
+#   resident/*     (capacity,)        1-D per-validator columns
+#   registry/r[xy] (32, capacity)     limb-plane rows x validator columns
+#   ssz/chunk_rows (blocks, words)    Merkle leaf-block rows
+PARTITION_RULES: tuple[tuple[str, tuple], ...] = (
+    (r"^resident/(bal_lo|bal_hi|scores|part_prev|part_cur)$", ("dp",)),
+    (r"^registry/r[xy]$", (None, "dp")),
+    (r"^ssz/chunk_rows$", ("dp", None)),
+)
+
+
+def match_partition_rule(name: str) -> tuple:
+    """The spec tuple for ``name`` under the exactly-one-rule contract.
+
+    Raises ``LookupError`` when no rule matches (an unlegislated plane)
+    and ``ValueError`` when more than one does (an ambiguous table) —
+    both are programming errors the ``shard-rules`` lint catches before
+    runtime ever does.
+    """
+    hits = [
+        (pattern, spec)
+        for pattern, spec in PARTITION_RULES
+        if re.search(pattern, name)
+    ]
+    if not hits:
+        raise LookupError(f"no partition rule matches plane {name!r}")
+    if len(hits) > 1:
+        raise ValueError(
+            f"plane {name!r} matches {len(hits)} partition rules: "
+            + ", ".join(p for p, _ in hits)
+        )
+    return hits[0][1]
+
+
+def sharded_axis(spec: tuple) -> int:
+    """Index of the array axis the spec deals over the mesh."""
+    for i, ax in enumerate(spec):
+        if ax is not None:
+            return i
+    raise ValueError(f"spec {spec!r} shards no axis")
+
+
+def place(name: str, arr, mesh=None):
+    """THE placement code path: pin ``arr`` in the layout the rule table
+    legislates for plane ``name``.
+
+    Falls back to plain device residency (unsharded) when the sharded
+    axis does not divide the mesh — callers keep pow2 capacities so
+    this only fires for sub-mesh toy shapes, and an uneven split would
+    otherwise pad-and-lie about the plane's bytes.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .mesh import default_mesh
+
+    spec = match_partition_rule(name)
+    if mesh is None:
+        mesh = default_mesh()
+    axis = sharded_axis(spec)
+    if int(arr.shape[axis]) % int(mesh.devices.size):
+        return jax.device_put(arr)
+    return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(*spec)))

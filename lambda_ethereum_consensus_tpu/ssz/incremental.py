@@ -68,6 +68,23 @@ _REBUILD_FRACTION = 4
 # so the crossover sits near 2^18 chunks (measured round 4: the 31k-chunk
 # participation sweep was 0.9 s via device vs 0.27 s on host)
 _DEVICE_CHUNKS = 1 << 18
+# ...but never below this even on the widest mesh: tiny dispatches lose
+# to the host regardless of how many devices split them
+_DEVICE_CHUNKS_MIN = 1 << 12
+
+
+def _device_chunk_floor() -> int:
+    """The host/device routing crossover, shard-aware (round 21): with
+    mesh-sharded state residency on, each device hashes only its block
+    of the chunk rows, so the per-device crossover divides by the live
+    mesh width — a rebuild big enough to beat the host on ONE chip at
+    2^18 chunks beats it at 2^18/8 when eight chips split the rows."""
+    from ..ops.mesh import initialized_device_count, state_shard_enabled
+
+    if not state_shard_enabled():
+        return _DEVICE_CHUNKS
+    n = initialized_device_count() or 1
+    return max(_DEVICE_CHUNKS_MIN, _DEVICE_CHUNKS // max(1, n))
 
 
 def _sha(pair: bytes) -> bytes:
@@ -295,31 +312,13 @@ class IncrementalStateRoot:
 
     def _consume_delta(self, cache: _FieldCache, value) -> frozenset | None:
         """The pushed-delta channel: a superset of the indices at which
-        ``value`` may differ from the cached snapshot, by walking the
-        adopt chain from ``value`` back to the stamped instance and
-        unioning the per-instance mutation logs.  ``None`` means the
-        chain can't vouch (unstamped, branched lineage, a structural op
-        anywhere along the walk, or a structural op on the stamped
-        instance after the stamp) — the caller then value-diffs, which
-        is always exact."""
-        target = cache.last_list
-        if target is None or getattr(value, "gen", None) is None:
-            return None
-        delta: set[int] = set()
-        node = value
-        for _ in range(8):
-            if node is target:
-                if node.full_gen > cache.stamp_gen:
-                    return None  # structural op since the stamp
-                delta.update(node.dirty)  # over-approx: pre-stamp too
-                return frozenset(delta)
-            if node.full_gen > 0:
-                return None  # structural op in an intermediate copy
-            delta.update(node.dirty)
-            node = node.parent
-            if node is None:
-                return None
-        return None
+        ``value`` may differ from the cached snapshot.  One shared walk
+        (``mutable.dirty_superset``) serves this engine and the resident
+        plane's shard-aware sync; ``None`` means the chain can't vouch
+        and the caller value-diffs, which is always exact."""
+        from ..state_transition.mutable import dirty_superset
+
+        return dirty_superset(value, cache.last_list, cache.stamp_gen)
 
     # ---- packed basic columns: balances, participation, inactivity, slashings
     def _uint_field(self, cache, ftype, value, spec, backend) -> bytes:
@@ -379,7 +378,7 @@ class IncrementalStateRoot:
         if cache.chunks is None or cache.count != m:
             cw = chunks.copy()  # writable: the pushed-delta path edits in place
             cache.levels = _build_levels(
-                cw, backend if m > _DEVICE_CHUNKS else self._host
+                cw, backend if m > _device_chunk_floor() else self._host
             )
             cache.chunks, cache.count = cw, m
         else:
@@ -388,7 +387,7 @@ class IncrementalStateRoot:
                 if dirty.size > m // _REBUILD_FRACTION:
                     cw = chunks.copy()
                     cache.levels = _build_levels(
-                        cw, backend if m > _DEVICE_CHUNKS else self._host
+                        cw, backend if m > _device_chunk_floor() else self._host
                     )
                     cache.chunks = cw
                 else:
@@ -437,7 +436,7 @@ class IncrementalStateRoot:
         if cache.prev is None or cache.count != n:
             leaves = self._element_leaves(elem, value, spec, backend)
             cache.levels = _build_levels(
-                leaves, backend if n > _DEVICE_CHUNKS else self._host
+                leaves, backend if n > _device_chunk_floor() else self._host
             )
             cache.prev, cache.count = list(value), n
         else:
@@ -447,7 +446,7 @@ class IncrementalStateRoot:
                 if len(dirty) > max(n // _REBUILD_FRACTION, 8):
                     leaves = self._element_leaves(elem, value, spec, backend)
                     cache.levels = _build_levels(
-                        leaves, backend if n > _DEVICE_CHUNKS else self._host
+                        leaves, backend if n > _device_chunk_floor() else self._host
                     )
                 else:
                     sub = self._element_leaves(

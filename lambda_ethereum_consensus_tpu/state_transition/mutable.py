@@ -158,6 +158,45 @@ class TrackedList(list):
         self._structural()
         list.clear(self)
 
+
+def dirty_superset(value, target, stamp_gen: int) -> frozenset | None:
+    """A provable superset of the indices at which ``value`` may differ
+    from ``target``'s content as of generation ``stamp_gen``, by walking
+    the adopt chain from ``value`` back to ``target`` and unioning the
+    per-instance mutation logs.
+
+    THE one copy of the delta-chain walk, shared by both consumers: the
+    incremental root engine (ssz/incremental.py ``_consume_delta``) and
+    the resident epoch plane's shard-aware sync
+    (state_transition/resident.py), which uses it to narrow the host
+    mirror compare to the touched indices instead of diffing the full
+    10M-validator column per boundary.
+
+    ``None`` means the chain can't vouch (unstamped, branched lineage,
+    a structural op anywhere along the walk, or a structural op on the
+    stamped instance after the stamp) — callers then value-diff, which
+    is always exact.  The returned set over-approximates (pre-stamp
+    dirty entries ride along): safe, extra indices only cost extra
+    compares/hashes.
+    """
+    if target is None or getattr(value, "gen", None) is None:
+        return None
+    delta: set[int] = set()
+    node = value
+    for _ in range(2 * _MAX_CHAIN):
+        if node is target:
+            if node.full_gen > stamp_gen:
+                return None  # structural op since the stamp
+            delta.update(node.dirty)  # over-approx: pre-stamp too
+            return frozenset(delta)
+        if node.full_gen > 0:
+            return None  # structural op in an intermediate copy
+        delta.update(node.dirty)
+        node = node.parent
+        if node is None:
+            return None
+    return None
+
     def sort(self, **kwargs):
         self._structural()
         list.sort(self, **kwargs)

@@ -44,7 +44,9 @@ import weakref
 import numpy as np
 
 from ..config import ChainSpec, constants, get_chain_spec
+from ..ops import shard_rules
 from ..ops.aot import aot_jit, compile_context, register_shape_bucket
+from ..ops.mesh import state_shard_enabled
 from ..ops.profile import register_plane
 from ..telemetry import observe, set_gauge
 from .math import integer_squareroot
@@ -107,17 +109,16 @@ def _scatter_buckets(capacity: int) -> tuple[int, ...]:
 # --------------------------------------------------------------- kernels
 
 
-def _build_kernels() -> dict:
-    """The jitted kernel set — shape-polymorphic wrappers whose compiled
-    programs are AOT-cached per padded column shape (aot_jit keys on the
-    actual argument signature).
+def _kernel_bodies() -> dict:
+    """The pure kernel bodies, element-wise over the validator axis.
 
-    Donation map: the sweep updates (bal_lo, bal_hi, scores) in place;
-    the scatter kernels update their target column in place.  Callers
-    MUST rebind their references to the outputs — graftlint's
-    retrace-hazard donated-buffer check enforces exactly that.
+    Shared VERBATIM by the single-device jit wrappers
+    (:func:`_build_kernels`) and the round-21 ``shard_map`` wrappers
+    (:func:`_build_sharded_kernels`): the sweep and hysteresis bodies
+    are collective-free by construction (no cross-validator data flow),
+    so sharding them is purely a placement decision — only the epoch
+    sums need one ``psum`` to finish.
     """
-    import jax
     import jax.numpy as jnp
 
     u32 = jnp.uint32
@@ -229,6 +230,23 @@ def _build_kernels() -> dict:
         eu_hi = e_hi + (eu_lo < up).astype(u32)
         return lt(bd_lo, bd_hi, e_lo, e_hi) | lt(eu_lo, eu_hi, bal_lo, bal_hi)
 
+    return {"sums": _sums, "sweep": _sweep, "hysteresis": _hysteresis}
+
+
+def _build_kernels() -> dict:
+    """The jitted kernel set — shape-polymorphic wrappers whose compiled
+    programs are AOT-cached per padded column shape (aot_jit keys on the
+    actual argument signature).
+
+    Donation map: the sweep updates (bal_lo, bal_hi, scores) in place;
+    the scatter kernels update their target column in place.  Callers
+    MUST rebind their references to the outputs — graftlint's
+    retrace-hazard donated-buffer check enforces exactly that.
+    """
+    import jax
+
+    bodies = _kernel_bodies()
+
     def _scatter2(lo, hi, idx, v_lo, v_hi):
         return lo.at[idx].set(v_lo), hi.at[idx].set(v_hi)
 
@@ -243,12 +261,14 @@ def _build_kernels() -> dict:
     # intermittently (see aot_jit's docstring) — they stay in-memory
     # cached and the warmer compiles them off the boot critical path
     return {
-        "sums": aot_jit(jax.jit(_sums), "transition_sums"),
+        "sums": aot_jit(jax.jit(bodies["sums"]), "transition_sums"),
         "sweep": aot_jit(
-            jax.jit(_sweep, donate_argnums=(0, 1, 2)),
+            jax.jit(bodies["sweep"], donate_argnums=(0, 1, 2)),
             "transition_sweep", disk=False,
         ),
-        "hysteresis": aot_jit(jax.jit(_hysteresis), "transition_hysteresis"),
+        "hysteresis": aot_jit(
+            jax.jit(bodies["hysteresis"]), "transition_hysteresis"
+        ),
         "scatter2": aot_jit(
             jax.jit(_scatter2, donate_argnums=(0, 1)),
             "transition_scatter2", disk=False,
@@ -269,6 +289,115 @@ def _kernels() -> dict:
         return _KERNELS
 
 
+_SHARD_KERNELS: dict = {}
+
+
+def _build_sharded_kernels(mesh) -> dict:
+    """The round-21 mesh-sharded kernel set, cached per mesh identity.
+
+    The sweep and hysteresis bodies run UNCHANGED under ``shard_map`` —
+    element-wise over the validator axis, every column dealt ``P("dp")``,
+    zero communication.  The epoch sums reduce each device's local
+    partial through ONE ``psum``.  The scatter/gather kernels take
+    per-shard index/value ROWS (``(n_shards, bucket)``, dealt
+    ``P("dp", None)``): each device writes only its own row into its
+    local column block, so the delta scatter is collective-free too —
+    the host routes every touched index to its owning shard
+    (:meth:`ResidentEpochPlane._shard_rows`).  ``disk=False``
+    throughout: the donated programs must never hit the serialized
+    executable tier, and shard_map programs deserialized on the CPU
+    mesh are the measured round-4 crash mode.
+    """
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.mesh import shard_map_compat
+
+    key = tuple(d.id for d in mesh.devices.flat)
+    with _KERNEL_LOCK:
+        hit = _SHARD_KERNELS.get(key)
+        if hit is not None:
+            return hit
+
+    bodies = _kernel_bodies()
+    col = P("dp")
+    row = P("dp", None)
+    rep = P()
+
+    def _smap(fn, in_specs, out_specs, name, donate=()):
+        kwargs = {"donate_argnums": donate} if donate else {}
+        jitted = jax.jit(
+            shard_map_compat(fn, mesh, in_specs, out_specs), **kwargs
+        )
+        return aot_jit(jitted, name, disk=False)
+
+    def _sums_psum(*args):
+        return lax.psum(bodies["sums"](*args), "dp")
+
+    # scatter/gather rows arrive (1, bucket) per device after shard_map
+    # splits the leading shard axis: each device applies only ITS row to
+    # its local column block — pre-routed by the host
+    # (ResidentEpochPlane._shard_rows), so no collective is needed.  The
+    # ``own`` mask keeps padded slots as identity read-back writes: a
+    # shard with no touched indices cannot know a fresh value to repeat
+    # (mid-epoch the host mirrors are stale), so it rewrites what the
+    # buffer already holds.
+    def _scatter2_rows(lo, hi, idx, v_lo, v_hi, own):
+        import jax.numpy as jnp
+
+        i = idx[0]
+        new_lo = jnp.where(own[0], v_lo[0], lo[i])
+        new_hi = jnp.where(own[0], v_hi[0], hi[i])
+        return lo.at[i].set(new_lo), hi.at[i].set(new_hi)
+
+    def _scatter1_rows(buf, idx, vals, own):
+        import jax.numpy as jnp
+
+        i = idx[0]
+        return buf.at[i].set(jnp.where(own[0], vals[0], buf[i]))
+
+    def _gather2_rows(lo, hi, idx, own):
+        import jax.numpy as jnp
+
+        g_lo = jnp.where(own[0], lo[idx[0]], 0)
+        g_hi = jnp.where(own[0], hi[idx[0]], 0)
+        # each bucket slot is owned by exactly one shard (others
+        # contribute zeros), so the sum IS the gather
+        return lax.psum(g_lo, "dp"), lax.psum(g_hi, "dp")
+
+    kernels = {
+        "sums": _smap(
+            _sums_psum, (col,) * 6, rep, "transition_shard_sums"
+        ),
+        "sweep": _smap(
+            bodies["sweep"],
+            (col,) * 8 + (rep, rep),
+            (col, col, col),
+            "transition_shard_sweep",
+            donate=(0, 1, 2),
+        ),
+        "hysteresis": _smap(
+            bodies["hysteresis"], (col, col, col, rep), col,
+            "transition_shard_hysteresis",
+        ),
+        "scatter2": _smap(
+            _scatter2_rows, (col, col, row, row, row, row), (col, col),
+            "transition_shard_scatter2", donate=(0, 1),
+        ),
+        "scatter1": _smap(
+            _scatter1_rows, (col, row, row, row), col,
+            "transition_shard_scatter1", donate=(0,),
+        ),
+        "gather2": _smap(
+            _gather2_rows, (col, col, row, row), (rep, rep),
+            "transition_shard_gather2",
+        ),
+    }
+    with _KERNEL_LOCK:
+        return _SHARD_KERNELS.setdefault(key, kernels)
+
+
 # ----------------------------------------------------------------- plane
 
 
@@ -279,6 +408,9 @@ _LIVE_PLANES: "weakref.WeakSet[ResidentEpochPlane]" = weakref.WeakSet()
 register_plane(
     "resident_epoch",
     lambda: sum(p.device_bytes for p in list(_LIVE_PLANES)),
+    devices=lambda: max(
+        (p.shard_devices() for p in list(_LIVE_PLANES)), default=1
+    ),
 )
 
 
@@ -308,6 +440,27 @@ class ResidentEpochPlane:
         self.part_prev = None
         self.part_cur = None
         self.stats = {"syncs": 0, "sweeps": 0, "scatter_elems": 0, "fallbacks": 0}
+        # delta-chain stamps: field -> (TrackedList instance, gen) the
+        # mirrors matched last, so sync can narrow its mirror compare to
+        # the indices mutated since (mutable.dirty_superset)
+        self._stamps: dict = {}
+        # mesh-sharded residency (round 21): decided ONCE at construction
+        # — re-deciding per sync would bounce every column between
+        # layouts.  Capacity is pow2 and the dp axis is pow2, so the
+        # block split is always even.
+        self.sharded = False
+        self._mesh = None
+        self.n_shards = 1
+        if state_shard_enabled():
+            from ..ops.mesh import default_mesh, mesh_devices
+
+            self._mesh = default_mesh()
+            self.n_shards = mesh_devices(self._mesh)
+            self.sharded = self.n_shards > 1 and (
+                self.capacity % self.n_shards == 0
+            )
+            if not self.sharded:
+                self._mesh, self.n_shards = None, 1
         register_shape_bucket("transition_validators", self.capacity)
         for b in _scatter_buckets(self.capacity):
             register_shape_bucket("transition_scatter", b)
@@ -316,7 +469,9 @@ class ResidentEpochPlane:
     @property
     def device_bytes(self) -> int:
         """Bytes pinned by the resident columns (0 before first sync) —
-        the round-18 plane-registry accounting source."""
+        the round-18 plane-registry accounting source.  Logical total
+        across the mesh; divide by :meth:`shard_devices` for the
+        per-device footprint the watermark gauge reports."""
         return sum(
             int(col.nbytes)
             for col in (
@@ -326,6 +481,18 @@ class ResidentEpochPlane:
             if col is not None
         )
 
+    def shard_devices(self) -> int:
+        """How many devices the resident columns are actually spread
+        over (1 = replicated/unsharded) — read from the live buffer's
+        sharding, not the construction-time intent, so the accounting
+        never claims a split that placement fell back from."""
+        if self.bal_lo is None:
+            return 1
+        try:
+            return max(1, len(self.bal_lo.sharding.device_set))
+        except AttributeError:
+            return 1
+
     # ------------------------------------------------------------- sync
 
     def _pad_col(self, arr: np.ndarray, dtype) -> np.ndarray:
@@ -333,18 +500,33 @@ class ResidentEpochPlane:
         out[: arr.shape[0]] = arr
         return out
 
+    def _put(self, name: str, arr: np.ndarray):
+        """THE column placement path: through the partition-rule table
+        when this plane is sharded, plain device residency otherwise."""
+        import jax
+
+        if self.sharded:
+            return shard_rules.place(name, arr, self._mesh)
+        return jax.device_put(arr)
+
+    def _kset(self) -> dict:
+        return (
+            _build_sharded_kernels(self._mesh) if self.sharded else _kernels()
+        )
+
     def _upload_full(self, balances: np.ndarray, scores: np.ndarray,
                      part_prev: np.ndarray, part_cur: np.ndarray) -> None:
-        import jax
-        import jax.numpy as jnp  # noqa: F401  (jnp types via device_put)
-
         lo = (balances & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         hi = (balances >> np.uint64(32)).astype(np.uint32)
-        self.bal_lo = jax.device_put(self._pad_col(lo, np.uint32))
-        self.bal_hi = jax.device_put(self._pad_col(hi, np.uint32))
-        self.scores = jax.device_put(self._pad_col(scores, np.int32))
-        self.part_prev = jax.device_put(self._pad_col(part_prev, np.int32))
-        self.part_cur = jax.device_put(self._pad_col(part_cur, np.int32))
+        self.bal_lo = self._put("resident/bal_lo", self._pad_col(lo, np.uint32))
+        self.bal_hi = self._put("resident/bal_hi", self._pad_col(hi, np.uint32))
+        self.scores = self._put("resident/scores", self._pad_col(scores, np.int32))
+        self.part_prev = self._put(
+            "resident/part_prev", self._pad_col(part_prev, np.int32)
+        )
+        self.part_cur = self._put(
+            "resident/part_cur", self._pad_col(part_cur, np.int32)
+        )
 
     def _scatter_idx(self, idx: np.ndarray) -> np.ndarray:
         """Pad a scatter index vector to the smallest warmed bucket by
@@ -361,6 +543,117 @@ class ResidentEpochPlane:
         out = np.full(bucket, idx[0], np.int32)
         out[:k] = idx
         return out
+
+    # ------------------------------------------- sharded delta routing
+
+    def _shard_rows(self, idx: np.ndarray, vals: list) -> tuple:
+        """Route a global scatter (``idx`` global indices, ``vals``
+        arrays aligned with them) to per-shard rows for the sharded
+        scatter kernels: every index lands on its OWNING shard's row
+        (owner = global // local_block under the block split), local-
+        indexed.  Ragged tails pad by repeating the shard's first entry
+        (duplicate identical writes are no-ops); a shard with no touched
+        indices pads with ``own=False`` slots the kernel turns into
+        identity read-back writes.  Row width snaps to the warmed
+        ``transition_scatter`` buckets."""
+        d = self.n_shards
+        local = self.capacity // d
+        owner = idx // local
+        li = (idx % local).astype(np.int32)
+        counts = np.bincount(owner, minlength=d)
+        kmax = int(counts.max())
+        bucket = next(
+            (b for b in _scatter_buckets(self.capacity) if b >= kmax),
+            _pad_pow2(kmax),
+        )
+        idx_rows = np.zeros((d, bucket), np.int32)
+        own_rows = np.zeros((d, bucket), np.bool_)
+        val_rows = [np.zeros((d, bucket), v.dtype) for v in vals]
+        for s in range(d):
+            sel = np.nonzero(owner == s)[0]
+            c = sel.size
+            if not c:
+                continue
+            idx_rows[s, :c] = li[sel]
+            idx_rows[s, c:] = li[sel][0]
+            own_rows[s] = True
+            for vr, v in zip(val_rows, vals):
+                vr[s, :c] = v[sel]
+                vr[s, c:] = v[sel][0]
+        return idx_rows, val_rows, own_rows
+
+    def _gather_rows(self, idx: np.ndarray) -> tuple:
+        """Per-shard rows for the psum gather: bucket slot ``j`` carries
+        global index ``idx[j]`` on its owning shard's row ONLY (every
+        other shard contributes a masked zero), so the psum reassembles
+        the gathered vector replicated on every device."""
+        k = idx.size
+        local = self.capacity // self.n_shards
+        owner = idx // local
+        li = (idx % local).astype(np.int32)
+        bucket = next(
+            (b for b in _scatter_buckets(self.capacity) if b >= k),
+            _pad_pow2(k),
+        )
+        idx_rows = np.zeros((self.n_shards, bucket), np.int32)
+        own_rows = np.zeros((self.n_shards, bucket), np.bool_)
+        idx_rows[owner, np.arange(k)] = li
+        own_rows[owner, np.arange(k)] = True
+        return idx_rows, own_rows
+
+    _STAMP_FIELDS = (
+        "balances", "inactivity_scores",
+        "previous_epoch_participation", "current_epoch_participation",
+    )
+
+    def _stamp_deltas(self, state) -> None:
+        """Record the exact TrackedList instances the mirrors now match
+        (and their generations): the next sync narrows its mirror
+        compare to the indices mutated since, instead of diffing the
+        full column — the shard-aware delta-routing feed.  A list that
+        is not a TrackedList (or was replaced wholesale) stamps None
+        and the next compare is full, which is always exact."""
+        for field in self._STAMP_FIELDS:
+            lst = getattr(state, field, None)
+            gen = getattr(lst, "gen", None)
+            self._stamps[field] = None if gen is None else (lst, gen)
+
+    def _changed_idx(self, field: str, state, mirror: np.ndarray,
+                     new: np.ndarray) -> np.ndarray:
+        """Indices where the device column is stale.  The delta-chain
+        stamp narrows the compare to a provable superset of the touched
+        indices (mutable.dirty_superset); candidates are still value-
+        compared against the mirror, so the result is exact either way."""
+        hint = None
+        st = self._stamps.get(field)
+        lst = getattr(state, field, None)
+        if st is not None and lst is not None and mirror.shape[0] == new.shape[0]:
+            from .mutable import dirty_superset
+
+            hint = dirty_superset(lst, st[0], st[1])
+        if hint is None:
+            return np.nonzero(mirror != new)[0]
+        n = new.shape[0]
+        cand = np.fromiter((i for i in hint if 0 <= i < n), np.int64)
+        if cand.size == 0:
+            return cand
+        cand.sort()
+        return cand[mirror[cand] != new[cand]]
+
+    def _scatter1_col(self, col2: str, changed: np.ndarray,
+                      new: np.ndarray) -> None:
+        """One int32 column delta scatter, routed per-shard when the
+        plane is sharded, through the warmed flat buckets otherwise."""
+        k = self._kset()
+        buf = getattr(self, col2)
+        if self.sharded:
+            idx_rows, (vals,), own = self._shard_rows(
+                changed, [new[changed].astype(np.int32)]
+            )
+            setattr(self, col2, k["scatter1"](buf, idx_rows, vals, own))
+        else:
+            idx = self._scatter_idx(changed.astype(np.int32))
+            setattr(self, col2, k["scatter1"](buf, idx, new[idx].astype(np.int32)))
 
     def sync(self, state, spec: ChainSpec) -> bool:
         """Bring the device columns up to date with ``state``; False when
@@ -386,35 +679,52 @@ class ResidentEpochPlane:
         if self.bal_lo is None or self.n != n:
             self._upload_full(balances, scores, part_prev, part_cur)
         else:
-            k = _kernels()
-            for mirror, new, col2 in (
-                (self.mirror_part_prev, part_prev, "part_prev"),
-                (self.mirror_part_cur, part_cur, "part_cur"),
+            k = self._kset()
+            for field, mirror, new, col2 in (
+                ("previous_epoch_participation",
+                 self.mirror_part_prev, part_prev, "part_prev"),
+                ("current_epoch_participation",
+                 self.mirror_part_cur, part_cur, "part_cur"),
             ):
-                changed = np.nonzero(mirror != new)[0]
+                changed = self._changed_idx(field, state, mirror, new)
                 if changed.size == 0:
                     continue
                 if changed.size > n // 4:
-                    import jax
-
-                    setattr(self, col2, jax.device_put(self._pad_col(new, np.int32)))
-                else:
-                    idx = self._scatter_idx(changed.astype(np.int32))
-                    vals = new[idx].astype(np.int32)
                     setattr(
                         self, col2,
-                        k["scatter1"](getattr(self, col2), idx, vals),
+                        self._put(
+                            f"resident/{col2}", self._pad_col(new, np.int32)
+                        ),
                     )
+                else:
+                    self._scatter1_col(col2, changed, new)
                     self.stats["scatter_elems"] += int(changed.size)
-            changed = np.nonzero(self.mirror_bal != balances)[0]
+            changed = self._changed_idx(
+                "balances", state, self.mirror_bal, balances
+            )
             if changed.size:
                 if changed.size > n // 4:
-                    import jax
-
                     lo = (balances & np.uint64(0xFFFFFFFF)).astype(np.uint32)
                     hi = (balances >> np.uint64(32)).astype(np.uint32)
-                    self.bal_lo = jax.device_put(self._pad_col(lo, np.uint32))
-                    self.bal_hi = jax.device_put(self._pad_col(hi, np.uint32))
+                    self.bal_lo = self._put(
+                        "resident/bal_lo", self._pad_col(lo, np.uint32)
+                    )
+                    self.bal_hi = self._put(
+                        "resident/bal_hi", self._pad_col(hi, np.uint32)
+                    )
+                elif self.sharded:
+                    v = balances[changed]
+                    idx_rows, (vlo, vhi), own = self._shard_rows(
+                        changed,
+                        [
+                            (v & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                            (v >> np.uint64(32)).astype(np.uint32),
+                        ],
+                    )
+                    self.bal_lo, self.bal_hi = k["scatter2"](
+                        self.bal_lo, self.bal_hi, idx_rows, vlo, vhi, own
+                    )
+                    self.stats["scatter_elems"] += int(changed.size)
                 else:
                     idx = self._scatter_idx(changed.astype(np.int32))
                     v = balances[idx]
@@ -424,28 +734,26 @@ class ResidentEpochPlane:
                         (v >> np.uint64(32)).astype(np.uint32),
                     )
                     self.stats["scatter_elems"] += int(changed.size)
-            changed = np.nonzero(self.mirror_scores != scores)[0]
+            changed = self._changed_idx(
+                "inactivity_scores", state, self.mirror_scores, scores
+            )
             if changed.size:
                 if changed.size > n // 4:
                     # wholesale change (a host-fallback leak epoch moved
                     # every score): full upload, like the other columns —
                     # a full-size scatter would pad past the warmed
                     # buckets and live-compile a donated kernel
-                    import jax
-
-                    self.scores = jax.device_put(
-                        self._pad_col(scores, np.int32)
+                    self.scores = self._put(
+                        "resident/scores", self._pad_col(scores, np.int32)
                     )
                 else:
-                    idx = self._scatter_idx(changed.astype(np.int32))
-                    self.scores = k["scatter1"](
-                        self.scores, idx, scores[idx].astype(np.int32)
-                    )
+                    self._scatter1_col("scores", changed, scores)
         self.n = n
         self.mirror_bal = balances.copy()
         self.mirror_scores = scores.copy()
         self.mirror_part_prev = part_prev.copy()
         self.mirror_part_cur = part_cur.copy()
+        self._stamp_deltas(state)
         set_gauge("resident_plane_validators", n)
         return True
 
@@ -465,7 +773,7 @@ class ResidentEpochPlane:
 
     def epoch_sums(self, efb_incr, active_prev, active_cur, slashed):
         """[total_active, flag0, flag1, flag2, curr_target] increment sums."""
-        k = _kernels()
+        k = self._kset()
         out = k["sums"](
             self._pad_col(efb_incr, np.int32),
             self.part_prev,
@@ -479,7 +787,7 @@ class ResidentEpochPlane:
     def sweep(self, efb_incr, eligible, active_prev, slashed, params, luts):
         """Dispatch the donated rewards/inactivity sweep; the plane's
         balance/score buffers are replaced by the in-place outputs."""
-        k = _kernels()
+        k = self._kset()
         self.bal_lo, self.bal_hi, self.scores = k["sweep"](
             self.bal_lo, self.bal_hi, self.scores,
             self._pad_col(efb_incr, np.int32),
@@ -496,25 +804,48 @@ class ResidentEpochPlane:
                     adjusted_total: int, total_balance: int, increment: int) -> None:
         """Exact per-target slashing penalties: gather the (rare) target
         balances, do the >64-bit arithmetic in host ints, scatter back."""
-        k = _kernels()
-        idx = self._scatter_idx(targets.astype(np.int32))
-        g_lo, g_hi = k["gather2"](self.bal_lo, self.bal_hi, idx)
+        k = self._kset()
+        if self.sharded:
+            idx = targets.astype(np.int64)
+            g_rows, g_own = self._gather_rows(idx)
+            g_lo, g_hi = k["gather2"](self.bal_lo, self.bal_hi, g_rows, g_own)
+        else:
+            idx = self._scatter_idx(targets.astype(np.int32))
+            g_lo, g_hi = k["gather2"](self.bal_lo, self.bal_hi, idx)
         bal = np.asarray(g_lo).astype(np.uint64) | (
             np.asarray(g_hi).astype(np.uint64) << np.uint64(32)
         )
         new = bal.copy()
+        # in the sharded case idx is exactly the kt targets and bal's
+        # padded tail stays untouched (masked zero gather slots); in the
+        # flat case idx is bucket-padded by repeating idx[0], so padded
+        # slots recompute the identical penalty (duplicate same-value
+        # writes stay deterministic)
         for j, i in enumerate(idx):
             pen_num = int(efb_incr[i]) * adjusted_total
             penalty = pen_num // total_balance * increment
             new[j] = max(0, int(bal[j]) - penalty)
-        self.bal_lo, self.bal_hi = k["scatter2"](
-            self.bal_lo, self.bal_hi, idx,
-            (new & np.uint64(0xFFFFFFFF)).astype(np.uint32),
-            (new >> np.uint64(32)).astype(np.uint32),
-        )
+        if self.sharded:
+            kt = targets.size
+            idx_rows, (vlo, vhi), own = self._shard_rows(
+                idx,
+                [
+                    (new[:kt] & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                    (new[:kt] >> np.uint64(32)).astype(np.uint32),
+                ],
+            )
+            self.bal_lo, self.bal_hi = k["scatter2"](
+                self.bal_lo, self.bal_hi, idx_rows, vlo, vhi, own
+            )
+        else:
+            self.bal_lo, self.bal_hi = k["scatter2"](
+                self.bal_lo, self.bal_hi, idx,
+                (new & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                (new >> np.uint64(32)).astype(np.uint32),
+            )
 
     def hysteresis_mask(self, efb_incr, downward, upward, increment) -> np.ndarray:
-        k = _kernels()
+        k = self._kset()
         mask = k["hysteresis"](
             self.bal_lo, self.bal_hi,
             self._pad_col(efb_incr, np.int32),
@@ -535,11 +866,19 @@ class ResidentEpochPlane:
 
     def rotate_participation(self) -> None:
         """Device-side mirror of the epoch participation reset: previous
-        adopts current's buffer, current becomes zeros (no upload)."""
-        import jax.numpy as jnp
-
+        adopts current's buffer, current becomes zeros (no upload).  The
+        handed-over buffer keeps its layout, so the fresh zeros column
+        must be PLACED in the rule-table layout too — a replicated
+        current column would silently double the per-device footprint."""
         self.part_prev = self.part_cur
-        self.part_cur = jnp.zeros(self.capacity, jnp.int32)
+        if self.sharded:
+            self.part_cur = self._put(
+                "resident/part_cur", np.zeros(self.capacity, np.int32)
+            )
+        else:
+            import jax.numpy as jnp
+
+            self.part_cur = jnp.zeros(self.capacity, jnp.int32)
         self.mirror_part_prev = self.mirror_part_cur
         self.mirror_part_cur = np.zeros(self.n, np.uint8)
 
@@ -755,6 +1094,9 @@ def process_epoch_resident(state, plane: ResidentEpochPlane,
     process_participation_flag_updates(state, spec)
     plane.rotate_participation()
     process_sync_committee_updates(state, spec)
+    # mirrors now match the post-epoch lists again: re-stamp so the NEXT
+    # boundary's sync narrows its compare to the block deltas in between
+    plane._stamp_deltas(state)
     set_gauge("resident_plane_sync_elems", plane.stats["scatter_elems"])
     return True
 
@@ -771,7 +1113,16 @@ def warm_transition_programs(n_validators: int) -> float:
 
     t0 = time.perf_counter()
     cap = _pad_pow2(n_validators)
-    k = _kernels()
+    # mirror ResidentEpochPlane's construction-time sharding decision so
+    # the warmer compiles the kernel set the plane will actually dispatch
+    sharded, mesh, nsh = False, None, 1
+    if state_shard_enabled():
+        from ..ops.mesh import default_mesh, mesh_devices
+
+        mesh = default_mesh()
+        nsh = mesh_devices(mesh)
+        sharded = nsh > 1 and cap % nsh == 0
+    k = _build_sharded_kernels(mesh) if sharded else _kernels()
     zb = np.zeros(cap, np.bool_)
     zi = np.zeros(cap, np.int32)
     # distinct buffers for the donated positions: numpy inputs are copied
@@ -791,11 +1142,19 @@ def warm_transition_programs(n_validators: int) -> float:
         # kernels have no disk tier, so an unwarmed bucket would compile
         # live inside the first epoch boundary
         for b in _scatter_buckets(cap):
-            idx = np.zeros(b, np.int32)
-            lo, hi = k["scatter2"](lo, hi, idx, idx.astype(np.uint32),
-                                   idx.astype(np.uint32))
-            np.asarray(k["scatter1"](np.zeros(cap, np.int32), idx, idx))
-            np.asarray(k["gather2"](lo, hi, idx)[0])
+            if sharded:
+                idx = np.zeros((nsh, b), np.int32)
+                own = np.zeros((nsh, b), np.bool_)
+                u = idx.astype(np.uint32)
+                lo, hi = k["scatter2"](lo, hi, idx, u, u, own)
+                np.asarray(k["scatter1"](np.zeros(cap, np.int32), idx, idx, own))
+                np.asarray(k["gather2"](lo, hi, idx, own)[0])
+            else:
+                idx = np.zeros(b, np.int32)
+                lo, hi = k["scatter2"](lo, hi, idx, idx.astype(np.uint32),
+                                       idx.astype(np.uint32))
+                np.asarray(k["scatter1"](np.zeros(cap, np.int32), idx, idx))
+                np.asarray(k["gather2"](lo, hi, idx)[0])
     register_shape_bucket("transition_validators", cap)
     for b in _scatter_buckets(cap):
         register_shape_bucket("transition_scatter", b)

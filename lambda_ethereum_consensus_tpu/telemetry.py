@@ -96,7 +96,7 @@ _HELP = {
     "ssz_hash_tree_root_seconds": "top-level SSZ Merkleization root",
     "sidecar_roundtrip_seconds": "one sidecar command round-trip",
     "device_live_arrays": "live device arrays (jax.live_arrays)",
-    "device_plane_bytes": "retained bytes per accounted memory plane (unattributed = jax.live_arrays() total minus the live-array planes; host/executable planes report outside that arithmetic)",
+    "device_plane_bytes": "retained PER-DEVICE bytes per accounted memory plane (sharded=1 planes divide their logical total by the live mesh spread; unattributed = jax.live_arrays() total minus the live-array planes; host/executable planes report outside that arithmetic)",
     "device_plane_bytes_watermark": "high watermark of total live device bytes",
     "ops_entry_flops_total": "HLO-estimated FLOPs dispatched per AOT entry point",
     "ops_entry_bytes_total": "HLO-estimated bytes accessed per AOT entry point",

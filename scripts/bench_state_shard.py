@@ -1,0 +1,358 @@
+"""Mesh-sharded state residency micro-bench (round 21 tentpole).
+
+Drives the FULL sharded epoch kernel sequence — delta scatter routed to
+owning shards, psum'd increment sums, the donated rewards/inactivity
+sweep, exact slashing gather/scatter, the hysteresis mask and the
+participation rotation — over synthetic per-validator columns at each
+``--validators`` size on an ``--devices``-way mesh, and certifies two
+things before it prints a single throughput number:
+
+1. **Bit-exactness.** Every epoch's device sums are checked against an
+   exact numpy oracle, and the whole sequence runs a second time through
+   the single-device kernel path (the flat kernels tier-1 pins against
+   the host transition oracle) on identical inputs; final balances,
+   scores and both participation planes must match bit-for-bit.
+2. **Residency split.** The sharded columns must actually be spread over
+   all ``--devices`` devices (read from the live buffer sharding, not
+   the construction-time intent), so the per-device footprint figure is
+   ``logical_bytes / devices``, never a relabeled replicated total.
+
+Emits one JSON line per metric (bench.py's guarded-subprocess contract):
+
+    sharded_epoch_validators_per_sec   validators processed per second
+                                       through the sharded epoch
+                                       sequence at the LARGEST size,
+                                       with the per-size rates alongside
+    sharded_state_bytes_per_device     per-device resident column bytes
+                                       at the largest size, with the
+                                       single-device footprint and the
+                                       fraction (must be 1/devices)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.config import get_chain_spec  # noqa: E402
+from lambda_ethereum_consensus_tpu.state_transition import resident as RES  # noqa: E402
+
+_LO = np.uint64(0xFFFFFFFF)
+
+
+def _make_plane(n: int, sharded: bool) -> RES.ResidentEpochPlane:
+    """Construct a plane with the sharding decision forced either way
+    (the decision is read from the env ONCE, at construction)."""
+    env = os.environ
+    old = {k: env.get(k) for k in ("GRAFT_STATE_SHARD", "GRAFT_STATE_NO_SHARD")}
+    try:
+        if sharded:
+            env["GRAFT_STATE_SHARD"] = "1"
+            env.pop("GRAFT_STATE_NO_SHARD", None)
+        else:
+            env["GRAFT_STATE_NO_SHARD"] = "1"
+        return RES.ResidentEpochPlane(n)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
+
+
+def _columns(n: int, seed: int):
+    """Synthetic per-validator state columns: balances near 32 ETH with
+    jitter (still < 2^63), modest inactivity scores, participation flag
+    bytes, and registry-shaped masks with a sprinkle of slashed/inactive
+    validators so every kernel branch sees both polarities."""
+    rng = np.random.default_rng(seed)
+    spec = get_chain_spec()
+    incr = np.uint64(spec.EFFECTIVE_BALANCE_INCREMENT)
+    efb_incr = rng.integers(1, 33, n).astype(np.int32)
+    bal = efb_incr.astype(np.uint64) * incr + rng.integers(
+        0, int(incr), n
+    ).astype(np.uint64)
+    scores = rng.integers(0, 1 << 20, n).astype(np.int64)
+    part_prev = rng.integers(0, 8, n).astype(np.uint8)
+    part_cur = rng.integers(0, 8, n).astype(np.uint8)
+    active_prev = rng.random(n) < 0.98
+    active_cur = rng.random(n) < 0.98
+    slashed = rng.random(n) < 0.002
+    eligible = active_prev | slashed
+    return {
+        "efb_incr": efb_incr, "bal": bal, "scores": scores,
+        "part_prev": part_prev, "part_cur": part_cur,
+        "active_prev": active_prev, "active_cur": active_cur,
+        "slashed": slashed, "eligible": eligible,
+    }
+
+
+def _epoch_inputs(n: int, epochs: int, seed: int):
+    """Pre-generated per-epoch deltas, identical for both planes: block
+    balance deltas (<= the small warmed scatter bucket), fresh
+    participation bits for the rotated current plane, and a handful of
+    slashing targets."""
+    rng = np.random.default_rng(seed + 1)
+    k = int(min(1024, max(1, n // 8)))
+    out = []
+    for _ in range(epochs):
+        out.append({
+            "bal_idx": np.sort(rng.choice(n, k, replace=False)).astype(np.int64),
+            "bal_add": rng.integers(1, 1 << 20, k).astype(np.uint64),
+            "part_idx": np.sort(rng.choice(n, k, replace=False)).astype(np.int64),
+            "part_val": rng.integers(1, 8, k).astype(np.uint8),
+            "slash_idx": np.sort(
+                rng.choice(n, 64, replace=False)
+            ).astype(np.int64),
+        })
+    return out
+
+
+def _upload(plane: RES.ResidentEpochPlane, cols: dict) -> None:
+    n = cols["bal"].shape[0]
+    plane.n = n
+    plane._upload_full(
+        cols["bal"], cols["scores"], cols["part_prev"], cols["part_cur"]
+    )
+    plane.mirror_bal = cols["bal"].copy()
+    plane.mirror_scores = cols["scores"].copy()
+    plane.mirror_part_prev = cols["part_prev"].copy()
+    plane.mirror_part_cur = cols["part_cur"].copy()
+
+
+def _scatter_balances(plane, kset, idx: np.ndarray, bal_full: np.ndarray):
+    """sync()'s balance-delta branch, lifted: route ``idx`` to the
+    owning shards (sharded) or the warmed flat bucket (oracle)."""
+    if plane.sharded:
+        v = bal_full[idx]
+        idx_rows, (vlo, vhi), own = plane._shard_rows(
+            idx,
+            [(v & _LO).astype(np.uint32),
+             (v >> np.uint64(32)).astype(np.uint32)],
+        )
+        plane.bal_lo, plane.bal_hi = kset["scatter2"](
+            plane.bal_lo, plane.bal_hi, idx_rows, vlo, vhi, own
+        )
+    else:
+        pidx = plane._scatter_idx(idx.astype(np.int32))
+        v = bal_full[pidx]
+        plane.bal_lo, plane.bal_hi = kset["scatter2"](
+            plane.bal_lo, plane.bal_hi, pidx,
+            (v & _LO).astype(np.uint32),
+            (v >> np.uint64(32)).astype(np.uint32),
+        )
+
+
+def _reward_params(spec, sums, n):
+    incr = spec.EFFECTIVE_BALANCE_INCREMENT
+    total_active = max(incr, sums[0] * incr)
+    brpi = incr * spec.BASE_REWARD_FACTOR // RES.integer_squareroot(total_active)
+    flag_incr = [max(incr, sums[1 + f] * incr) // incr for f in range(3)]
+    luts = RES._reward_tables(spec, brpi, False, total_active // incr, flag_incr)
+    if luts is None:
+        raise RuntimeError("reward tables overflow the single-limb bound")
+    mult, shift = RES._inactivity_factors(spec)
+    params = [
+        0, 1, 1,
+        spec.INACTIVITY_SCORE_BIAS, spec.INACTIVITY_SCORE_RECOVERY_RATE,
+        mult, shift,
+    ]
+    return params, luts, total_active
+
+
+def _run_epochs(plane, cols, epoch_inputs, spec):
+    """The epoch sequence against one plane; returns the per-epoch sums
+    and hysteresis popcounts (the cheap cross-plane invariants) plus the
+    final host-read columns."""
+    n = cols["bal"].shape[0]
+    incr = spec.EFFECTIVE_BALANCE_INCREMENT
+    bal_host = cols["bal"].copy()  # only for delta values fed to scatter
+    sums_log, mask_log = [], []
+    kset = plane._kset()
+    for ep in epoch_inputs:
+        # (0) block deltas since the last boundary: balances + current
+        # participation, routed per-shard / through the flat bucket
+        np.add.at(bal_host, ep["bal_idx"], ep["bal_add"])
+        _scatter_balances(plane, kset, ep["bal_idx"], bal_host)
+        part_full = np.zeros(n, np.uint8)
+        part_full[ep["part_idx"]] = ep["part_val"]
+        plane._scatter1_col("part_cur", ep["part_idx"], part_full)
+        # (1) increment sums (the one psum in the sharded path)
+        sums = plane.epoch_sums(
+            cols["efb_incr"], cols["active_prev"],
+            cols["active_cur"], cols["slashed"],
+        )
+        sums_log.append(sums)
+        params, luts, total_active = _reward_params(spec, sums, n)
+        # (2) donated rewards/inactivity sweep
+        plane.sweep(
+            cols["efb_incr"], cols["eligible"], cols["active_prev"],
+            cols["slashed"], params, luts,
+        )
+        # (3) exact slashing penalties: gather / host ints / scatter
+        plane.slash_fixup(
+            ep["slash_idx"], cols["efb_incr"],
+            total_active // 2, total_active, incr,
+        )
+        # (4) hysteresis mask
+        mask = plane.hysteresis_mask(
+            cols["efb_incr"],
+            incr // spec.HYSTERESIS_QUOTIENT * spec.HYSTERESIS_DOWNWARD_MULTIPLIER,
+            incr // spec.HYSTERESIS_QUOTIENT * spec.HYSTERESIS_UPWARD_MULTIPLIER,
+            incr,
+        )
+        mask_log.append(int(mask.sum()))
+        # (5) participation rotation (device-side, no upload)
+        plane.rotate_participation()
+    return {
+        "sums": sums_log,
+        "mask_pop": mask_log,
+        "bal": plane.balances_to_host(),
+        "scores": plane.scores_to_host(),
+        "part_prev": np.asarray(plane.part_prev)[:n],
+        "part_cur": np.asarray(plane.part_cur)[:n],
+    }
+
+
+def _oracle_sums(cols, ep0) -> list[int]:
+    """Exact numpy mirror of the sums kernel body, over the columns as
+    the FIRST epoch sees them (its block deltas land before the sums)."""
+    pc = cols["part_cur"].copy()
+    pc[ep0["part_idx"]] = ep0["part_val"]
+    efb, pp = cols["efb_incr"], cols["part_prev"]
+    unsl_prev = cols["active_prev"] & ~cols["slashed"]
+    unsl_cur = cols["active_cur"] & ~cols["slashed"]
+
+    def msum(mask):
+        return int(efb[mask].sum())
+
+    return [
+        msum(cols["active_cur"]),
+        msum(unsl_prev & ((pp & 1) != 0)),
+        msum(unsl_prev & ((pp & 2) != 0)),
+        msum(unsl_prev & ((pp & 4) != 0)),
+        msum(unsl_cur & ((pc & 2) != 0)),
+    ]
+
+
+def _bench_size(n: int, epochs: int, devices: int, seed: int) -> dict:
+    spec = get_chain_spec()
+    cols = _columns(n, seed)
+    epoch_inputs = _epoch_inputs(n, epochs, seed)
+
+    plane = _make_plane(n, sharded=True)
+    if not plane.sharded or plane.n_shards != devices:
+        print(
+            f"bench_state_shard: no {devices}-way mesh to shard over "
+            f"(got {plane.n_shards} shard(s)) — run under a multi-device "
+            "backend or bench.py's virtual-CPU fallback",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    _upload(plane, cols)
+    # warm epoch (untimed): compiles every sharded program at this shape
+    _run_epochs(plane, cols, epoch_inputs[:1], spec)
+    _upload(plane, cols)
+    t0 = time.perf_counter()
+    got = _run_epochs(plane, cols, epoch_inputs, spec)
+    elapsed = time.perf_counter() - t0
+
+    spread = plane.shard_devices()
+    logical = plane.device_bytes
+    # sums oracle: first epoch's participation planes are the synthetic
+    # originals (rotation + scatter perturb the later ones — those are
+    # covered by the flat-path comparison below)
+    if got["sums"][0] != _oracle_sums(cols, epoch_inputs[0]):
+        print("bench_state_shard: sharded sums diverge from the numpy "
+              f"oracle at n={n}", file=sys.stderr)
+        raise SystemExit(3)
+
+    # the single-device kernel path on identical inputs — tier-1 pins
+    # these kernels bit-exact against the host transition oracle
+    flat = _make_plane(n, sharded=False)
+    _upload(flat, cols)
+    _run_epochs(flat, cols, epoch_inputs[:1], spec)
+    _upload(flat, cols)
+    want = _run_epochs(flat, cols, epoch_inputs, spec)
+    flat_bytes = flat.device_bytes
+    for key in ("bal", "scores", "part_prev", "part_cur"):
+        if not np.array_equal(got[key], want[key]):
+            bad = int(np.count_nonzero(got[key] != want[key]))
+            print(
+                f"bench_state_shard: sharded {key} diverges from the "
+                f"single-device path at n={n} ({bad} element(s))",
+                file=sys.stderr,
+            )
+            raise SystemExit(3)
+    if got["sums"] != want["sums"] or got["mask_pop"] != want["mask_pop"]:
+        print(
+            f"bench_state_shard: per-epoch sums/hysteresis diverge at n={n}",
+            file=sys.stderr,
+        )
+        raise SystemExit(3)
+
+    return {
+        "validators": n,
+        "epochs": epochs,
+        "elapsed_s": elapsed,
+        "validators_per_sec": n * epochs / elapsed,
+        "devices": spread,
+        "logical_bytes": logical,
+        "bytes_per_device": logical / spread,
+        "single_device_bytes": flat_bytes,
+        "bit_exact": True,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", default="1000000,10000000",
+                    help="comma-separated registry sizes")
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--devices", type=int, default=8)
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.validators.split(",") if s]
+
+    results = [
+        _bench_size(n, args.epochs, args.devices, seed=0xE7A + i)
+        for i, n in enumerate(sizes)
+    ]
+    head = max(results, key=lambda r: r["validators"])
+    print(json.dumps({
+        "metric": "sharded_epoch_validators_per_sec",
+        "value": head["validators_per_sec"],
+        "unit": "validators/s",
+        "validators": head["validators"],
+        "epochs": head["epochs"],
+        "devices": head["devices"],
+        "bit_exact": all(r["bit_exact"] for r in results),
+        "by_size": {
+            str(r["validators"]): round(r["validators_per_sec"], 1)
+            for r in results
+        },
+    }), flush=True)
+    print(json.dumps({
+        "metric": "sharded_state_bytes_per_device",
+        "value": head["bytes_per_device"],
+        "unit": "bytes",
+        "validators": head["validators"],
+        "devices": head["devices"],
+        "logical_bytes": head["logical_bytes"],
+        "single_device_bytes": head["single_device_bytes"],
+        "frac_of_single_device":
+            head["bytes_per_device"] / head["single_device_bytes"],
+        "by_size": {
+            str(r["validators"]): r["bytes_per_device"] for r in results
+        },
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

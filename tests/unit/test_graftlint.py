@@ -1290,6 +1290,124 @@ def test_durable_rename_scoped_to_store_paths(tmp_path):
     assert findings == []
 
 
+# -------------------------------------------------------------- shard-rules
+
+
+_RULE_TABLE = """
+PARTITION_RULES = (
+    (r"^resident/(bal|scores)$", ("dp",)),
+    (r"^registry/r[xy]$", (None, "dp")),
+)
+"""
+
+
+def test_shard_rules_fires_on_unlegislated_plane(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "ops/shard_rules.py": _RULE_TABLE,
+            "ops/user.py": """
+            from .shard_rules import place
+
+            def upload(arr):
+                place("registry/rx", arr)
+                place("registry/ry", arr)
+                place("resident/bal", arr)
+                place("resident/scores", arr)
+                return place("witness/rows", arr)
+            """,
+        },
+        rules=["shard-rules"],
+    )
+    assert len(findings) == 1
+    assert "witness/rows" in findings[0].message
+    assert "matches no" in findings[0].message
+
+
+def test_shard_rules_fires_on_ambiguous_table(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "ops/shard_rules.py": """
+            PARTITION_RULES = (
+                (r"^resident/bal$", ("dp",)),
+                (r"resident/.*", ("dp",)),
+            )
+            """,
+            "ops/user.py": """
+            from .shard_rules import place
+
+            def upload(arr):
+                return place("resident/bal", arr)
+            """,
+        },
+        rules=["shard-rules"],
+    )
+    assert any("ambiguous" in f.message for f in findings)
+
+
+def test_shard_rules_fires_on_dead_rule(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "ops/shard_rules.py": _RULE_TABLE,
+            "ops/user.py": """
+            from .shard_rules import place
+
+            def upload(arr):
+                place("resident/bal", arr)
+                return place("resident/scores", arr)
+            """,
+        },
+        rules=["shard-rules"],
+    )
+    assert len(findings) == 1
+    assert "dead" in findings[0].message
+    assert "registry/r[xy]" in findings[0].message
+
+
+def test_shard_rules_passes_when_table_and_sites_agree(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "ops/shard_rules.py": _RULE_TABLE,
+            "ops/user.py": """
+            from .shard_rules import place
+
+            class Plane:
+                def _put(self, name, arr):
+                    return place(name, arr)
+
+                def upload(self, arr, col):
+                    self._put("registry/rx", arr)
+                    self._put("registry/ry", arr)
+                    self._put("resident/scores", arr)
+                    # the f-string prefix credits the resident rule
+                    return self._put(f"resident/{col}", arr)
+            """,
+        },
+        rules=["shard-rules"],
+    )
+    assert findings == []
+
+
+def test_shard_rules_silent_without_a_table(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "ops/user.py": """
+            def place(name, arr):
+                return arr
+
+            def upload(arr):
+                return place("anything/atall", arr)
+            """,
+        },
+        rules=["shard-rules"],
+    )
+    assert findings == []
+
+
 def test_list_rules_names_six_active_rules(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
@@ -1300,13 +1418,14 @@ def test_list_rules_names_six_active_rules(capsys):
         "exception-containment",
         "retrace-hazard",
         "metric-contract",
+        "shard-rules",
     ):
         assert name in out
 
 
 def test_repo_lints_clean():
     """The whole package (and the Grafana dashboards) must stay clean
-    under all six rules with the checked-in (empty) baseline — real
+    under all seven rules with the checked-in (empty) baseline — real
     defects get fixed, intended patterns get inline suppressions."""
     rc = cli_main(
         [

@@ -1,0 +1,199 @@
+"""Mesh-sharded state residency (round 21).
+
+The resident epoch columns become mesh-sharded device arrays placed by
+the declarative partition-rule table (ops/shard_rules.py), the epoch
+sweeps become collective-free shard_map kernels (one psum for the sums)
+and delta scatters route each touched index to its owning shard.  These
+tests pin the three contracts that make that safe:
+
+1. **Bit-exactness** — a multi-epoch attested replay through the
+   sharded plane reproduces the host-minted state roots block by block
+   (with justification actually moving, so the psum'd sums are
+   load-bearing).
+2. **Ownership routing** — ``_shard_rows`` puts every touched index on
+   its owning shard's row at the right local offset, own-masks the
+   padding, and snaps row widths to the warmed scatter buckets.
+3. **Fallback coherence** — a representability guard tripping for a
+   validator on ONE shard must route the WHOLE epoch to the host path
+   (no half-sharded epoch), still bit-exact.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from lambda_ethereum_consensus_tpu.config import use_chain_spec
+from lambda_ethereum_consensus_tpu.ops import shard_rules
+from lambda_ethereum_consensus_tpu.ops.mesh import state_shard_enabled
+from lambda_ethereum_consensus_tpu.state_transition.core import state_transition
+from lambda_ethereum_consensus_tpu.state_transition.mutable import BeaconStateMut
+from lambda_ethereum_consensus_tpu.state_transition.resident import (
+    ResidentEpochPlane,
+    _scatter_buckets,
+)
+from tests.unit.test_resident_transition import (  # noqa: F401 (fixtures)
+    _mint_attested_chain,
+    _oracle_root,
+    _walk,
+    genesis,
+    spec,
+)
+
+
+def _require_mesh(n=8):
+    if jax.device_count() < n:
+        pytest.skip(f"needs the {n}-device CPU mesh (conftest)")
+
+
+# ------------------------------------------------------------- polarity
+
+
+def test_state_shard_env_precedence(monkeypatch):
+    monkeypatch.setenv("GRAFT_STATE_NO_SHARD", "1")
+    monkeypatch.setenv("GRAFT_STATE_SHARD", "1")
+    assert not state_shard_enabled()  # kill-switch wins over force
+    monkeypatch.delenv("GRAFT_STATE_NO_SHARD")
+    assert state_shard_enabled()
+    monkeypatch.delenv("GRAFT_STATE_SHARD")
+    # default: multi-device TPU only — the virtual CPU mesh (conftest)
+    # must not flip state placement on its own
+    assert not state_shard_enabled()
+
+
+# ------------------------------------------------------------ rule table
+
+
+def test_rule_table_legislates_every_state_plane():
+    assert shard_rules.match_partition_rule("resident/bal_lo") == ("dp",)
+    assert shard_rules.match_partition_rule("resident/part_cur") == ("dp",)
+    assert shard_rules.match_partition_rule("registry/rx") == (None, "dp")
+    assert shard_rules.match_partition_rule("ssz/chunk_rows") == ("dp", None)
+    assert shard_rules.sharded_axis((None, "dp")) == 1
+    assert shard_rules.sharded_axis(("dp",)) == 0
+
+
+def test_rule_table_rejects_unlegislated_and_ambiguous(monkeypatch):
+    with pytest.raises(LookupError):
+        shard_rules.match_partition_rule("resident/unheard_of")
+    monkeypatch.setattr(
+        shard_rules, "PARTITION_RULES",
+        ((r"^resident/", ("dp",)), (r"bal_lo$", ("dp",))),
+    )
+    with pytest.raises(ValueError):
+        shard_rules.match_partition_rule("resident/bal_lo")
+
+
+def test_place_falls_back_on_uneven_split():
+    _require_mesh()
+    even = shard_rules.place("resident/bal_lo", np.zeros(16, np.uint32))
+    assert len(even.sharding.device_set) == jax.device_count()
+    odd = shard_rules.place("resident/bal_lo", np.zeros(12, np.uint32))
+    assert len(odd.sharding.device_set) == 1  # honest unsharded fallback
+
+
+# ------------------------------------------------- delta scatter routing
+
+
+def test_shard_rows_routes_to_owning_shards(monkeypatch):
+    """Property test: every touched global index lands on its owning
+    shard's row, local-indexed and own-masked, and replaying the rows as
+    a per-shard scatter reproduces the flat scatter exactly."""
+    _require_mesh()
+    monkeypatch.setenv("GRAFT_STATE_SHARD", "1")
+    plane = ResidentEpochPlane(4096)
+    d, cap = plane.n_shards, plane.capacity
+    assert plane.sharded and d == jax.device_count()
+    local = cap // d
+    rng = np.random.default_rng(21)
+    for k in (1, 7, 100, 1000):
+        idx = np.sort(rng.choice(cap, k, replace=False)).astype(np.int64)
+        vals = rng.integers(0, 1 << 32, k, dtype=np.uint64).astype(np.uint32)
+        idx_rows, (val_rows,), own_rows = plane._shard_rows(idx, [vals])
+        # row width snapped to the smallest warmed bucket that fits the
+        # busiest shard
+        kmax = int(np.bincount(idx // local, minlength=d).max())
+        want_bucket = next(b for b in _scatter_buckets(cap) if b >= kmax)
+        assert idx_rows.shape == (d, want_bucket)
+        # replay the rows: owned slots write, padded slots repeat a
+        # real (identical) write, untouched shards stay all-masked
+        flat = np.zeros(cap, np.uint32)
+        routed = np.zeros(cap, np.uint32)
+        flat[idx] = vals
+        for s in range(d):
+            if not own_rows[s].any():
+                assert not np.isin(np.arange(s * local, (s + 1) * local), idx).any()
+                continue
+            assert own_rows[s].all()  # occupied shards pad with real writes
+            routed[s * local + idx_rows[s]] = val_rows[s]
+        assert np.array_equal(routed, flat)
+
+
+def test_gather_rows_one_owner_per_slot(monkeypatch):
+    _require_mesh()
+    monkeypatch.setenv("GRAFT_STATE_SHARD", "1")
+    plane = ResidentEpochPlane(4096)
+    d, cap = plane.n_shards, plane.capacity
+    local = cap // d
+    idx = np.array([0, 5, local, 2 * local + 3, cap - 1], np.int64)
+    idx_rows, own_rows = plane._gather_rows(idx)
+    # each gather slot is claimed by EXACTLY its owner (the psum then
+    # reassembles the vector from one real contribution per slot)
+    assert own_rows[:, : idx.size].sum(axis=0).tolist() == [1] * idx.size
+    for j, g in enumerate(idx):
+        s = int(g) // local
+        assert own_rows[s, j]
+        assert idx_rows[s, j] == int(g) % local
+
+
+# ----------------------------------------------------- epoch bit-exactness
+
+
+def test_sharded_replay_is_bit_exact_across_epochs(genesis, spec, monkeypatch):
+    """Three epoch boundaries through the SHARDED plane, blocks fully
+    attested so justification moves: every block's state root must match
+    the host-minted one (validate_result) and the final roots agree."""
+    _require_mesh()
+    with use_chain_spec(spec):
+        n_blocks = 3 * spec.SLOTS_PER_EPOCH + 2
+        monkeypatch.setenv("GRAFT_RESIDENT_EPOCH", "0")
+        blocks, host_final = _mint_attested_chain(genesis, spec, n_blocks)
+
+        monkeypatch.setenv("GRAFT_RESIDENT_EPOCH", "1")
+        monkeypatch.setenv("GRAFT_STATE_SHARD", "1")
+        cur = genesis
+        for signed in blocks:
+            cur = state_transition(cur, signed, validate_result=True, spec=spec)
+        plane = getattr(cur, "_resident_plane", None)
+        assert plane is not None and plane.sharded
+        assert plane.shard_devices() == jax.device_count()
+        assert plane.stats["sweeps"] >= 3
+        assert plane.stats["fallbacks"] == 0
+        assert _oracle_root(cur, spec) == _oracle_root(host_final, spec)
+        assert cur.current_justified_checkpoint.epoch >= 1
+
+
+def test_guard_trip_on_one_shard_falls_back_whole(genesis, spec, monkeypatch):
+    """A balance outside the limb bound for ONE validator — owned by the
+    LAST shard under the block split — must refuse the whole sharded
+    sync and run the epoch on the host path, bit-exact (never a
+    half-sharded epoch where 7 shards sweep and one doesn't)."""
+    _require_mesh()
+    with use_chain_spec(spec):
+        ws = BeaconStateMut(genesis)
+        ws._root_engine = None
+        ws._resident_plane = None
+        hot = len(ws.balances) - 1  # capacity == n here: the last shard
+        ws.balances[hot] = 1 << 63
+        staged = ws.freeze()
+        target = spec.SLOTS_PER_EPOCH + 1
+        monkeypatch.setenv("GRAFT_STATE_SHARD", "1")
+        res = _walk(staged, target, spec, True, monkeypatch)
+        plane = res._resident_plane
+        assert plane.sharded  # construction went sharded...
+        assert plane.stats["fallbacks"] >= 1  # ...and the guard refused
+        monkeypatch.delenv("GRAFT_STATE_SHARD")
+        host = _walk(staged, target, spec, False, monkeypatch)
+        assert _oracle_root(res, spec) == _oracle_root(host, spec)

@@ -14,6 +14,7 @@ from .durable_rename import DurableRenameRule
 from .exception_containment import ExceptionContainmentRule
 from .metric_contract import MetricContractRule
 from .retrace_hazard import RetraceHazardRule
+from .shard_rules import ShardRulesRule
 
 ALL_RULES = [
     AsyncBlockingRule,
@@ -22,6 +23,7 @@ ALL_RULES = [
     ExceptionContainmentRule,
     RetraceHazardRule,
     MetricContractRule,
+    ShardRulesRule,
 ]
 
 

@@ -1,0 +1,196 @@
+"""shard-rules: the partition-rule table and its call sites cannot drift.
+
+Round 21 routes every mesh-sharded state plane through ONE declarative
+table (``ops/shard_rules.PARTITION_RULES``: plane-name regex ->
+partition spec) under an exactly-one-rule contract: a placed plane name
+matching zero rules means someone added a plane without legislating its
+layout, matching two means the table is ambiguous and the winner would
+be accidental, and a rule no call site ever exercises is dead
+legislation hiding a rename.  ``match_partition_rule`` raises for the
+first two at runtime — but only on the code path that actually places,
+which on a single-device dev box never runs.  This rule enforces all
+three statically, repo-wide.
+
+Name collection is conservative and literal: the string FIRST argument
+of calls named ``place`` / ``match_partition_rule`` (the table's own
+API), plus wrapper calls named ``_put`` / ``_place`` whose first
+argument looks like a plane name (contains ``/``) — the repo's two
+placement wrappers (``ResidentEpochPlane._put``,
+``RegistryPlaneStore._place``) take the plane name first by contract.
+Dynamic names (f-strings, variables) are out of scope for the dead-rule
+check but still covered at runtime by ``match_partition_rule``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Project
+
+# the table's own API names, and the repo's placement-wrapper names
+_API_CALLS = ("place", "match_partition_rule")
+_WRAPPER_CALLS = ("_put", "_place")
+
+
+def _literal_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    # f"resident/{col2}"-style names: expand over nothing — dynamic, skip
+    return None
+
+
+def _fstring_prefix(node: ast.AST) -> str | None:
+    """The leading literal text of a JoinedStr (``f"resident/{col2}"``
+    -> ``"resident/"``) — enough to credit a rule as exercised by a
+    dynamic plane name, without claiming exactness."""
+    if not isinstance(node, ast.JoinedStr) or not node.values:
+        return None
+    head = node.values[0]
+    if isinstance(head, ast.Constant) and isinstance(head.value, str):
+        return head.value
+    return None
+
+
+class ShardRulesRule:
+    name = "shard-rules"
+    description = (
+        "every placed plane name matches exactly one PARTITION_RULES "
+        "entry, and no rule is dead"
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        rules: list[tuple[str, int, str]] = []  # (pattern, line, rel)
+        table_module = None
+
+        for module in project.modules:
+            for node in module.tree.body:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [node.target]
+                else:
+                    continue
+                if not any(
+                    isinstance(t, ast.Name) and t.id == "PARTITION_RULES"
+                    for t in targets
+                ):
+                    continue
+                value = node.value
+                if not isinstance(value, (ast.Tuple, ast.List)):
+                    continue
+                table_module = module
+                for entry in value.elts:
+                    if not (
+                        isinstance(entry, (ast.Tuple, ast.List))
+                        and entry.elts
+                    ):
+                        continue
+                    pattern = _literal_str(entry.elts[0])
+                    if pattern is None:
+                        continue
+                    try:
+                        re.compile(pattern)
+                    except re.error as exc:
+                        findings.append(Finding(
+                            rule=self.name,
+                            path=module.rel,
+                            line=entry.lineno,
+                            message=(
+                                f"partition rule {pattern!r} is not a "
+                                f"valid regex: {exc}"
+                            ),
+                            symbol="PARTITION_RULES",
+                        ))
+                        continue
+                    rules.append((pattern, entry.lineno, module.rel))
+
+        if table_module is None:
+            return findings  # no table in this project: nothing to check
+
+        # ---- collect placed plane names across the project
+        exercised: set[str] = set()  # rule patterns some call site matches
+        for module in project.modules:
+            if module is table_module:
+                continue  # the table's own defensive code isn't a call site
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                fn = node.func
+                callee = (
+                    fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None
+                )
+                if callee is None:
+                    continue
+                first = node.args[0]
+                if callee in _API_CALLS or callee in _WRAPPER_CALLS:
+                    name = _literal_str(first)
+                    if callee in _WRAPPER_CALLS and (
+                        name is None or "/" not in name
+                    ):
+                        # a wrapper by coincidence of name, or a dynamic
+                        # plane name: only the f-string widening below
+                        name = None
+                    if name is not None:
+                        hits = [
+                            p for p, _ln, _rel in rules
+                            if re.search(p, name)
+                        ]
+                        if not hits:
+                            findings.append(Finding(
+                                rule=self.name,
+                                path=module.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"plane {name!r} matches no "
+                                    "PARTITION_RULES entry — legislate a "
+                                    "layout before placing it"
+                                ),
+                                symbol=module.symbol_at(node.lineno),
+                            ))
+                        elif len(hits) > 1:
+                            findings.append(Finding(
+                                rule=self.name,
+                                path=module.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"plane {name!r} matches "
+                                    f"{len(hits)} PARTITION_RULES entries "
+                                    f"({', '.join(map(repr, hits))}) — "
+                                    "the table is ambiguous"
+                                ),
+                                symbol=module.symbol_at(node.lineno),
+                            ))
+                        else:
+                            exercised.add(hits[0])
+                        continue
+                    prefix = _fstring_prefix(first)
+                    if prefix and "/" in prefix:
+                        for p, _ln, _rel in rules:
+                            # a dynamic name exercises a rule when its
+                            # literal prefix overlaps the rule pattern's
+                            # literal core (regex syntax stripped)
+                            core = re.sub(
+                                r"[\^\$]|\(.*?\)|\[.*?\]", "", p
+                            ).replace("\\", "")
+                            if core.startswith(prefix) or prefix.startswith(
+                                core
+                            ):
+                                exercised.add(p)
+
+        for pattern, line, rel in rules:
+            if pattern not in exercised:
+                findings.append(Finding(
+                    rule=self.name,
+                    path=rel,
+                    line=line,
+                    message=(
+                        f"partition rule {pattern!r} is dead — no call "
+                        "site places a plane it matches"
+                    ),
+                    symbol="PARTITION_RULES",
+                ))
+        return findings
