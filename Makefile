@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke soak-smoke soak-validate crash-smoke crash-validate lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke soak-smoke soak-validate fleet-obs-smoke crash-smoke crash-validate lint clean
 
 all: native
 
@@ -37,6 +37,7 @@ test: native
 	python scripts/bench_compare.py --report-only
 	$(MAKE) serve-gate
 	$(MAKE) soak-smoke
+	$(MAKE) fleet-obs-smoke
 	$(MAKE) crash-smoke
 
 # The SLO budget gate alone (round 12): a recorded load profile through
@@ -75,6 +76,18 @@ soak-validate:
 	  echo "soak-validate: no SOAK_r*.json artifact found" >&2; exit 1; \
 	fi; \
 	python scripts/soak_check.py --validate "$$artifact"
+
+# The fleet-observatory gate (round 22): a 4-node chaos fleet whose
+# block propagation must be traceable admit->verify->apply across >= 3
+# members in ONE merged Perfetto export (cross-node flow arrows), with
+# per-peer gossip health scraped into the merged /debug/fleet view,
+# scrape-failure containment (a hung endpoint and a member dying
+# mid-run both yield stale-marked rows, never a wedged loop), and the
+# fleet propagation/peer-delivery/head-divergence SLO rows green WITH
+# observations.  The validated pass is recorded to FLEETOBS_r01.json.
+fleet-obs-smoke:
+	python scripts/soak_check.py --smoke --scenario fleet_obs --json FLEETOBS_r01.json
+	python scripts/soak_check.py --validate FLEETOBS_r01.json
 
 # The crash-safety gate (round 20): >=20 seeded SIGKILL trials against a
 # live WAL writer (killed at deterministic byte offsets) + a corruption

@@ -124,8 +124,12 @@ class BeaconApiServer:
         }
         # routes whose handler takes the raw query string as its last arg
         self._query_patterns = frozenset(
-            p for p, _ in self._routes() if "witness" in p
+            p for p, _ in self._routes()
+            if "witness" in p or p == r"/debug/trace"
         )
+        # fleet observatory (round 22): chaos/fleet.py attaches one so
+        # this server also answers /debug/fleet with the merged view
+        self.observatory = None
         # per-state multiproof planners (lambda_ethereum_consensus_tpu.
         # witness), created lazily on the first witness request
         self._witness = None
@@ -358,6 +362,8 @@ class BeaconApiServer:
             (r"/eth/v1/node/identity", self._identity),
             (r"/debug/lanes", self._debug_lanes),
             (r"/debug/slot", self._debug_slot),
+            (r"/debug/peers", self._debug_peers),
+            (r"/debug/fleet", self._debug_fleet),
         ]
 
     @staticmethod
@@ -697,13 +703,44 @@ class BeaconApiServer:
 
     # --------------------------------------------------------- debug routes
 
-    def _debug_trace(self) -> tuple[str, str, bytes]:
-        """The flight recorder's ring as Chrome/Perfetto trace JSON."""
+    def _debug_trace(self, query: str = "") -> tuple[str, str, bytes]:
+        """The flight recorder's ring as Chrome/Perfetto trace JSON.
+        ``?node=<label>`` filters to one node's process row — the
+        per-member slice the fleet aggregator scrapes before merging
+        (in-process fleets share ONE ring)."""
+        node = None
+        for part in query.split("&"):
+            if part.startswith("node="):
+                node = part[len("node="):] or None
         return (
             "200 OK",
             "application/json",
-            json.dumps(get_recorder().chrome()).encode(),
+            json.dumps(get_recorder().chrome(node=node)).encode(),
         )
+
+    def _debug_peers(self) -> tuple[str, str, bytes]:
+        """Per-peer gossip health: the node's last sidecar stats
+        snapshot (delivery first/duplicate counts, peer scores, mesh
+        membership, control-frame counters) plus its age.  404 without
+        an owning node; ``{}`` data before the first poll lands."""
+        node = self.node
+        if node is None:
+            return self._error(404, "no owning node")
+        stats = getattr(node, "_gossip_stats", {}) or {}
+        ts = getattr(node, "_gossip_stats_ts", 0.0)
+        return self._json({"data": {
+            "stats": stats,
+            "age_s": round(time.time() - ts, 3) if ts else None,
+        }})
+
+    def _debug_fleet(self) -> tuple[str, str, bytes]:
+        """The merged fleet view (round 22): per-member head/slot/SLO
+        status, the propagation matrix and fleet-level SLO rows — only
+        on the member (or standalone server) a FleetObservatory was
+        attached to; 404 elsewhere."""
+        if self.observatory is None:
+            return self._error(404, "no fleet observatory attached")
+        return self._json({"data": self.observatory.fleet_view()})
 
     def _debug_compile(self) -> tuple[str, str, bytes]:
         """The AOT compile/retrace attribution table: every cached

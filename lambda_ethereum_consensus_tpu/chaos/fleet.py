@@ -19,6 +19,9 @@ round-19 ``fleet_divergence_p95`` SLO row) when the members reconverge.
 
 from __future__ import annotations
 
+import asyncio
+import json
+import logging
 import os
 import time
 from contextlib import asynccontextmanager
@@ -29,18 +32,22 @@ from ..crypto import bls
 from ..fork_choice import get_head
 from ..network.gossip import publish_ssz, topic_name
 from ..node import BeaconNode, NodeConfig
+from ..slo import FLEET_SLOS, SloEngine
 from ..telemetry import get_metrics
-from ..tracing import get_recorder
+from ..tracing import get_recorder, merge_chrome_traces
 from .faults import FaultScheduler, FaultSpec
 from .inject import ChaosPort
 
 __all__ = [
     "ChainBundle",
     "Fleet",
+    "FleetObservatory",
     "default_keys",
     "make_chain",
     "started_node",
 ]
+
+log = logging.getLogger("chaos.fleet")
 
 
 def default_keys(n: int) -> list[bytes]:
@@ -191,6 +198,7 @@ class Fleet:
                 wire=wire,
                 attnet_subnets=subnets,
                 port_wrapper=factory,
+                node_label=f"n{i}",
             )
             node = BeaconNode(config, self.spec)
             await node.start()
@@ -312,7 +320,8 @@ class Fleet:
         await node.pending.process_once()
         digest = node.chain.fork_digest()
         await publish_ssz(
-            node.port, topic_name(digest, "beacon_block"), signed, self.spec
+            node.port, topic_name(digest, "beacon_block"), signed, self.spec,
+            node=node.config.node_label,
         )
         return signed.message.hash_tree_root(self.spec)
 
@@ -320,5 +329,300 @@ class Fleet:
         node = self.nodes[publisher]
         digest = node.chain.fork_digest()
         await publish_ssz(
-            node.port, topic_name(digest, topic_short), value, self.spec
+            node.port, topic_name(digest, topic_short), value, self.spec,
+            node=node.config.node_label,
         )
+
+    def observatory(self, **kwargs) -> "FleetObservatory":
+        """A :class:`FleetObservatory` over this fleet's live members,
+        attached to member 0's API server (which then answers
+        ``/debug/fleet`` with the merged view)."""
+        obs = FleetObservatory(
+            members=[
+                (f"n{i}", node.api.host, node.api.port)
+                for i, node in enumerate(self.nodes)
+                if node.api is not None
+            ],
+            **kwargs,
+        )
+        if self.nodes and self.nodes[0].api is not None:
+            self.nodes[0].api.observatory = obs
+        return obs
+
+
+# ------------------------------------------------------- fleet observatory
+
+# per-member scrape budget: one hung member costs AT MOST this much of a
+# scrape pass, never the loop (satellite: failure containment)
+FLEET_SCRAPE_TIMEOUT_S = 2.0
+# a member whose last good scrape is older than this is marked stale in
+# the merged view even between scrape passes
+FLEET_STALE_AFTER_S = 15.0
+
+# /metrics gauges lifted into the merged per-member rows (simple
+# exposition-line parse; full families stay on the member's own route)
+_FLEET_GAUGES = ("fork_choice_head_slot", "peers_connection_count")
+
+
+async def _http_get_json(
+    host: str, port: int, path: str, timeout_s: float
+) -> object:
+    """Minimal dependency-free HTTP/1.1 GET -> parsed JSON body.
+    Raises on timeout, connection failure, non-200 or bad JSON — the
+    caller owns containment."""
+    status, body = await _http_get(host, port, path, timeout_s)
+    if status != 200:
+        raise RuntimeError(f"GET {path}: HTTP {status}")
+    return json.loads(body.decode())
+
+
+async def _http_get(
+    host: str, port: int, path: str, timeout_s: float
+) -> tuple[int, bytes]:
+    async def go() -> tuple[int, bytes]:
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            writer.write(
+                f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+                "Connection: close\r\n\r\n".encode()
+            )
+            await writer.drain()
+            raw = await reader.read(-1)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+        head, _, body = raw.partition(b"\r\n\r\n")
+        status = int(head.split(b"\r\n", 1)[0].split()[1])
+        return status, body
+
+    return await asyncio.wait_for(go(), timeout_s)
+
+
+def _parse_gauges(text: str, names=_FLEET_GAUGES) -> dict:
+    """Lift a few label-less gauges out of a Prometheus exposition."""
+    out: dict = {}
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        for name in names:
+            if line.startswith(name + " ") or line.startswith(name + "{"):
+                try:
+                    out[name] = float(line.rsplit(None, 1)[-1])
+                except ValueError:
+                    pass
+    return out
+
+
+class FleetObservatory:
+    """The merged fleet view (round 22 tentpole, part 3).
+
+    Scrapes every member's Beacon API over real HTTP loopback —
+    ``/metrics``, ``/debug/slo``, ``/debug/slot``, ``/debug/peers`` and
+    ``/debug/trace?node=<label>`` — under a PER-MEMBER timeout, merges
+    the results into one ``/debug/fleet`` document (per-node head/slot/
+    SLO status + the propagation matrix), evaluates the fleet-level SLO
+    rows (:data:`~..slo.FLEET_SLOS`) and produces ONE Perfetto export
+    whose cross-node flow arrows reconstruct a block's propagation.
+
+    Failure containment is the design center: a member that hangs,
+    answers 500 or died mid-scrape yields a **stale-marked row** (with
+    ``fleet_scrape_errors_total{member}`` counting the miss) — never an
+    exception out of the scrape loop, never a blocked pass."""
+
+    def __init__(
+        self,
+        members: list[tuple[str, str, int]],
+        *,
+        timeout_s: float | None = None,
+        windows=None,
+        metrics=None,
+    ):
+        if timeout_s is None:
+            try:
+                timeout_s = float(
+                    os.environ.get("FLEET_SCRAPE_TIMEOUT_S", "")
+                    or FLEET_SCRAPE_TIMEOUT_S
+                )
+            except ValueError:
+                timeout_s = FLEET_SCRAPE_TIMEOUT_S
+        self.members = list(members)
+        self.timeout_s = timeout_s
+        self.metrics = metrics if metrics is not None else get_metrics()
+        kwargs = {"windows": windows} if windows is not None else {}
+        # fleet-level budget rows over the process-wide histograms (the
+        # in-process fleet's propagation/delivery families aggregate
+        # there); own engine so evaluations never consume the node tick
+        # engine's snapshot history
+        self.engine = SloEngine(
+            slos=FLEET_SLOS, metrics=self.metrics, **kwargs
+        )
+        self._rows: dict[str, dict] = {
+            name: {"member": name, "stale": True, "error": "never scraped"}
+            for name, _, _ in self.members
+        }
+        self._traces: dict[str, dict] = {}
+        self._scrapes = 0
+        self._task: asyncio.Task | None = None
+
+    # ------------------------------------------------------------ scraping
+
+    async def scrape_once(self) -> dict:
+        """One full pass over every member (concurrently, each under its
+        own timeout).  Always returns the merged view; never raises."""
+        await asyncio.gather(
+            *(self._scrape_member(m) for m in self.members),
+            return_exceptions=True,
+        )
+        self._scrapes += 1
+        return self.fleet_view()
+
+    async def _scrape_member(self, member: tuple[str, str, int]) -> None:
+        name, host, port = member
+        try:
+            # the whole member scrape shares ONE budget: per-GET
+            # timeouts would let a slow member cost 5x the bound
+            async def pull():
+                metrics_status, metrics_body = await _http_get(
+                    host, port, "/metrics", self.timeout_s
+                )
+                slo = await _http_get_json(
+                    host, port, "/debug/slo", self.timeout_s
+                )
+                slot = await _http_get_json(
+                    host, port, "/debug/slot", self.timeout_s
+                )
+                peers = await _http_get_json(
+                    host, port, "/debug/peers", self.timeout_s
+                )
+                trace = await _http_get_json(
+                    host, port, f"/debug/trace?node={name}", self.timeout_s
+                )
+                return metrics_status, metrics_body, slo, slot, peers, trace
+
+            (metrics_status, metrics_body, slo, slot, peers, trace) = (
+                await asyncio.wait_for(pull(), self.timeout_s)
+            )
+            if metrics_status != 200:
+                raise RuntimeError(f"/metrics: HTTP {metrics_status}")
+        except Exception as e:
+            # containment: the row goes stale with the reason; the pass
+            # and the other members are untouched
+            self.metrics.inc("fleet_scrape_errors_total", member=name)
+            row = self._rows.get(name, {"member": name})
+            row.update({"stale": True, "error": f"{type(e).__name__}: {e}"})
+            self._rows[name] = row
+            return
+        slo_data = (slo or {}).get("data") or {}
+        slot_data = (slot or {}).get("data") or {}
+        peers_data = ((peers or {}).get("data") or {}).get("stats") or {}
+        self._traces[name] = trace or {}
+        self._rows[name] = {
+            "member": name,
+            "stale": False,
+            "error": None,
+            "scraped_at": time.time(),
+            "slot": slot_data.get("slot"),
+            "head_slot": slot_data.get("head_slot"),
+            "head_root": slot_data.get("head_root"),
+            "slo_ok": slo_data.get("ok"),
+            "slo_violations": [
+                r.get("slo")
+                for r in (slo_data.get("slos") or ())
+                if r.get("ok") is False
+            ],
+            "gauges": _parse_gauges(
+                metrics_body.decode("utf-8", "replace")
+            ),
+            "peers": {
+                peer[:8]: {
+                    "score": (info or {}).get("score"),
+                    "topics": (info or {}).get("topics"),
+                }
+                for peer, info in (peers_data.get("peers") or {}).items()
+            },
+            "delivery": peers_data.get("delivery") or {},
+            "wire": peers_data.get("wire"),
+        }
+
+    # ------------------------------------------------------- merged views
+
+    def propagation_matrix(self) -> dict:
+        """``{receiver: {sender_prefix: {topic_short: {first, duplicate}}}}``
+        from the members' per-peer delivery stats — who actually carried
+        the fleet's traffic, and how much of it was redundant."""
+        matrix: dict = {}
+        for name, row in self._rows.items():
+            if row.get("stale"):
+                continue
+            cell: dict = {}
+            for peer, topics in (row.get("delivery") or {}).items():
+                short = {}
+                for topic, counts in (topics or {}).items():
+                    short[topic.split("/")[3] if topic.count("/") >= 4
+                          else topic] = counts
+                cell[peer[:8]] = short
+            matrix[name] = cell
+        return matrix
+
+    def fleet_view(self) -> dict:
+        """The ``/debug/fleet`` document.  Cheap and non-raising: reads
+        the cached rows, re-marks age-based staleness, and runs one
+        read-only fleet SLO evaluation."""
+        now = time.time()
+        rows = []
+        for name, _, _ in self.members:
+            row = dict(self._rows.get(name) or {"member": name, "stale": True})
+            scraped = row.get("scraped_at")
+            if scraped is not None and now - scraped > FLEET_STALE_AFTER_S:
+                row["stale"] = True
+                row.setdefault("error", "stale: last scrape too old")
+            rows.append(row)
+        try:
+            report = self.engine.evaluate(emit=False, snapshot=False)
+        except Exception:  # a broken registry must not 500 the view
+            log.exception("fleet SLO evaluation failed")
+            report = {"ok": None, "rows": []}
+        fresh = [r for r in rows if not r.get("stale")]
+        head_slots = [
+            r["head_slot"] for r in fresh if r.get("head_slot") is not None
+        ]
+        return {
+            "members": rows,
+            "scrapes": self._scrapes,
+            "converged": len({r.get("head_root") for r in fresh}) <= 1,
+            "head_lag_slots": (
+                max(head_slots) - min(head_slots) if head_slots else None
+            ),
+            "propagation_matrix": self.propagation_matrix(),
+            "slo": report,
+        }
+
+    def merged_trace(self) -> dict:
+        """ONE Perfetto document over every member's last scraped
+        export — per-node process rows (stable label-derived pids) and
+        the cross-node flow arrows the wire trace contexts stitched."""
+        return merge_chrome_traces(
+            [self._traces[name] for name, _, _ in self.members
+             if name in self._traces]
+        )
+
+    # ---------------------------------------------------------- scrape loop
+
+    def start(self, interval_s: float = 5.0) -> None:
+        """Run :meth:`scrape_once` forever at ``interval_s`` (bounded by
+        construction: one pass in flight, per-member timeouts inside)."""
+        async def loop() -> None:
+            while True:
+                await self.scrape_once()
+                await asyncio.sleep(interval_s)
+
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

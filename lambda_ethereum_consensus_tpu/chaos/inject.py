@@ -183,7 +183,7 @@ class ChaosPort:
 
     # --------------------------------------------------------- outbound
 
-    async def publish(self, topic: str, payload: bytes) -> None:
+    async def publish(self, topic: str, payload: bytes, trace=None) -> None:
         decision = self._faults.decide(f"{self.name}->out")
         if decision.drop:
             self._record("drop")
@@ -192,10 +192,10 @@ class ChaosPort:
             self.fault_counts["delay"] += 1
             get_metrics().inc("chaos_fault_injected_total", kind="delay")
             await asyncio.sleep(decision.delay_s)
-        await self._port.publish(topic, payload)
+        await self._port.publish(topic, payload, trace)
         if decision.dup:
             self._record("dup")
-            await self._port.publish(topic, payload)
+            await self._port.publish(topic, payload, trace)
 
     # ---------------------------------------------------------- req/resp
 

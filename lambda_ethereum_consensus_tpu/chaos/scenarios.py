@@ -27,6 +27,7 @@ and writes the pass/fail artifact; the final budget gate is one
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import time
 from dataclasses import dataclass, field
@@ -942,12 +943,297 @@ async def _churn(ctx: ScenarioContext) -> dict:
     }
 
 
+async def _fleet_obs(ctx: ScenarioContext) -> dict:
+    """The round-22 observatory acceptance: a 4-node chaos fleet whose
+    block propagation is traceable admit->verify->apply across >=3
+    members inside ONE merged Perfetto export (cross-node flow arrows
+    stitched by the wire trace contexts), per-peer gossip health scraped
+    into the merged ``/debug/fleet`` view, fleet-level SLO rows with
+    REAL observations (anti-silent-green), and scrape-loop failure
+    containment proven against both a hung endpoint and a member that
+    dies mid-run."""
+    from ..validator import build_signed_block
+
+    bundle = make_chain(n_keys=64, chain_len=3, spec=soak_spec())
+    spec = bundle.spec
+    slot_s = float(SOAK_SECONDS_PER_SLOT)
+    kinds = ("scrape_hang", "member_down")
+    before = _fault_totals(kinds)
+    m = get_metrics()
+    err0 = {
+        name: m.get("fleet_scrape_errors_total", member=name)
+        for name in ("n3", "hung")
+    }
+    ok = True
+    with use_chain_spec(spec):
+        fleet = await Fleet.boot(
+            4, bundle, ctx.base_dir + "/fleetobs",
+            fault_spec=FaultSpec(dup=0.05, jitter_s=0.005),
+            seed=ctx.seed + 5,
+        )
+        # a live endpoint that accepts and never answers: the scrape
+        # loop's per-member budget is the ONLY thing standing between
+        # one bad member and a wedged observatory
+        release = asyncio.Event()
+
+        async def _hang(reader, writer):
+            try:
+                await release.wait()
+            finally:
+                writer.close()
+
+        hung = await asyncio.start_server(_hang, "127.0.0.1", 0)
+        obs = fleet.observatory(windows=SOAK_WINDOWS, timeout_s=0.75)
+        obs.members.append(
+            ("hung", "127.0.0.1", hung.sockets[0].getsockname()[1])
+        )
+        try:
+            seed_head = bundle.blocks[-1].message.hash_tree_root(spec)
+            assert await fleet.wait_converged(20.0, root=seed_head), (
+                "fleet never converged on the seed chain"
+            )
+            # one slot-clocked block: its wire trace context fans the
+            # flow id out to every admitting member
+            cur = await _wait_for_slot(
+                fleet.nodes[0], int(bundle.tip_state.slot) + 1, spec
+            )
+            signed, tip_state = build_signed_block(
+                bundle.tip_state, cur, bundle.sks, spec=spec
+            )
+            await _publish_until_seen(fleet, 0, signed)
+            # a brief partition/heal so the fleet head-divergence SLO
+            # row (round-19 family, folded into the fleet gate this
+            # round) has a real episode to observe
+            fleet.partition([[0, 1, 2], [3]])
+            cur = await _wait_for_slot(
+                fleet.nodes[0], int(tip_state.slot) + 1, spec
+            )
+            signed, tip_state = build_signed_block(
+                tip_state, cur, bundle.sks, spec=spec
+            )
+            await _publish_until_seen(fleet, 0, signed, timeout_s=6.0)
+            fleet.sample_heads()  # opens the divergence episode
+            diverged = len(set(fleet.heads())) > 1
+            fleet.heal()
+            t_heal = time.monotonic()
+            cur = await _wait_for_slot(
+                fleet.nodes[0], int(tip_state.slot) + 1, spec
+            )
+            signed, tip_state = build_signed_block(
+                tip_state, cur, bundle.sks, spec=spec
+            )
+            final_root = await _publish_until_seen(fleet, 0, signed)
+            budget_slots = 8 if ctx.smoke else 12
+            converged = await fleet.wait_converged(
+                budget_slots * slot_s, root=final_root
+            )
+            recovery = _observe_recovery(
+                ctx, "fleet_obs", time.monotonic() - t_heal, budget_slots,
+                recovered=converged,
+            )
+            ok = recovery["recovered"]
+            if not diverged:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    "the partition never diverged the fleet — the "
+                    "divergence SLO row saw no episode",
+                )
+            # deterministic per-peer health poll (the node tick loop
+            # polls every GOSSIP_STATS_POLL_S; the scenario must not
+            # depend on that phase)
+            for node in fleet.nodes:
+                await node._poll_gossip_stats()
+            # scrape pass 1: every live member fresh, the hung endpoint
+            # contained to its budget
+            _count_fault("scrape_hang")
+            t0 = time.monotonic()
+            view = await obs.scrape_once()
+            scrape_s = time.monotonic() - t0
+            rows = {r["member"]: r for r in view["members"]}
+            live = [f"n{i}" for i in range(4)]
+            stale_live = [n for n in live if rows[n].get("stale")]
+            if stale_live:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    f"live members scraped stale: {stale_live} "
+                    f"({[rows[n].get('error') for n in stale_live]})",
+                )
+            if not rows["hung"].get("stale") or not rows["hung"].get("error"):
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    "the hung member did not yield a stale-marked row",
+                )
+            if m.get("fleet_scrape_errors_total", member="hung") <= err0["hung"]:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    "fleet_scrape_errors_total never counted the hung member",
+                )
+            if scrape_s > obs.timeout_s + 2.0:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    f"scrape pass took {scrape_s:.2f}s — the hung member "
+                    "blocked the loop past its per-member budget",
+                    observed=scrape_s, budget=obs.timeout_s + 2.0,
+                )
+            # the propagation matrix must show real carried traffic on
+            # >=3 receivers (who heard the fleet's blocks, from whom)
+            matrix = view["propagation_matrix"]
+            carried = [
+                name for name, cell in matrix.items()
+                if any(
+                    counts.get("first", 0) > 0
+                    for topics in cell.values()
+                    for counts in topics.values()
+                )
+            ]
+            if len(carried) < 3:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    f"propagation matrix shows deliveries on only "
+                    f"{len(carried)} members ({carried}); need >= 3",
+                )
+            # merged Perfetto export: ONE document, per-node process
+            # rows, and at least one flow id spanning >= 3 processes
+            merged = obs.merged_trace()
+            procs = {
+                e["pid"]
+                for e in merged.get("traceEvents", ())
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+            }
+            flows: dict = {}
+            for e in merged.get("traceEvents", ()):
+                if e.get("cat") == "gossip_flow":
+                    f = flows.setdefault(e.get("id"), {"s": set(), "f": set()})
+                    if e.get("ph") in ("s", "f"):
+                        f[e["ph"]].add(e.get("pid"))
+            flow_span = max(
+                (len(f["s"] | f["f"]) for f in flows.values()
+                 if f["s"] and f["f"]),
+                default=0,
+            )
+            if len(procs) < 4:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    f"merged export has {len(procs)} process rows; "
+                    "expected one per member (4)",
+                )
+            if flow_span < 3:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    f"no gossip flow spans >= 3 nodes in the merged "
+                    f"export (best: {flow_span})",
+                )
+            os.makedirs(ctx.base_dir + "/fleetobs", exist_ok=True)
+            trace_path = ctx.base_dir + "/fleetobs/fleet_trace.json"
+            # graftlint: disable=async-blocking — harness-only artifact
+            # write at scenario teardown, off the consensus hot path
+            with open(trace_path, "w") as fh:
+                json.dump(merged, fh)
+            view_path = ctx.base_dir + "/fleetobs/fleet_view.json"
+            # graftlint: disable=async-blocking — see above
+            with open(view_path, "w") as fh:
+                json.dump(view, fh, indent=2, default=str)
+            # member death mid-run: the NEXT pass must contain it the
+            # same way — stale row, counted error, loop alive
+            _count_fault("member_down")
+            await fleet.nodes[3].stop()
+            fleet.nodes = fleet.nodes[:3]  # stopped; skip in fleet.stop()
+            fleet.chaos = fleet.chaos[:3]
+            view2 = await obs.scrape_once()
+            rows2 = {r["member"]: r for r in view2["members"]}
+            if not rows2["n3"].get("stale") or not rows2["n3"].get("error"):
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    "a member that died mid-run did not yield a "
+                    "stale-marked row on the next pass",
+                )
+            if m.get("fleet_scrape_errors_total", member="n3") <= err0["n3"]:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    "fleet_scrape_errors_total never counted the dead member",
+                )
+            if [n for n in live[:3] if rows2[n].get("stale")]:
+                ok = False
+                ctx.violation(
+                    "fleet_obs",
+                    "a dead member's scrape failure leaked into the "
+                    "surviving members' rows",
+                )
+            # the fleet SLO rows this scenario exercises must carry real
+            # observations — a green row with count=0 is silent green
+            report = obs.engine.evaluate(emit=False, snapshot=False)
+            slo_rows = {r["slo"]: r for r in report["slos"]}
+            exercised = (
+                "fleet_propagation_p95", "peer_delivery_p95",
+                "fleet_divergence_p95",
+            )
+            for name in exercised:
+                row = slo_rows.get(name)
+                if row is None or row["count"] <= 0:
+                    ok = False
+                    ctx.violation(
+                        "fleet_obs",
+                        f"fleet SLO row {name} has no observations — "
+                        "the gate would be silently green",
+                    )
+                elif row["ok"] is False:
+                    ok = False
+                    ctx.violation(
+                        "fleet_obs",
+                        f"fleet SLO row {name} over budget",
+                        observed=row["observed"], budget=row["budget"],
+                    )
+        finally:
+            release.set()
+            hung.close()
+            await hung.wait_closed()
+            obs.stop()
+            await fleet.stop()
+    injected = {
+        kind: m.get(_FAULT_COUNTER, kind=kind) - before[kind]
+        for kind in kinds
+    }
+    missing = [kind for kind, delta in injected.items() if delta <= 0]
+    if missing:
+        ok = False
+        ctx.violation("fleet_obs", f"injected fault kinds unobserved: {missing}")
+    return {
+        "scenario": "fleet_obs", "ok": ok, "nodes": 4,
+        "diverged": diverged, "faults": injected,
+        "scrape_s": round(scrape_s, 3), "scrapes": view2["scrapes"],
+        "flow_span_nodes": flow_span, "process_rows": len(procs),
+        "propagation_members": carried,
+        "fleet_slo": {
+            name: {
+                "count": slo_rows[name]["count"],
+                "observed": slo_rows[name]["observed"],
+                "budget": slo_rows[name]["budget"],
+                "ok": slo_rows[name]["ok"],
+            }
+            for name in exercised if name in slo_rows
+        },
+        "trace_path": trace_path, "view_path": view_path,
+        "final_root": final_root.hex(), **recovery,
+    }
+
+
 SCENARIOS = {
     "steady": _steady,
     "storm": _storm,
     "partition": _partition,
     "equivocation": _equivocation,
     "churn": _churn,
+    "fleet_obs": _fleet_obs,
 }
 
 

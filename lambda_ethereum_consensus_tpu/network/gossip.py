@@ -23,7 +23,7 @@ from ..compression.snappy import decompress as snappy_decompress
 from ..config import ChainSpec, get_chain_spec
 from ..state_transition import misc
 from ..telemetry import get_metrics, span
-from ..tracing import new_trace
+from ..tracing import get_recorder, new_trace
 from .port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT, Port
 
 MAX_QUEUE = 1024
@@ -97,6 +97,7 @@ class TopicSubscription:
         scheduler=None,
         lane: str | None = None,
         sink: "SharedLaneSink | None" = None,
+        node: str | None = None,
     ):
         """``max_batch`` bounds one drain's handler batch.  Attestation
         channels raise it by two orders of magnitude: the device RLC
@@ -126,6 +127,9 @@ class TopicSubscription:
         self.scheduler = scheduler
         self.lane = lane
         self.sink = sink
+        # node label for the flight recorder's per-node process rows (a
+        # fleet's co-resident nodes share ONE ring; None = single-node)
+        self.node = node
         # prebuilt standalone-enqueue trace args: the admission callback
         # runs at gossip arrival rate, so the per-item note must not
         # allocate (ItemTrace stores shared dicts without mutating them)
@@ -161,7 +165,15 @@ class TopicSubscription:
         # tuple carries it end to end — lane, flush, decode, verify,
         # verdict — so "where did this message's budget go" is one
         # /debug/trace lookup instead of histogram archaeology
-        trace = new_trace(self.topic_label)
+        trace = new_trace(self.topic_label, node=self.node)
+        # wire trace context (round 22): the sender stamped (origin,
+        # trace_id, hop, origin_ts) onto the frame and the Port parked it
+        # under this msg_id.  Absent for old/interop senders — the fresh
+        # local trace above is then the whole story (mixed-version path).
+        pop = getattr(self.port, "pop_trace", None)
+        wire = pop(msg_id) if pop is not None else None
+        if wire is not None:
+            self._admit_remote(trace, wire, peer_id)
         if self.scheduler is not None:
             # lane producer: admission (and any cross-lane shedding) is
             # the scheduler's call; this topic just dispatches the
@@ -191,6 +203,34 @@ class TopicSubscription:
         if trace is not None:
             trace.note("enqueue", self._enqueue_args)
         self.queue.put_nowait((msg_id, payload, peer_id, trace))
+
+    def _admit_remote(self, trace, wire, peer_id: bytes) -> None:
+        """Book a remotely-originated admission: per-peer delivery
+        latency (+ the fleet block-propagation histogram for blocks),
+        a ``remote_admit`` stage event carrying the origin's identity,
+        and the Perfetto flow arrow binding this node's trace to the
+        origin's publish (shared global id ``origin:trace_id``)."""
+        origin, origin_tid, hop, origin_ts = wire
+        delay = max(0.0, time.time() - origin_ts)
+        m = get_metrics()
+        if m._enabled:
+            m.observe(
+                "peer_delivery_latency_seconds", delay,
+                peer=peer_id.hex()[:8], topic=self.topic_label,
+            )
+            if self.topic_label == "beacon_block":
+                m.observe("fleet_block_propagation_seconds", delay)
+        if trace is not None:
+            flow = f"{origin}:{origin_tid}"
+            trace.note("remote_admit", {
+                "origin": origin, "origin_trace": origin_tid,
+                "hop": hop, "flow": flow, "prop_s": round(delay, 4),
+            })
+            get_recorder().record(
+                "flow_f", trace.trace_id, f"admit:{self.topic_label}",
+                {"flow": flow, "origin": origin, "hop": hop},
+                node=self.node,
+            )
 
     # ------------------------------------------------- scheduler-lane target
 
@@ -342,10 +382,37 @@ class SharedLaneSink:
             )
 
 
-async def publish_ssz(port: Port, topic: str, value, spec: ChainSpec | None = None) -> None:
-    """SSZ-encode + raw-snappy-compress + publish."""
+async def publish_ssz(
+    port: Port,
+    topic: str,
+    value,
+    spec: ChainSpec | None = None,
+    *,
+    node: str | None = None,
+) -> None:
+    """SSZ-encode + raw-snappy-compress + publish.
+
+    With a ``node`` label (round 22), the publish is stamped with a
+    wire trace context ``(node, trace_id, hop=0, time.time())`` and a
+    Perfetto flow-start arrow is recorded under the same global id —
+    every remote admission of this message binds back to this instant
+    in the merged fleet export.  Label-less publishes stay unstamped
+    (the pre-round-22 wire, byte for byte)."""
     from ..compression.snappy import compress
 
     spec = spec or get_chain_spec()
     port_payload = compress(value.encode(spec))
-    await port.publish(topic, port_payload)
+    trace_ctx = None
+    rec = get_recorder()
+    if node is not None and rec.enabled:
+        trace_id = rec.new_id()
+        trace_ctx = (node, trace_id, 0, time.time())
+        rec.record(
+            "flow_s", trace_id, f"publish:{_topic_short(topic)}",
+            {"flow": f"{node}:{trace_id}"}, node=node,
+        )
+    if trace_ctx is not None:
+        await port.publish(topic, port_payload, trace_ctx)
+    else:
+        # positional-compat: test doubles often stub a 2-arg publish
+        await port.publish(topic, port_payload)

@@ -168,6 +168,12 @@ class Gossipsub:
         # gossip windows: lists of msg-ids, newest first
         self._history: list[list[bytes]] = []
         self._current_window: list[bytes] = []
+        # per-(peer, topic) delivery counters [first, duplicate] and
+        # control-frame tallies (round 22 fleet observatory): duplicates
+        # dedup here and never reach the host, so gossip health must be
+        # tallied at the wire and exported via get_gossip_stats
+        self.delivery_stats: dict[tuple[bytes, str], list[int]] = {}
+        self.control_stats: dict[str, int] = {}
         self._heartbeat_task: asyncio.Task | None = None
         host.set_stream_handler(MESHSUB_PROTOCOL, self._inbound)
         self._prev_on_peer = host.on_peer
@@ -282,7 +288,12 @@ class Gossipsub:
         if topic not in self.subscriptions:
             return
         msg_id = eth2_msg_id(topic, msg.data)
-        if not self._mark_seen(msg_id):
+        first = self._mark_seen(msg_id)
+        stat = self.delivery_stats.setdefault(
+            (state.peer_id.bytes, topic), [0, 0]
+        )
+        stat[0 if first else 1] += 1
+        if not first:
             return
         verdict = ACCEPT
         if self.validator is not None:
@@ -318,6 +329,10 @@ class Gossipsub:
         self.backoff[(topic, peer_id)] = now + duration_s
 
     async def _on_control(self, state: _PeerState, ctl: pb.ControlMessage) -> None:
+        if ctl.graft:
+            self._bump("graft_recv", len(ctl.graft))
+        if ctl.prune:
+            self._bump("prune_recv", len(ctl.prune))
         for graft in ctl.graft:
             topic = graft.topic_id
             if self._in_backoff(topic, state.peer_id):
@@ -357,6 +372,7 @@ class Gossipsub:
         wanted: list[bytes] = []
         seen_this_rpc: set[bytes] = set()
         for ihave in ctl.ihave:
+            self._bump("ihave_recv", len(ihave.message_ids))
             if ihave.topic_id not in self.subscriptions:
                 continue
             for m in ihave.message_ids:
@@ -372,11 +388,13 @@ class Gossipsub:
                 state.ihave_budget -= 1
                 wanted.append(m)
         if wanted:
+            self._bump("iwant_sent", len(wanted))
             rpc = pb.RPC()
             rpc.control.iwant.add().message_ids.extend(wanted)
             await self._send_rpc(state, rpc)
         serve: list[tuple[str, bytes]] = []
         for iwant in ctl.iwant:
+            self._bump("iwant_recv", len(iwant.message_ids))
             for mid in iwant.message_ids:
                 # per-(peer, msg) retransmission budget (the spec's
                 # GossipRetransmission role): re-IWANTing the same cached
@@ -391,12 +409,52 @@ class Gossipsub:
                         state.iwant_served.pop(next(iter(state.iwant_served)))
                     serve.append(entry)
         if serve:
+            self._bump("iwant_served", len(serve))
             rpc = pb.RPC()
             for topic, data in serve:
                 m = rpc.publish.add()
                 m.topic = topic
                 m.data = data
             await self._send_rpc(state, rpc)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        self.control_stats[key] = self.control_stats.get(key, 0) + n
+
+    def stats(self) -> dict:
+        """JSON-able gossip-health snapshot — the libp2p-wire twin of the
+        bespoke sidecar's ``gossip_stats()``, with live IHAVE/IWANT
+        efficacy counters (ids advertised / requested / retransmitted)."""
+        delivery: dict[str, dict[str, dict[str, int]]] = {}
+        for (pid, topic), (first, dup) in self.delivery_stats.items():
+            delivery.setdefault(pid.hex(), {})[topic] = {
+                "first": first, "duplicate": dup,
+            }
+        control = dict(self.control_stats)
+        for key in ("graft_sent", "graft_recv", "prune_sent", "prune_recv",
+                    "ihave_sent", "ihave_recv", "iwant_sent", "iwant_recv",
+                    "iwant_served"):
+            control.setdefault(key, 0)
+        return {
+            "wire": "libp2p",
+            "peers": {
+                s.peer_id.bytes.hex(): {
+                    "score": round(s.score, 4),
+                    "addr": "",
+                    "topics": sorted(s.topics),
+                }
+                for s in self.peers.values()
+            },
+            "delivery": delivery,
+            "mesh": {
+                topic: sorted(p.bytes.hex() for p in members)
+                for topic, members in self.mesh.items()
+            },
+            "ban_scores": {
+                p.bytes.hex(): round(score, 4)
+                for p, score in self.retained_scores.items()
+            },
+            "control": control,
+        }
 
     # ------------------------------------------------------------- outbound
     async def subscribe(self, topic: str) -> None:
@@ -474,8 +532,10 @@ class Gossipsub:
         shrinking it (VERDICT r5 item 7)."""
         rpc = pb.RPC()
         for topic in graft:
+            self._bump("graft_sent")
             rpc.control.graft.add().topic_id = topic
         for topic in prune:
+            self._bump("prune_sent")
             entry = rpc.control.prune.add()
             entry.topic_id = topic
             entry.backoff = int(PRUNE_BACKOFF_S)
@@ -597,6 +657,7 @@ class Gossipsub:
             if topic in s.topics and s.peer_id not in members and s.score >= 0
         ][:D]
         for state in audience:
+            self._bump("ihave_sent", len(ids))
             rpc = pb.RPC()
             ih = rpc.control.ihave.add()
             ih.topic_id = topic

@@ -12,11 +12,13 @@ libp2p_port.ex:232-234).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import random
 import struct
 import sys
+from collections import OrderedDict
 from typing import Awaitable, Callable
 
 from ..telemetry import get_metrics, span
@@ -77,6 +79,15 @@ class Port:
         self._on_new_peer: Handler | None = None
         self._on_peer_gone: Handler | None = None
         self.on_exit: Handler | None = None
+        # Cross-node trace contexts delivered alongside gossip (round 22).
+        # Handlers keep their 4-arg (topic, msg_id, payload, peer) signature
+        # — the optional wire trace is parked here keyed by msg_id and
+        # retrieved via pop_trace() by whoever mints the local ItemTrace.
+        # Bounded: an un-popped entry (handler predates tracing) must not
+        # grow without limit.
+        self._gossip_traces: OrderedDict[bytes, tuple[str, int, int, float]] = (
+            OrderedDict()
+        )
         # peer events that raced handler assignment: the sidecar dials
         # bootnodes during init, so on a fast loopback a new_peer
         # notification can land before the node wires on_new_peer —
@@ -295,10 +306,24 @@ class Port:
         cmd.unsubscribe.topic = topic
         await self._command(cmd)
 
-    async def publish(self, topic: str, payload: bytes) -> None:
+    async def publish(
+        self,
+        topic: str,
+        payload: bytes,
+        trace: tuple[str, int, int, float] | None = None,
+    ) -> None:
+        """Publish, optionally stamping a ``(origin, trace_id, hop,
+        origin_ts)`` trace context onto the wire frame so remote admission
+        can attribute the message back to this node's ItemTrace."""
         cmd = port_pb2.Command()
         cmd.publish.topic = topic
         cmd.publish.payload = payload
+        if trace is not None:
+            origin, trace_id, hop, origin_ts = trace
+            cmd.publish.trace.origin = origin
+            cmd.publish.trace.trace_id = trace_id
+            cmd.publish.trace.hop = hop
+            cmd.publish.trace.origin_ts = origin_ts
         await self._command(cmd)
 
     async def validate_message(self, msg_id: bytes, verdict: int) -> None:
@@ -333,6 +358,23 @@ class Port:
         cmd.send_response.request_id = request_id
         cmd.send_response.payload = payload
         await self._command(cmd)
+
+    async def get_gossip_stats(self) -> dict:
+        """Per-(peer, topic) gossip-health snapshot from the sidecar.
+
+        Returns ``{}`` against a sidecar that predates the command
+        (mixed-version fleet) — peer-health metrics simply stay empty
+        rather than failing the node's tick loop."""
+        cmd = port_pb2.Command()
+        cmd.get_gossip_stats.SetInParent()
+        try:
+            result = await self._command(cmd)
+        except PortCommandError:
+            return {}
+        try:
+            return json.loads(result.payload.decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            return {}
 
     # -------------------------------------------------------- notifications
 
@@ -370,6 +412,12 @@ class Port:
             if handler is None:
                 self._spawn(self.validate_message, n.gossip.msg_id, VERDICT_IGNORE)
             else:
+                if n.gossip.HasField("trace"):
+                    t = n.gossip.trace
+                    self._stash_trace(
+                        n.gossip.msg_id,
+                        (t.origin, t.trace_id, t.hop, t.origin_ts),
+                    )
                 self._spawn(
                     handler,
                     n.gossip.topic, n.gossip.msg_id, n.gossip.payload, n.gossip.peer_id,
@@ -394,6 +442,19 @@ class Port:
                 self._spawn(self.on_peer_gone, n.peer_gone.peer_id)
             else:
                 self._buffer_early("peer_gone", (n.peer_gone.peer_id,))
+
+    _GOSSIP_TRACES_MAX = 512
+
+    def _stash_trace(self, msg_id: bytes, trace: tuple[str, int, int, float]) -> None:
+        self._gossip_traces[msg_id] = trace
+        while len(self._gossip_traces) > self._GOSSIP_TRACES_MAX:
+            self._gossip_traces.popitem(last=False)
+
+    def pop_trace(self, msg_id: bytes) -> tuple[str, int, int, float] | None:
+        """Claim the wire trace context delivered with ``msg_id``'s gossip
+        notification, or None when the sender omitted it (old node, interop
+        peer) — the caller then mints a fresh local trace."""
+        return self._gossip_traces.pop(msg_id, None)
 
     @staticmethod
     def _spawn(handler, *args) -> None:

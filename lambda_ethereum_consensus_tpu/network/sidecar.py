@@ -74,8 +74,19 @@ SUBSCRIBER_PX_EVERY = 10
 
 def _msg_id(topic: str, payload: bytes) -> bytes:
     """Gossip message id (sha256 prefix, like eth2's MsgID —
-    subscriptions.go SHA256-based MsgID)."""
+    subscriptions.go SHA256-based MsgID).  Deliberately EXCLUDES the
+    optional trace context: the same payload republished with a
+    different trace stamp must still dedup as one message."""
     return hashlib.sha256(topic.encode() + b"\x00" + payload).digest()[:20]
+
+
+def _copy_trace(dst, src) -> None:
+    """Field-wise copy between the p2p and port TraceCtx twins (distinct
+    generated types with identical shape)."""
+    dst.origin = src.origin
+    dst.trace_id = src.trace_id
+    dst.hop = src.hop
+    dst.origin_ts = src.origin_ts
 
 
 class Peer:
@@ -189,9 +200,14 @@ class Sidecar:
             self.node_id = hashlib.sha256(_pub(self.noise_static)).digest()
         self.handlers: set[str] = set()  # protocol ids served by the host
         self.seen: OrderedDict[bytes, None] = OrderedDict()
-        # msg_id -> (topic, payload, source); capped — an evicted entry means
-        # the verdict never came, so the message is simply never forwarded
-        self.pending_validation: OrderedDict[bytes, tuple[str, bytes, bytes]] = OrderedDict()
+        # msg_id -> (topic, payload, source, trace); capped — an evicted entry
+        # means the verdict never came, so the message is simply never forwarded
+        self.pending_validation: OrderedDict[bytes, tuple] = OrderedDict()
+        # per-peer gossip health (round 22 fleet observatory): duplicates
+        # dedup HERE and never reach the host, so first/duplicate counts
+        # must be tallied at the wire and exported via get_gossip_stats
+        self.delivery_stats: dict[tuple[bytes, str], list[int]] = {}
+        self.control_stats: dict[str, int] = {}  # graft/prune sent/recv
         # req_id -> (command id, peer node_id): responses only count from the
         # peer the request went to (no cross-peer response forgery)
         self.pending_requests: dict[bytes, tuple[bytes, bytes]] = {}
@@ -261,7 +277,10 @@ class Sidecar:
             await self._announce_sub(topic, False)
             await self.result(cmd.id, True)
         elif which == "publish":
-            await self.publish(cmd.publish.topic, cmd.publish.payload)
+            trace = (
+                cmd.publish.trace if cmd.publish.HasField("trace") else None
+            )
+            await self.publish(cmd.publish.topic, cmd.publish.payload, trace)
             await self.result(cmd.id, True)
         elif which == "validate_message":
             await self.finish_validation(
@@ -271,6 +290,12 @@ class Sidecar:
         elif which == "set_request_handler":
             self.handlers.add(cmd.set_request_handler.protocol_id)
             await self.result(cmd.id, True)
+        elif which == "get_gossip_stats":
+            import json
+
+            await self.result(
+                cmd.id, True, payload=json.dumps(self.gossip_stats()).encode()
+            )
         elif which == "send_request":
             await self.send_request(cmd)
         elif which == "send_response":
@@ -375,6 +400,17 @@ class Sidecar:
             self.peers[peer.node_id] = peer
             if peer.addr:
                 self.known_addrs.add(peer.addr)
+            # Re-announce our subscription set now that the peer is
+            # registered: a host subscribe processed while this handshake
+            # was in flight landed after our HELLO topic snapshot but
+            # before we appeared in self.peers, so its _announce_sub
+            # fan-out missed this link — without the repair the peer
+            # never learns the topic and mesh routing blackholes it.
+            for topic in sorted(self.subscriptions):
+                sub = p2p_pb2.P2PFrame()
+                sub.sub_opts.topic = topic
+                sub.sub_opts.subscribe = True
+                await peer.send_frame(sub)
             n = port_pb2.Notification()
             n.new_peer.peer_id = peer.node_id
             n.new_peer.addr = peer.addr
@@ -426,7 +462,12 @@ class Sidecar:
     async def handle_frame(self, peer: Peer, frame: p2p_pb2.P2PFrame) -> None:
         which = frame.WhichOneof("f")
         if which == "gossip":
-            await self.on_gossip(peer, frame.gossip.topic, frame.gossip.payload)
+            await self.on_gossip(
+                peer,
+                frame.gossip.topic,
+                frame.gossip.payload,
+                frame.gossip.trace if frame.gossip.HasField("trace") else None,
+            )
         elif which == "req":
             await self.on_req(peer, frame.req)
         elif which == "resp":
@@ -440,8 +481,14 @@ class Sidecar:
                 peer.topics.discard(frame.sub_opts.topic)
                 self.mesh.get(frame.sub_opts.topic, set()).discard(peer.node_id)
         elif which == "graft":
+            self.control_stats["graft_recv"] = (
+                self.control_stats.get("graft_recv", 0) + 1
+            )
             await self.on_graft(peer, frame.graft.topic)
         elif which == "prune":
+            self.control_stats["prune_recv"] = (
+                self.control_stats.get("prune_recv", 0) + 1
+            )
             self.mesh.get(frame.prune.topic, set()).discard(peer.node_id)
         elif which == "goodbye":
             peer.writer.close()
@@ -451,6 +498,9 @@ class Sidecar:
     async def _send_control(self, peer: Peer, kind: str, topic: str) -> None:
         frame = p2p_pb2.P2PFrame()
         getattr(frame, kind).topic = topic
+        self.control_stats[f"{kind}_sent"] = (
+            self.control_stats.get(f"{kind}_sent", 0) + 1
+        )
         try:
             await peer.send_frame(frame)
         except (OSError, ConnectionError, NoiseError):
@@ -573,10 +623,10 @@ class Sidecar:
             self.seen.popitem(last=False)
         return True
 
-    async def publish(self, topic: str, payload: bytes) -> None:
+    async def publish(self, topic: str, payload: bytes, trace=None) -> None:
         msg_id = _msg_id(topic, payload)
         self._mark_seen(msg_id)
-        await self._forward(topic, payload, exclude=None)
+        await self._forward(topic, payload, exclude=None, trace=trace)
 
     def _route_targets(self, topic: str, exclude: bytes | None) -> list[Peer]:
         """Mesh members for the topic; when the mesh is still empty (cold
@@ -590,19 +640,29 @@ class Sidecar:
             if nid != exclude and nid in self.peers
         ]
 
-    async def _forward(self, topic: str, payload: bytes, exclude: bytes | None) -> None:
+    async def _forward(
+        self, topic: str, payload: bytes, exclude: bytes | None, trace=None
+    ) -> None:
         frame = p2p_pb2.P2PFrame()
         frame.gossip.topic = topic
         frame.gossip.payload = payload
+        if trace is not None:
+            _copy_trace(frame.gossip.trace, trace)
         for peer in self._route_targets(topic, exclude):
             try:
                 await peer.send_frame(frame)
             except (OSError, ConnectionError, NoiseError):
                 pass
 
-    async def on_gossip(self, peer: Peer, topic: str, payload: bytes) -> None:
+    def _note_delivery(self, peer: Peer, topic: str, first: bool) -> None:
+        stat = self.delivery_stats.setdefault((peer.node_id, topic), [0, 0])
+        stat[0 if first else 1] += 1
+
+    async def on_gossip(self, peer: Peer, topic: str, payload: bytes, trace=None) -> None:
         msg_id = _msg_id(topic, payload)
-        if not self._mark_seen(msg_id):
+        first = self._mark_seen(msg_id)
+        self._note_delivery(peer, topic, first)
+        if not first:
             return
         if topic not in self.subscriptions:
             # mesh routing: messages flow along grafted links of
@@ -610,7 +670,7 @@ class Sidecar:
             return
         # host-gated validation before forwarding (reference: blocking topic
         # validator waiting on the Elixir verdict, subscriptions.go:95-135)
-        self.pending_validation[msg_id] = (topic, payload, peer.node_id)
+        self.pending_validation[msg_id] = (topic, payload, peer.node_id, trace)
         while len(self.pending_validation) > GOSSIP_SEEN_CAP:
             self.pending_validation.popitem(last=False)
         n = port_pb2.Notification()
@@ -618,18 +678,27 @@ class Sidecar:
         n.gossip.msg_id = msg_id
         n.gossip.payload = payload
         n.gossip.peer_id = peer.node_id
+        if trace is not None:
+            _copy_trace(n.gossip.trace, trace)
         await self.notify(n)
 
     async def finish_validation(self, msg_id: bytes, verdict: int) -> None:
         entry = self.pending_validation.pop(msg_id, None)
         if entry is None:
             return
-        topic, payload, source = entry
+        topic, payload, source, trace = entry
         peer = self.peers.get(source)
         if verdict == port_pb2.ValidateMessage.ACCEPT:
             if peer is not None:
                 peer.score = min(MAX_SCORE, peer.score + ACCEPT_REWARD)
-            await self._forward(topic, payload, exclude=source)
+            if trace is not None:
+                # the context survives the re-publish with one more hop:
+                # downstream admissions attribute latency to the ORIGIN
+                fwd = p2p_pb2.TraceCtx()
+                _copy_trace(fwd, trace)
+                fwd.hop = trace.hop + 1
+                trace = fwd
+            await self._forward(topic, payload, exclude=source, trace=trace)
         elif verdict == port_pb2.ValidateMessage.REJECT:
             # protocol violation: downscore, prune from every mesh, and
             # disconnect once past the graylist threshold (round 1 never
@@ -654,6 +723,44 @@ class Sidecar:
                         await self._send_control(peer, "prune", topic)
             if peer.score < GRAYLIST_SCORE:
                 await self._disconnect(peer)
+
+    def gossip_stats(self) -> dict:
+        """JSON-able per-peer gossip-health snapshot (round 22): delivery
+        first/duplicate counters per (peer, topic), live peer scores,
+        mesh membership and control-frame counts.  IHAVE/IWANT slots are
+        structurally present but zero on this wire — the bespoke mesh
+        has no gossip-id advertisement; the libp2p sidecar fills them."""
+        delivery: dict[str, dict[str, dict[str, int]]] = {}
+        for (nid, topic), (first, dup) in self.delivery_stats.items():
+            delivery.setdefault(nid.hex(), {})[topic] = {
+                "first": first, "duplicate": dup,
+            }
+        peers = {
+            nid.hex(): {
+                "score": round(peer.score, 4),
+                "addr": peer.addr,
+                "topics": sorted(peer.topics),
+            }
+            for nid, peer in self.peers.items()
+        }
+        control = dict(self.control_stats)
+        for key in ("ihave_sent", "ihave_recv", "iwant_sent", "iwant_recv",
+                    "iwant_served"):
+            control.setdefault(key, 0)
+        return {
+            "wire": "bespoke",
+            "peers": peers,
+            "delivery": delivery,
+            "mesh": {
+                topic: sorted(nid.hex() for nid in members)
+                for topic, members in self.mesh.items()
+            },
+            "ban_scores": {
+                nid.hex(): round(score, 4)
+                for nid, score in self.ban_scores.items()
+            },
+            "control": control,
+        }
 
     # ------------------------------------------------------------ req/resp
 

@@ -170,6 +170,12 @@ class Libp2pSidecar:
             protocol = cmd.set_request_handler.protocol_id
             self.host.set_stream_handler(protocol, self._serve_stream)
             await self.result(cmd.id, True)
+        elif which == "get_gossip_stats":
+            import json
+
+            await self.result(
+                cmd.id, True, payload=json.dumps(self.gossip.stats()).encode()
+            )
         elif which == "send_request":
             asyncio.ensure_future(self._send_request(cmd))
         elif which == "send_response":

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -25,7 +26,7 @@ from ..fork_choice import (
     on_tick,
 )
 from ..network import Port
-from ..network.gossip import TopicSubscription, topic_name
+from ..network.gossip import TopicSubscription, _topic_short, topic_name
 from ..network.peerbook import Peerbook
 from ..network.port import VERDICT_ACCEPT, VERDICT_IGNORE, VERDICT_REJECT
 from ..network.reqresp import BlockDownloader, ReqRespServer
@@ -109,6 +110,12 @@ class NodeConfig:
     # here.  Applied on EVERY network (re)build, so a sidecar restart
     # keeps its fault schedule and partition state.
     port_wrapper: object | None = None
+    # fleet-observatory identity (round 22): the label stamped into wire
+    # trace contexts on publish and onto this node's flight-recorder
+    # process row — co-resident fleet members stay distinguishable in
+    # ONE merged Perfetto export.  None = single-node (no stamping; the
+    # pre-round-22 wire byte for byte).
+    node_label: str | None = None
 
 
 class BeaconNode:
@@ -158,6 +165,16 @@ class BeaconNode:
         # which require the target block (hence a seed source) to be known
         self._vote_cell_disc: dict[tuple[int, bytes], tuple[bytes, bool]] = {}
         self._seen_subnet_votes: dict[int, set] = {}
+        # per-peer gossip-health plumbing (round 22): the last sidecar
+        # stats snapshot (served at /debug/peers), counter cursors for
+        # delta emission (the sidecar reports totals; a restart resets
+        # them), and the bounded poll task
+        self._gossip_stats: dict = {}
+        self._gossip_stats_ts: float = 0.0
+        self._gossip_poll_task: asyncio.Task | None = None
+        self._gossip_poll_mono: float = 0.0
+        self._peer_stat_cursor: dict[tuple[str, str], tuple[int, int]] = {}
+        self._control_cursor: dict[str, int] = {}
 
     # ------------------------------------------------------------- startup
 
@@ -485,6 +502,7 @@ class BeaconNode:
             self.port, block_topic, self._on_block_batch,
             ssz_type=SignedBeaconBlock, spec=self.spec, metrics=self.metrics,
             scheduler=sched, lane="block" if sched else None,
+            node=self.config.node_label,
         )
         await sub.start()
         self._subs.append(sub)
@@ -498,6 +516,7 @@ class BeaconNode:
             ssz_type=SignedAggregateAndProof, spec=self.spec,
             max_batch=ATT_BATCH, max_queue=ATT_QUEUE, metrics=self.metrics,
             scheduler=sched, lane="aggregate" if sched else None,
+            node=self.config.node_label,
         )
         await agg.start()
         self._subs.append(agg)
@@ -524,7 +543,7 @@ class BeaconNode:
                 ssz_type=Attestation, spec=self.spec,
                 max_batch=ATT_BATCH, max_queue=ATT_QUEUE, metrics=self.metrics,
                 scheduler=sched, lane="subnet" if sched else None,
-                sink=subnet_sink,
+                sink=subnet_sink, node=self.config.node_label,
             )
             await att_sub.start()
             self._subs.append(att_sub)
@@ -890,6 +909,7 @@ class BeaconNode:
                 # finalized checkpoint advanced this tick (never per-put)
                 self._persist_finality()
                 self._sample_device_telemetry()
+                self._maybe_poll_gossip_stats()
                 # one SLO evaluation per tick: publishes the slo_* gauges
                 # and appends the burn-rate snapshot the multi-window
                 # evaluation (and /debug/slo) reads — at 1 Hz the engine's
@@ -952,7 +972,7 @@ class BeaconNode:
                     self.pending.add_block(signed)  # self-import, no echo wait
                 await publish_ssz(
                     self.port, topic_name(digest, "beacon_block"),
-                    signed, self.spec,
+                    signed, self.spec, node=self.config.node_label,
                 )
             subscribed = set(self.config.attnet_subnets)
             cps = int(produced.get("committees_per_slot") or 1)
@@ -967,15 +987,104 @@ class BeaconNode:
                     await publish_ssz(
                         self.port,
                         topic_name(digest, f"beacon_attestation_{subnet}"),
-                        att, self.spec,
+                        att, self.spec, node=self.config.node_label,
                     )
             agg_topic = topic_name(digest, "beacon_aggregate_and_proof")
             for agg in produced.get("aggregates", ()):
-                await publish_ssz(self.port, agg_topic, agg, self.spec)
+                await publish_ssz(
+                    self.port, agg_topic, agg, self.spec,
+                    node=self.config.node_label,
+                )
         except Exception:
             # a wedged sidecar must not kill duty production; the next
             # slot's firing retries against whatever port is live then
             log.exception("duty publication failed")
+
+    # how often the sidecar's gossip-health snapshot is pulled (a full
+    # command round-trip — NOT every tick)
+    GOSSIP_STATS_POLL_S = 5.0
+
+    def _maybe_poll_gossip_stats(self) -> None:
+        """Kick one bounded gossip-stats poll per interval (round 22).
+        Off the tick's critical path: the round-trip runs as its own
+        task, and at most one is ever in flight."""
+        if self.port is None:
+            return
+        if self._gossip_poll_task is not None and not self._gossip_poll_task.done():
+            return
+        try:
+            interval = float(
+                os.environ.get("GOSSIP_STATS_POLL_S", "")
+                or self.GOSSIP_STATS_POLL_S
+            )
+        except ValueError:
+            interval = self.GOSSIP_STATS_POLL_S
+        now = time.monotonic()
+        if now - self._gossip_poll_mono < interval:
+            return
+        self._gossip_poll_mono = now
+        self._gossip_poll_task = asyncio.ensure_future(self._poll_gossip_stats())
+
+    async def _poll_gossip_stats(self) -> None:
+        """One sidecar stats round-trip -> per-peer health metrics +
+        the cached snapshot ``/debug/peers`` serves.  Every failure mode
+        (dead port, old sidecar returning ``{}``, command timeout) is
+        absorbed — peer health degrades to staleness, never to a tick
+        error."""
+        port = self.port
+        get_stats = getattr(port, "get_gossip_stats", None)
+        if port is None or get_stats is None or not getattr(port, "alive", False):
+            return
+        try:
+            stats = await get_stats()
+        except Exception:
+            return
+        if not stats:
+            return
+        self._gossip_stats = stats
+        self._gossip_stats_ts = time.time()
+        self._emit_gossip_health(stats)
+
+    def _emit_gossip_health(self, stats: dict) -> None:
+        """Sidecar totals -> metric families, by delta against the last
+        snapshot (a restarted sidecar resets to zero: the cursor then
+        re-baselines and counts the fresh totals).  Peer labels are
+        8-hex-char node-id prefixes — bounded cardinality, and the same
+        prefix ``/debug/fleet``'s propagation matrix keys on."""
+        m = self.metrics
+        if not m.enabled:
+            return
+        for peer, topics in (stats.get("delivery") or {}).items():
+            label = peer[:8]
+            for topic, cell in (topics or {}).items():
+                short = _topic_short(topic)
+                key = (peer, topic)
+                prev_first, prev_dup = self._peer_stat_cursor.get(key, (0, 0))
+                first = int(cell.get("first", 0))
+                dup = int(cell.get("duplicate", 0))
+                d_first, d_dup = first - prev_first, dup - prev_dup
+                if d_first < 0 or d_dup < 0:  # sidecar restart reset
+                    d_first, d_dup = first, dup
+                self._peer_stat_cursor[key] = (first, dup)
+                if d_first:
+                    m.inc("peer_gossip_first_total",
+                          value=d_first, peer=label, topic=short)
+                if d_dup:
+                    m.inc("peer_gossip_duplicate_total",
+                          value=d_dup, peer=label, topic=short)
+        for kind, count in (stats.get("control") or {}).items():
+            prev = self._control_cursor.get(kind, 0)
+            delta = int(count) - prev
+            if delta < 0:
+                delta = int(count)
+            self._control_cursor[kind] = int(count)
+            if delta:
+                m.inc("peer_gossip_control_total", value=delta, kind=kind)
+        for peer, info in (stats.get("peers") or {}).items():
+            m.set_gauge(
+                "peer_score", float((info or {}).get("score", 0.0)),
+                peer=peer[:8],
+            )
 
     def _sample_device_telemetry(self) -> None:
         """Per-tick device/cache gauges (ISSUE 2 tentpole): live device
@@ -1134,6 +1243,8 @@ class BeaconNode:
             self.pending.stop()
         if self._duty_task is not None:
             self._duty_task.cancel()
+        if self._gossip_poll_task is not None:
+            self._gossip_poll_task.cancel()
         for t in self._tasks:
             t.cancel()
         if self.api is not None:

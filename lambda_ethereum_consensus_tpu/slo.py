@@ -54,6 +54,7 @@ from .telemetry import Metrics, get_metrics
 __all__ = [
     "DEFAULT_SLOS",
     "DEFAULT_WINDOWS",
+    "FLEET_SLOS",
     "SOAK_SLOS",
     "STORAGE_SLOS",
     "SloDef",
@@ -223,6 +224,27 @@ SOAK_SLOS = DEFAULT_SLOS + STORAGE_SLOS + (
         # EXPECTED to diverge for their whole window, so the budget is
         # sized to the scenario windows, not to steady-state operation
         "fleet head-divergence episodes resolve within the soak window",
+    ),
+)
+
+
+# Fleet-observatory rows (round 22): cross-node propagation health,
+# judged by the fleet aggregator over its MERGED view.  Propagation is
+# measured from the wire trace context's origin timestamp to remote
+# admission, so the budget is slot-phase-relative: a block must be
+# fleet-wide well inside the attestation deadline (1/3 slot of the
+# 2 s-per-slot soak profile).  Per-peer delivery keeps a looser bound —
+# one slow mesh link is a peer problem before it is a fleet problem.
+FLEET_SLOS = SOAK_SLOS + (
+    SloDef(
+        "fleet_propagation_p95", "fleet_block_propagation_seconds",
+        0.95, 0.75,
+        "origin publish -> remote admission for gossip blocks, fleet-wide",
+    ),
+    SloDef(
+        "peer_delivery_p95", "peer_delivery_latency_seconds",
+        0.95, 1.5,
+        "per-peer gossip delivery latency (origin publish -> local first delivery)",
     ),
 )
 

@@ -156,6 +156,13 @@ _HELP = {
     "chaos_recovery_seconds": "post-fault-window recovery: burn rates back under threshold and fleet reconverged",
     "fleet_head_divergence_seconds": "wall time fleet members spent on divergent heads before reconverging",
     "fleet_head_lag_slots": "head-slot spread across fleet members (lead head slot minus laggard's)",
+    "fleet_block_propagation_seconds": "origin publish -> remote admission wall time for gossip blocks carrying a wire trace context",
+    "fleet_scrape_errors_total": "fleet-observatory scrapes that timed out / errored, by member",
+    "peer_delivery_latency_seconds": "origin publish -> local first delivery per peer and topic (wire trace context required)",
+    "peer_gossip_first_total": "messages a peer delivered first (useful deliveries), by peer and topic",
+    "peer_gossip_duplicate_total": "already-seen messages a peer delivered, by peer and topic",
+    "peer_gossip_control_total": "gossip control frames, by direction-qualified kind (graft_sent, ihave_recv, iwant_served, ...)",
+    "peer_score": "sidecar-reported peer score (ban threshold < 0)",
     "pipeline_drain_restarts_total": "supervised ingest drain-loop restarts",
     "slot_block_arrival_offset_seconds": "gossip block arrival offset into its slot",
     "attestation_admit_apply_seconds": "attestation gossip admission -> fork-choice apply",

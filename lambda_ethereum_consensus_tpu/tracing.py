@@ -46,6 +46,7 @@ import itertools
 import os
 import threading
 import time
+import zlib
 from collections import deque
 
 from .telemetry import get_metrics, telemetry_enabled
@@ -57,11 +58,16 @@ __all__ = [
     "ItemTrace",
     "SlotClock",
     "get_recorder",
+    "merge_chrome_traces",
     "new_trace",
     "record_verify_batch",
     "observe_block_arrival",
     "observe_head_update",
 ]
+
+# Process-row label for events recorded without a node dimension — the
+# single-node default, and the pid-1 row every pre-round-22 export used.
+DEFAULT_NODE = "beacon-node"
 
 # Ring capacity in ENTRIES: one entry per TERMINATED item trace (its
 # whole buffered walk rides in one composite slot), per batch span, per
@@ -117,7 +123,7 @@ class FlightRecorder:
     """Bounded ring buffer of trace entries (overwrite-oldest).
 
     Entries are compact tuples ``(ts_us, kind, trace_id, name, dur_us,
-    args)``: ``span`` is a complete batch-scoped slice with duration,
+    args, node)``: ``span`` is a complete batch-scoped slice with duration,
     ``trace_id`` 0 marks a global instant (degraded flips, drain
     restarts), and ``item`` is one COMPOSITE terminated item trace —
     its buffered ``(monotonic, name, args)`` stage events ride in the
@@ -180,6 +186,7 @@ class FlightRecorder:
         args: dict | None = None,
         ts_us: int | None = None,
         dur_us: int | None = None,
+        node: str | None = None,
     ) -> None:
         if not self._enabled:
             return
@@ -190,7 +197,7 @@ class FlightRecorder:
             if len(self._events) == self._capacity:
                 self._dropped += 1
             self._appended += 1
-            self._events.append((ts_us, kind, trace_id, name, dur_us, args))
+            self._events.append((ts_us, kind, trace_id, name, dur_us, args, node))
 
     # composite item entries are appended by ItemTrace.end (inlined
     # there — the hot path's one ring touch per terminated item)
@@ -214,13 +221,15 @@ class FlightRecorder:
         with self._lock:
             events = list(self._events)
         out = []
-        for ts, kind, tid, name, dur, args in events:
+        for ts, kind, tid, name, dur, args, node in events:
             if kind != "item":
                 out.append({"ts_us": ts, "kind": kind, "trace_id": tid,
-                            "name": name, "dur_us": dur, "args": args})
+                            "name": name, "dur_us": dur, "args": args,
+                            "node": node})
                 continue
             out.append({"ts_us": ts, "kind": "begin", "trace_id": tid,
-                        "name": name, "dur_us": None, "args": None})
+                        "name": name, "dur_us": None, "args": None,
+                        "node": node})
             for tm, ev_name, ev_args in args:
                 if ev_name is _END:
                     # terminal events store (stage, shared_args): merge
@@ -232,17 +241,17 @@ class FlightRecorder:
                     out.append({
                         "ts_us": int(tm * 1e6), "kind": "end",
                         "trace_id": tid, "name": name,
-                        "dur_us": None, "args": merged,
+                        "dur_us": None, "args": merged, "node": node,
                     })
                 else:
                     out.append({
                         "ts_us": int(tm * 1e6), "kind": "inst",
                         "trace_id": tid, "name": ev_name,
-                        "dur_us": None, "args": ev_args,
+                        "dur_us": None, "args": ev_args, "node": node,
                     })
         return out
 
-    def chrome(self) -> dict:
+    def chrome(self, node: str | None = None) -> dict:
         """The ring as Chrome trace-event JSON (Perfetto-loadable).
 
         Item events render as nestable async slices keyed by trace id
@@ -252,29 +261,100 @@ class FlightRecorder:
         ``verify`` instant carries the matching ``batch`` id); global
         events (trace id 0) render as scoped instants.  A trace whose
         ``begin`` was overwritten by the ring still exports its
-        surviving events — Perfetto tolerates orphan async events."""
-        out = [{
-            "ph": "M", "name": "process_name", "pid": 1,
-            "args": {"name": "beacon-node"},
-        }]
+        surviving events — Perfetto tolerates orphan async events.
+
+        Round 22: every event lands on its node's OWN process row — the
+        pid is a stable crc32 derivation of the node label (so two
+        nodes' independent exports agree and a fleet merge never
+        collides rows), node-less events keep the historical pid-1
+        "beacon-node" row, and ``flow_s``/``flow_f`` entries render as
+        Perfetto flow arrows (``ph`` s/f sharing a global id) linking a
+        publish on the origin's row to the remote admit on the
+        receiver's.  ``node=`` filters the export to one node's events
+        (the per-member view the fleet aggregator scrapes)."""
+        events = self.snapshot()
+        if node is not None:
+            events = [
+                ev for ev in events
+                if (ev.get("node") or DEFAULT_NODE) == node
+            ]
+        pids = _assign_pids({ev.get("node") for ev in events})
+        out = [
+            {"ph": "M", "name": "process_name", "pid": pid,
+             "args": {"name": label if label is not None else DEFAULT_NODE}}
+            for label, pid in sorted(
+                pids.items(), key=lambda kv: kv[1]
+            )
+        ]
         ph_of = {"begin": "b", "inst": "n", "end": "e"}
-        for ev in self.snapshot():
+        for ev in events:
             ts, kind, tid, name = (
                 ev["ts_us"], ev["kind"], ev["trace_id"], ev["name"]
             )
+            pid = pids[ev.get("node")]
             if kind == "span":
-                e = {"ph": "X", "ts": ts, "dur": ev["dur_us"] or 1, "pid": 1,
+                e = {"ph": "X", "ts": ts, "dur": ev["dur_us"] or 1, "pid": pid,
                      "tid": "batch_verify", "name": name, "cat": "batch"}
+            elif kind in ("flow_s", "flow_f"):
+                # cross-node propagation arrow: origin publish (s) ->
+                # remote admit (f); both ends share the global flow id
+                e = {"ph": "s" if kind == "flow_s" else "f", "ts": ts,
+                     "pid": pid, "tid": "gossip", "cat": "gossip_flow",
+                     "id": (ev["args"] or {}).get("flow", format(tid, "x")),
+                     "name": name}
+                if kind == "flow_f":
+                    e["bp"] = "e"  # bind to the enclosing slice's end
             elif tid == 0:  # global instant (no owning trace)
-                e = {"ph": "i", "ts": ts, "pid": 1, "tid": "events",
+                e = {"ph": "i", "ts": ts, "pid": pid, "tid": "events",
                      "name": name, "s": "g"}
             else:  # item stage event (nestable async, keyed by trace id)
-                e = {"ph": ph_of.get(kind, "n"), "ts": ts, "pid": 1,
+                e = {"ph": ph_of.get(kind, "n"), "ts": ts, "pid": pid,
                      "cat": "item", "id": format(tid, "x"), "name": name}
             if ev["args"]:
                 e["args"] = ev["args"]
             out.append(e)
         return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def _assign_pids(nodes) -> dict:
+    """Stable pid per node label.  ``None`` (node-less events) keeps the
+    historical pid 1; named nodes hash their label (crc32) into a wide
+    pid space so INDEPENDENT exports — two nodes each exporting their
+    own ring — assign the same pid to the same node and a fleet merge
+    needs no renumbering.  Same-export collisions probe upward
+    deterministically (sorted label order)."""
+    pids = {None: 1}
+    used = {1}
+    for label in sorted(n for n in nodes if n is not None):
+        pid = 2 + (zlib.crc32(label.encode()) % 1_000_000)
+        while pid in used:
+            pid += 1
+        pids[label] = pid
+        used.add(pid)
+    return pids
+
+
+def merge_chrome_traces(docs) -> dict:
+    """Merge per-node Chrome exports into ONE fleet document.
+
+    Because :meth:`FlightRecorder.chrome` derives pids from node labels
+    (not process-local counters), a merge is a concatenation: process
+    rows stay distinct per node, duplicate ``process_name`` metadata
+    (the same node scraped twice, or pid-1 rows from several members)
+    collapses to one, and cross-node flow arrows — whose global ids the
+    wire trace context carried — connect across the member documents."""
+    events: list = []
+    seen_meta: set = set()
+    for doc in docs:
+        for ev in (doc or {}).get("traceEvents", ()):
+            if ev.get("ph") == "M":
+                key = (ev.get("pid"), ev.get("name"),
+                       str((ev.get("args") or {}).get("name")))
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 # sentinel marking a trace's terminal buffered event (identity-compared
@@ -294,13 +374,21 @@ class ItemTrace:
     no ring traffic) and the whole walk lands in the flight recorder as
     ONE entry when the trace terminates."""
 
-    __slots__ = ("trace_id", "label", "t0", "_rec", "_ev", "_done")
+    __slots__ = ("trace_id", "label", "t0", "node", "_rec", "_ev", "_done")
 
-    def __init__(self, rec: FlightRecorder, trace_id: int, label: str, t0: float):
+    def __init__(
+        self,
+        rec: FlightRecorder,
+        trace_id: int,
+        label: str,
+        t0: float,
+        node: str | None = None,
+    ):
         self._rec = rec
         self.trace_id = trace_id
         self.label = label
         self.t0 = t0
+        self.node = node
         self._ev: list = []
         self._done = False
 
@@ -345,7 +433,7 @@ class ItemTrace:
                 rec._appended += 1
                 rec._events.append((
                     int(self.t0 * 1e6), "item", self.trace_id, self.label,
-                    None, self._ev,
+                    None, self._ev, self.node,
                 ))
 
 
@@ -366,18 +454,20 @@ def get_recorder() -> FlightRecorder:
     return rec
 
 
-def new_trace(label: str) -> ItemTrace | None:
+def new_trace(label: str, node: str | None = None) -> ItemTrace | None:
     """Mint one item trace at gossip admission.  The admission instant
     (``t0``) and label become the trace's ``begin`` event when the
-    composite entry lands in the ring at termination.  Returns ``None``
-    when tracing is off: the hot path pays one module-global read and
-    one attribute check, nothing else."""
+    composite entry lands in the ring at termination.  ``node`` places
+    the trace on that node's process row at export (in-process fleets
+    share one recorder; the label keeps their walks apart).  Returns
+    ``None`` when tracing is off: the hot path pays one module-global
+    read and one attribute check, nothing else."""
     rec = _RECORDER
     if rec is None:
         rec = get_recorder()
     if not rec._enabled:
         return None
-    return ItemTrace(rec, next(rec._ids), label, time.monotonic())
+    return ItemTrace(rec, next(rec._ids), label, time.monotonic(), node)
 
 
 def record_verify_batch(
@@ -414,6 +504,7 @@ def record_verify_batch(
                 "n_members": len(members),
             },
             ts_us=int(t0 * 1e6), dur_us=max(int(dur_s * 1e6), 1),
+            node=members[0].node,
         )
         # ONE reverse-link dict shared by every member's verify event
         verify_args = {"batch": batch_id, "path": path}

@@ -2,16 +2,23 @@
 the real node stack, assert recovery against the SLO burn-rate engine,
 and record a validated pass/fail artifact (``SOAK_r*.json``).
 
-The five scenarios (``chaos/scenarios.py``) exercise REAL components —
+The scenarios (``chaos/scenarios.py``) exercise REAL components —
 the priority ingest scheduler under seeded message chaos and flood
 storms, multi-node fleets gossiping over the real loopback wire through
 the fault-injecting ``ChaosPort`` (partitions with healing, equivocating
 blocks, malformed/bad-signature aggregates, subnet floods, sidecar
-kill/restart, checkpoint-sync and resume-from-db churn).  The gate then
-evaluates :data:`~lambda_ethereum_consensus_tpu.slo.SOAK_SLOS` (the
-node's budget set plus the round-19 recovery/divergence rows)
-cumulatively, exactly the way ``scripts/slo_check.py`` gates the load
-profile.
+kill/restart, checkpoint-sync and resume-from-db churn, and the round-22
+fleet-observatory run: cross-node trace propagation, per-peer gossip
+health scrapes, scrape-failure containment).  The gate then evaluates
+:data:`~lambda_ethereum_consensus_tpu.slo.FLEET_SLOS` (the node's
+budget set plus the round-19 recovery/divergence rows and the round-22
+fleet propagation/peer-delivery rows) cumulatively, exactly the way
+``scripts/slo_check.py`` gates the load profile.
+
+``--scenario fleet_obs --json FLEETOBS_r01.json`` is the round-22
+fleet-observatory gate profile (``make fleet-obs-smoke``): the recorded
+knobs travel in the artifact, so ``--validate FLEETOBS_r01.json``
+requires exactly the fleet_obs record.
 
 Three layers of red:
 
@@ -58,11 +65,13 @@ from lambda_ethereum_consensus_tpu.chaos.scenarios import (  # noqa: E402
     ScenarioContext,
     run_scenario,
 )
-from lambda_ethereum_consensus_tpu.slo import SOAK_SLOS, SloEngine  # noqa: E402
+from lambda_ethereum_consensus_tpu.slo import FLEET_SLOS, SloEngine  # noqa: E402
 from lambda_ethereum_consensus_tpu.telemetry import get_metrics  # noqa: E402
 from lambda_ethereum_consensus_tpu.tracing import get_recorder  # noqa: E402
 
-SCENARIO_ORDER = ("steady", "storm", "partition", "equivocation", "churn")
+SCENARIO_ORDER = (
+    "steady", "storm", "partition", "equivocation", "churn", "fleet_obs",
+)
 
 # which scenarios drive which SLO rows: a row is EXERCISED (empty ==
 # violation) when any of its driving scenarios ran; otherwise UNCHECKED
@@ -74,11 +83,17 @@ EXERCISED_BY = {
     "head_update_delay_p95": {"steady"},
     "gossip_drain_p95": {"partition", "equivocation", "churn"},
     "block_transition_p95": {"partition", "equivocation", "churn"},
-    "chaos_recovery_p95": {"storm", "partition", "equivocation", "churn"},
-    "fleet_divergence_p95": {"partition"},
+    "chaos_recovery_p95": {
+        "storm", "partition", "equivocation", "churn", "fleet_obs",
+    },
+    "fleet_divergence_p95": {"partition", "fleet_obs"},
     # round 20: every DB resume (incl. the churn power-loss reboot)
     # observes its WAL-replay + root-verification wall time
     "storage_recovery_p95": {"churn"},
+    # round 22: the observatory scenario drives the fleet-level rows —
+    # origin publish -> remote admission over the real wire
+    "fleet_propagation_p95": {"fleet_obs"},
+    "peer_delivery_p95": {"fleet_obs"},
 }
 
 
@@ -174,7 +189,7 @@ def parse_budget_overrides(pairs: list[str]) -> dict[str, float]:
 
 
 def build_slos(overrides: dict[str, float]):
-    known = {s.name for s in SOAK_SLOS}
+    known = {s.name for s in FLEET_SLOS}
     unknown = sorted(set(overrides) - known)
     if unknown:
         _usage_error(
@@ -185,7 +200,7 @@ def build_slos(overrides: dict[str, float]):
         return tuple(
             dataclasses.replace(s, budget=overrides[s.name])
             if s.name in overrides else s
-            for s in SOAK_SLOS
+            for s in FLEET_SLOS
         )
     except ValueError as e:
         _usage_error(str(e))

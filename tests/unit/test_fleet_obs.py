@@ -1,0 +1,494 @@
+"""Round-22 fleet observatory: cross-node trace contexts on the wire
+(mixed-version round-trips stay clean — the round-19 wire-conformance
+MUSTs), per-node Perfetto process rows with stable pids and cross-node
+flow arrows, per-peer gossip health deltas, and the fleet scrape loop's
+failure containment (hang / 500 / dead member -> stale-marked rows,
+never an exception or a wedged pass)."""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+from lambda_ethereum_consensus_tpu.chaos.fleet import (
+    FleetObservatory,
+    _parse_gauges,
+)
+from lambda_ethereum_consensus_tpu.network import Port
+from lambda_ethereum_consensus_tpu.network.port import VERDICT_ACCEPT
+from lambda_ethereum_consensus_tpu.node.node import BeaconNode
+from lambda_ethereum_consensus_tpu.telemetry import Metrics
+from lambda_ethereum_consensus_tpu.tracing import (
+    FlightRecorder,
+    _assign_pids,
+    merge_chrome_traces,
+)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def start_pair(fork_digest=b"\xba\xa4\xda\x96"):
+    recver = await Port.start(fork_digest=fork_digest)
+    sender = await Port.start(fork_digest=fork_digest)
+    new_peer = asyncio.get_running_loop().create_future()
+
+    def on_new_peer(peer_id, addr):
+        if not new_peer.done():
+            new_peer.set_result(peer_id)
+
+    sender.on_new_peer = on_new_peer
+    await sender.add_peer(f"127.0.0.1:{recver.listen_port}")
+    peer_id = await asyncio.wait_for(new_peer, 10)
+    return sender, recver, peer_id
+
+
+async def publish_until(sender, topic, payload, done, *, trace=None):
+    """Republish until the receiver-side future resolves — the same
+    retry idiom the chaos scenarios use, so a publish racing the
+    subscription announcement can't flake the test."""
+    for _ in range(25):
+        await sender.publish(topic, payload, trace=trace)
+        try:
+            return await asyncio.wait_for(asyncio.shield(done), 0.8)
+        except asyncio.TimeoutError:
+            continue
+    return await asyncio.wait_for(done, 5)
+
+
+# ------------------------------------------------- trace ctx on the wire
+
+def test_trace_ctx_rides_the_wire_and_pops_once():
+    """A stamped publish delivers its trace context through the
+    receiver's side-table, exactly once per message id."""
+
+    async def main():
+        sender, recver, _peer = await start_pair()
+        got = asyncio.get_running_loop().create_future()
+
+        async def on_gossip(topic, msg_id, payload, from_peer):
+            await recver.validate_message(msg_id, VERDICT_ACCEPT)
+            if not got.done():
+                got.set_result((msg_id, recver.pop_trace(msg_id)))
+
+        await recver.subscribe("/eth2/test/topic/ssz_snappy", on_gossip)
+        await asyncio.sleep(0.2)
+        msg_id, wire = await publish_until(
+            sender, "/eth2/test/topic/ssz_snappy", b"traced body", got,
+            trace=("n0", 42, 0, 123.5),
+        )
+        assert wire == ("n0", 42, 0, 123.5)
+        # popped means popped: the side-table entry is consumed
+        assert recver.pop_trace(msg_id) is None
+        await sender.close()
+        await recver.close()
+
+    run(main())
+
+
+def test_mixed_version_roundtrip_without_trace():
+    """A peer that omits the optional trace field (an older build) must
+    decode cleanly on the new side — the handler sees the message, the
+    side-table stays empty, and a fresh local trace is the correct
+    fallback (the round-19 wire-conformance MUST)."""
+
+    async def main():
+        sender, recver, _peer = await start_pair()
+        got = asyncio.get_running_loop().create_future()
+
+        async def on_gossip(topic, msg_id, payload, from_peer):
+            await recver.validate_message(msg_id, VERDICT_ACCEPT)
+            if not got.done():
+                got.set_result((payload, recver.pop_trace(msg_id)))
+
+        await recver.subscribe("/eth2/test/topic/ssz_snappy", on_gossip)
+        await asyncio.sleep(0.2)
+        # the 2-arg publish is the old wire shape: no trace submessage
+        payload, wire = await publish_until(
+            sender, "/eth2/test/topic/ssz_snappy", b"plain body", got
+        )
+        assert payload == b"plain body"
+        assert wire is None
+        # and the reverse direction: a NEW-side stamped publish toward a
+        # handler that never reads the side-table (an older host) still
+        # delivers the payload unchanged
+        got2 = asyncio.get_running_loop().create_future()
+
+        async def old_style(topic, msg_id, payload, from_peer):
+            await recver.validate_message(msg_id, VERDICT_ACCEPT)
+            if not got2.done():
+                got2.set_result(payload)
+
+        await recver.subscribe("/eth2/test/other/ssz_snappy", old_style)
+        await asyncio.sleep(0.2)
+        assert await publish_until(
+            sender, "/eth2/test/other/ssz_snappy", b"stamped", got2,
+            trace=("n9", 7, 2, 1.0),
+        ) == b"stamped"
+        await sender.close()
+        await recver.close()
+
+    run(main())
+
+
+def test_pb2_trace_field_is_optional_both_sides():
+    """Wire schema: the trace submessage has explicit presence — absent
+    on old payloads, preserved on new ones."""
+    from lambda_ethereum_consensus_tpu.network.proto import p2p_pb2, port_pb2
+
+    old = p2p_pb2.GossipMsg(topic="/t", payload=b"x")
+    parsed = p2p_pb2.GossipMsg.FromString(old.SerializeToString())
+    assert not parsed.HasField("trace")
+
+    new = p2p_pb2.GossipMsg(topic="/t", payload=b"x")
+    new.trace.origin = "n0"
+    new.trace.trace_id = 9
+    new.trace.hop = 1
+    new.trace.origin_ts = 2.5
+    parsed = p2p_pb2.GossipMsg.FromString(new.SerializeToString())
+    assert parsed.HasField("trace")
+    assert (parsed.trace.origin, parsed.trace.trace_id,
+            parsed.trace.hop, parsed.trace.origin_ts) == ("n0", 9, 1, 2.5)
+
+    cmd = port_pb2.Command()
+    cmd.publish.topic = "/t"
+    cmd.publish.payload = b"x"
+    assert not cmd.publish.HasField("trace")
+    cmd.publish.trace.origin = "n1"
+    assert port_pb2.Command.FromString(
+        cmd.SerializeToString()
+    ).publish.HasField("trace")
+
+
+def test_republish_with_new_stamp_dedups_and_counts_duplicate():
+    """The message id excludes the trace context, so a re-publish with a
+    fresh stamp is ONE message: the handler fires once and the
+    receiver's sidecar counts the duplicate against the sending peer."""
+
+    async def main():
+        sender, recver, _peer = await start_pair()
+        deliveries = []
+        seen = asyncio.get_running_loop().create_future()
+
+        async def on_gossip(topic, msg_id, payload, from_peer):
+            await recver.validate_message(msg_id, VERDICT_ACCEPT)
+            deliveries.append(payload)
+            if not seen.done():
+                seen.set_result(True)
+
+        await recver.subscribe("/eth2/test/topic/ssz_snappy", on_gossip)
+        await asyncio.sleep(0.2)
+        await publish_until(
+            sender, "/eth2/test/topic/ssz_snappy", b"same body", seen,
+            trace=("n0", 1, 0, 1.0),
+        )
+
+        def cell_of(stats):
+            return stats.get("delivery", {}).get(
+                sender.node_id.hex(), {}
+            ).get("/eth2/test/topic/ssz_snappy", {})
+
+        # re-publish with a FRESH stamp until the receiver's sidecar has
+        # counted it as a duplicate of the same message id
+        stats = {}
+        for _ in range(25):
+            await sender.publish(
+                "/eth2/test/topic/ssz_snappy", b"same body",
+                trace=("n0", 2, 0, 2.0),
+            )
+            await asyncio.sleep(0.2)
+            stats = await recver.get_gossip_stats()
+            if cell_of(stats).get("duplicate", 0) >= 1:
+                break
+        assert deliveries == [b"same body"]
+        assert stats["wire"] == "bespoke"
+        cell = cell_of(stats)
+        assert cell["first"] == 1
+        assert cell["duplicate"] >= 1
+        # the control inventory is structurally present on the bespoke
+        # wire (zeros — there is no IHAVE/IWANT machinery to count)
+        for key in ("ihave_sent", "ihave_recv", "iwant_sent", "iwant_recv"):
+            assert key in stats["control"]
+        await sender.close()
+        await recver.close()
+
+    run(main())
+
+
+def test_trace_side_table_is_bounded():
+    port = Port()
+    for i in range(600):
+        port._stash_trace(i.to_bytes(4, "big"), ("n0", i, 0, 0.0))
+    assert len(port._gossip_traces) == 512
+    # the oldest were evicted, the newest survive
+    assert port.pop_trace((0).to_bytes(4, "big")) is None
+    assert port.pop_trace((599).to_bytes(4, "big")) == ("n0", 599, 0, 0.0)
+
+
+# ------------------------------------------------- per-node trace export
+
+def test_chrome_exports_per_node_process_rows_with_stable_pids():
+    rec = FlightRecorder(capacity=64, enabled=True)
+    rec.record("inst", 1, "on_n0", node="n0")
+    rec.record("inst", 2, "on_n1", node="n1")
+    rec.record("inst", 0, "global_instant")  # node-less -> pid 1
+    doc = rec.chrome()
+    metas = {
+        e["args"]["name"]: e["pid"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert metas["beacon-node"] == 1
+    expected = _assign_pids({"n0", "n1"})
+    assert metas["n0"] == expected["n0"] != 1
+    assert metas["n1"] == expected["n1"] != 1
+    # label-derived pids: an INDEPENDENT export of the same label agrees
+    rec2 = FlightRecorder(capacity=8, enabled=True)
+    rec2.record("inst", 3, "other_event", node="n0")
+    metas2 = {
+        e["args"]["name"]: e["pid"]
+        for e in rec2.chrome()["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert metas2["n0"] == metas["n0"]
+    # node= filters to one member's events
+    only_n0 = rec.chrome(node="n0")
+    names = [
+        e["name"] for e in only_n0["traceEvents"] if e.get("ph") != "M"
+    ]
+    assert names == ["on_n0"]
+
+
+def test_flow_arrows_pair_and_merge_dedups_metadata():
+    rec_a = FlightRecorder(capacity=16, enabled=True)
+    rec_b = FlightRecorder(capacity=16, enabled=True)
+    rec_a.record("flow_s", 7, "publish:beacon_block",
+                 {"flow": "n0:7"}, node="n0")
+    rec_b.record("flow_f", 9, "admit:beacon_block",
+                 {"flow": "n0:7"}, node="n1")
+    merged = merge_chrome_traces(
+        [rec_a.chrome(node="n0"), rec_b.chrome(node="n1")]
+    )
+    flows = [
+        e for e in merged["traceEvents"] if e.get("cat") == "gossip_flow"
+    ]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    assert len({e["id"] for e in flows}) == 1  # both ends share the flow id
+    assert len({e["pid"] for e in flows}) == 2  # ...across two process rows
+    fin = next(e for e in flows if e["ph"] == "f")
+    assert fin["bp"] == "e"
+    # each per-node export carries a pid-1 meta; the merge keeps ONE
+    pid1_metas = [
+        e for e in merged["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+        and e["pid"] == 1
+    ]
+    assert len(pid1_metas) == 1
+
+
+# ------------------------------------------------- scrape containment
+
+def _hang_handler(release):
+    async def handler(reader, writer):
+        try:
+            await release.wait()
+        finally:
+            writer.close()
+
+    return handler
+
+
+async def _http_500(reader, writer):
+    await reader.readline()
+    writer.write(b"HTTP/1.1 500 boom\r\nConnection: close\r\n\r\nnope")
+    try:
+        await writer.drain()
+    finally:
+        writer.close()
+
+
+def test_scrape_containment_hang_500_and_dead_member():
+    """Every failure mode yields a stale-marked row plus one counted
+    scrape error — never an exception out of the pass, and a hung
+    member costs at most its own timeout, not the loop."""
+
+    async def main():
+        release = asyncio.Event()
+        hang = await asyncio.start_server(
+            _hang_handler(release), "127.0.0.1", 0
+        )
+        err = await asyncio.start_server(_http_500, "127.0.0.1", 0)
+        # a dead member: bind, learn the port, close the listener
+        dead = await asyncio.start_server(_http_500, "127.0.0.1", 0)
+        dead_port = dead.sockets[0].getsockname()[1]
+        dead.close()
+        await dead.wait_closed()
+
+        m = Metrics(enabled=True)
+        obs = FleetObservatory(
+            members=[
+                ("hang", "127.0.0.1", hang.sockets[0].getsockname()[1]),
+                ("boom", "127.0.0.1", err.sockets[0].getsockname()[1]),
+                ("dead", "127.0.0.1", dead_port),
+            ],
+            timeout_s=0.4,
+            metrics=m,
+        )
+        try:
+            view = await obs.scrape_once()
+        finally:
+            release.set()
+            hang.close()
+            err.close()
+            await hang.wait_closed()
+            await err.wait_closed()
+        rows = {r["member"]: r for r in view["members"]}
+        for name in ("hang", "boom", "dead"):
+            assert rows[name]["stale"] is True
+            assert rows[name]["error"]
+            assert m.get("fleet_scrape_errors_total", member=name) == 1
+        assert "500" in rows["boom"]["error"]
+        assert view["scrapes"] == 1
+        # failures contribute nothing to the propagation matrix
+        assert view["propagation_matrix"] == {}
+
+    run(main())
+
+
+_CANNED = {
+    "/metrics": "# HELP fork_choice_head_slot x\n"
+                "fork_choice_head_slot 7\n"
+                "peers_connection_count 3\n",
+    "/debug/slo": {"data": {
+        "ok": False,
+        "slos": [
+            {"slo": "x_p95", "ok": False},
+            {"slo": "y_p95", "ok": True},
+        ],
+    }},
+    "/debug/slot": {"data": {
+        "slot": 9, "head_slot": 7, "head_root": "0xabc",
+    }},
+    "/debug/peers": {"data": {"stats": {
+        "wire": "bespoke",
+        "peers": {"deadbeef11223344": {"score": -0.5, "topics": ["/t"]}},
+        "delivery": {"deadbeef11223344": {
+            "/eth2/00000000/beacon_block/ssz_snappy": {
+                "first": 2, "duplicate": 1,
+            },
+        }},
+    }}},
+}
+
+
+async def _canned_member(reader, writer):
+    line = await reader.readline()
+    path = line.split()[1].decode().split("?")[0]
+    while (await reader.readline()) not in (b"\r\n", b""):
+        pass
+    if path.startswith("/debug/trace"):
+        body = json.dumps({"traceEvents": []}).encode()
+    else:
+        canned = _CANNED[path]
+        body = (
+            canned.encode() if isinstance(canned, str)
+            else json.dumps(canned).encode()
+        )
+    writer.write(
+        b"HTTP/1.1 200 OK\r\nConnection: close\r\n\r\n" + body
+    )
+    try:
+        await writer.drain()
+    finally:
+        writer.close()
+
+
+def test_scrape_merges_member_row_and_propagation_matrix():
+    async def main():
+        srv = await asyncio.start_server(_canned_member, "127.0.0.1", 0)
+        m = Metrics(enabled=True)
+        obs = FleetObservatory(
+            members=[("m0", "127.0.0.1", srv.sockets[0].getsockname()[1])],
+            timeout_s=2.0,
+            metrics=m,
+        )
+        try:
+            view = await obs.scrape_once()
+        finally:
+            srv.close()
+            await srv.wait_closed()
+        row = view["members"][0]
+        assert row["stale"] is False and row["error"] is None
+        assert row["slot"] == 9 and row["head_slot"] == 7
+        assert row["head_root"] == "0xabc"
+        assert row["slo_ok"] is False
+        assert row["slo_violations"] == ["x_p95"]
+        assert row["gauges"] == {
+            "fork_choice_head_slot": 7.0, "peers_connection_count": 3.0,
+        }
+        assert row["peers"] == {
+            "deadbeef": {"score": -0.5, "topics": ["/t"]},
+        }
+        assert view["propagation_matrix"] == {
+            "m0": {"deadbeef": {
+                "beacon_block": {"first": 2, "duplicate": 1},
+            }},
+        }
+        assert m.get("fleet_scrape_errors_total", member="m0") == 0.0
+
+    run(main())
+
+
+def test_parse_gauges():
+    text = (
+        "# HELP a b\n"
+        "fork_choice_head_slot 12\n"
+        "peers_connection_count{x=\"1\"} 4\n"
+        "unrelated_total 99\n"
+        "fork_choice_head_slot_not_this 1\n"
+    )
+    assert _parse_gauges(text) == {
+        "fork_choice_head_slot": 12.0, "peers_connection_count": 4.0,
+    }
+
+
+# ------------------------------------------------- per-peer health deltas
+
+def test_emit_gossip_health_deltas_and_restart_rebaseline():
+    """Sidecar totals convert to metric deltas; a restarted sidecar
+    (totals reset below the cursor) re-baselines instead of going
+    negative or stalling."""
+    m = Metrics(enabled=True)
+    stub = SimpleNamespace(
+        metrics=m, _peer_stat_cursor={}, _control_cursor={}
+    )
+    peer = "aabbccddeeff0011"
+    topic = "/eth2/00000000/beacon_block/ssz_snappy"
+
+    def stats(first, dup, ihave):
+        return {
+            "delivery": {peer: {topic: {"first": first, "duplicate": dup}}},
+            "control": {"ihave_recv": ihave},
+            "peers": {peer: {"score": -1.5}},
+        }
+
+    BeaconNode._emit_gossip_health(stub, stats(3, 1, 2))
+    assert m.get("peer_gossip_first_total",
+                 peer="aabbccdd", topic="beacon_block") == 3
+    assert m.get("peer_gossip_duplicate_total",
+                 peer="aabbccdd", topic="beacon_block") == 1
+    assert m.get("peer_gossip_control_total", kind="ihave_recv") == 2
+    assert m.get("peer_score", peer="aabbccdd") == -1.5
+
+    # steady growth: only the delta lands
+    BeaconNode._emit_gossip_health(stub, stats(5, 1, 6))
+    assert m.get("peer_gossip_first_total",
+                 peer="aabbccdd", topic="beacon_block") == 5
+    assert m.get("peer_gossip_control_total", kind="ihave_recv") == 6
+
+    # sidecar restart: totals reset to small fresh values — the cursor
+    # re-baselines and counts them, never a negative delta
+    BeaconNode._emit_gossip_health(stub, stats(2, 0, 1))
+    assert m.get("peer_gossip_first_total",
+                 peer="aabbccdd", topic="beacon_block") == 7
+    assert m.get("peer_gossip_control_total", kind="ihave_recv") == 7

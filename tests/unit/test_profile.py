@@ -430,12 +430,18 @@ def test_bench_compare_runs_over_checked_in_trajectory():
 
 def test_bench_compare_synthetic_regression_gates(tmp_path):
     """Acceptance: fed a synthetically regressed artifact on top of the
-    real trajectory, the gate exits non-zero."""
+    real trajectory, the gate exits non-zero.  The regressed values are
+    derived from the trajectory's own latest points (half of each
+    higher-is-better headliner) so re-anchored artifacts — e.g. a
+    cpu-backend bench run recording a far lower absolute number — can't
+    quietly turn the synthetic regression into an improvement."""
     paths = bench_compare.default_artifacts()
+    report = bench_compare.evaluate(paths)
     bad = tmp_path / "BENCH_r99.json"
     _write_lines(bad, [
-        {"metric": "ssz_merkle_node_hashes_per_sec", "value": 1.0e7},
-        {"metric": "aggregate_bls_verifications_per_sec", "value": 10.0},
+        {"metric": name, "value": report["metrics"][name]["latest"] * 0.5}
+        for name in ("ssz_merkle_node_hashes_per_sec",
+                     "aggregate_bls_verifications_per_sec")
     ])
     rc = bench_compare.main([*paths, str(bad)])
     assert rc == 1

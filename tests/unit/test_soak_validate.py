@@ -17,9 +17,12 @@ sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
 import soak_check  # noqa: E402
 
 from lambda_ethereum_consensus_tpu.chaos.scenarios import SCENARIOS  # noqa: E402
-from lambda_ethereum_consensus_tpu.slo import DEFAULT_SLOS, SOAK_SLOS  # noqa: E402
+from lambda_ethereum_consensus_tpu.slo import (  # noqa: E402
+    DEFAULT_SLOS,
+    FLEET_SLOS,
+)
 
-ALL = ("steady", "storm", "partition", "equivocation", "churn")
+ALL = ("steady", "storm", "partition", "equivocation", "churn", "fleet_obs")
 
 
 # ------------------------------------------------------------- inventory
@@ -42,7 +45,7 @@ def test_scenario_knob_inventory():
 def test_exercised_map_is_a_subset_of_the_soak_slos():
     """The anti-silent-green map may only name rows the engine will
     actually evaluate, and only scenarios that exist."""
-    slo_names = {s.name for s in SOAK_SLOS}
+    slo_names = {s.name for s in FLEET_SLOS}
     for slo, drivers in soak_check.EXERCISED_BY.items():
         assert slo in slo_names, f"EXERCISED_BY names unknown SLO {slo!r}"
         assert drivers <= set(ALL)
@@ -50,6 +53,9 @@ def test_exercised_map_is_a_subset_of_the_soak_slos():
     assert {s.name for s in DEFAULT_SLOS} <= slo_names
     assert "chaos_recovery_p95" in slo_names
     assert "fleet_divergence_p95" in slo_names
+    # round 22: the fleet rows are part of the gate's evaluated set
+    assert "fleet_propagation_p95" in slo_names
+    assert "peer_delivery_p95" in slo_names
 
 
 # ------------------------------------------------------------- artifacts
@@ -170,9 +176,14 @@ def test_validate_flags_missing_slo_report(tmp_path):
 
 
 def test_recorded_soak_artifact_is_green():
-    """The checked-in SOAK_r01.json must itself audit clean — the same
-    self-check discipline BENCH_r*.json artifacts live under."""
-    path = os.path.join(REPO_ROOT, "SOAK_r01.json")
+    """The newest checked-in SOAK_r*.json must itself audit clean — the
+    same self-check discipline BENCH_r*.json artifacts live under (the
+    newest is what `make soak-validate` picks up)."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(REPO_ROOT, "SOAK_r*.json")))
+    assert paths, "no recorded SOAK_r*.json artifact"
+    path = paths[-1]
     assert soak_check.validate_artifact(path) == []
     with open(path) as fh:
         data = json.load(fh)
@@ -180,6 +191,34 @@ def test_recorded_soak_artifact_is_green():
     by_name = {r["scenario"]: r for r in data["scenarios"]}
     assert set(by_name) == set(ALL)
     # recovery is the asserted property: every fault scenario recorded it
-    for name in ("storm", "partition", "equivocation", "churn"):
+    for name in ("storm", "partition", "equivocation", "churn", "fleet_obs"):
         assert by_name[name]["recovered"] is True
         assert any(v > 0 for v in by_name[name]["faults"].values())
+
+
+def test_recorded_fleetobs_artifact_is_green():
+    """The round-22 fleet-observatory gate artifact: recorded knobs must
+    require exactly the fleet_obs scenario, the merged-export acceptance
+    numbers must be present, and the fleet SLO rows must carry REAL
+    observations (anti-silent-green)."""
+    path = os.path.join(REPO_ROOT, "FLEETOBS_r01.json")
+    assert soak_check.validate_artifact(path) == []
+    with open(path) as fh:
+        data = json.load(fh)
+    assert data["ok"] is True
+    assert data["soak"]["scenarios_run"] == ["fleet_obs"]
+    record = {r["scenario"]: r for r in data["scenarios"]}["fleet_obs"]
+    assert record["ok"] is True
+    # the acceptance surface: one block traceable across >= 3 nodes via
+    # cross-node flow links, per-member process rows, live propagation
+    assert record["flow_span_nodes"] >= 3
+    assert record["process_rows"] >= 4
+    assert len(record["propagation_members"]) >= 3
+    for name in ("fleet_propagation_p95", "peer_delivery_p95",
+                 "fleet_divergence_p95"):
+        row = record["fleet_slo"][name]
+        assert row["count"] > 0, f"{name} recorded with zero observations"
+        assert row["ok"] is True
+    # containment: both injected scrape faults observed
+    assert record["faults"]["scrape_hang"] > 0
+    assert record["faults"]["member_down"] > 0
