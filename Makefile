@@ -5,7 +5,7 @@ SPECTEST_VERSION := v1.3.0
 SPECTEST_URL := https://github.com/ethereum/consensus-spec-tests/releases/download/$(SPECTEST_VERSION)
 VENDOR := vendor/consensus-spec-tests
 
-.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke soak-smoke soak-validate fleet-obs-smoke crash-smoke crash-validate lint clean
+.PHONY: all native test spec-test spec-vectors bench bench-validate bench-compare slo-smoke serve-gate duties-gate replay-smoke soak-smoke soak-validate fleet-obs-smoke da-smoke crash-smoke crash-validate lint clean
 
 all: native
 
@@ -38,6 +38,7 @@ test: native
 	$(MAKE) serve-gate
 	$(MAKE) soak-smoke
 	$(MAKE) fleet-obs-smoke
+	$(MAKE) da-smoke
 	$(MAKE) crash-smoke
 
 # The SLO budget gate alone (round 12): a recorded load profile through
@@ -88,6 +89,20 @@ soak-validate:
 fleet-obs-smoke:
 	python scripts/soak_check.py --smoke --scenario fleet_obs --json FLEETOBS_r01.json
 	python scripts/soak_check.py --validate FLEETOBS_r01.json
+
+# The data-availability gate (round 23): a 3-node deneb fleet where each
+# member samples its own blob columns.  The publisher advertises a
+# block's KZG commitments but withholds one column's sidecar (swallowed
+# at the chaos publish seam) and serves a tampered sidecar (valid blob
+# under a wrong index claim — must die on the commitment-linkage
+# REJECT).  The member sampling the withheld column must PARK the block
+# at its DA gate while the non-sampling member applies immediately;
+# after the column is served the fleet reconverges within the recovery
+# budget and the da_availability_p95 SLO row is green WITH
+# observations.  The validated pass is recorded to DA_r01.json.
+da-smoke:
+	python scripts/soak_check.py --smoke --scenario da --json DA_r01.json
+	python scripts/soak_check.py --validate DA_r01.json
 
 # The crash-safety gate (round 20): >=20 seeded SIGKILL trials against a
 # live WAL writer (killed at deterministic byte offsets) + a corruption

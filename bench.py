@@ -117,6 +117,7 @@ _STAGE_METRICS: tuple[tuple[str | None, tuple[str, ...]], ...] = (
         "sharded_state_bytes_per_device",
     )),
     ("BENCH_NO_WITNESS", ("witness_verifications_per_sec",)),
+    ("BENCH_NO_KZG", ("kzg_blob_verifications_per_sec",)),
     ("BENCH_NO_DUTIES", (
         "duty_signatures_per_sec",
         "duties_met_per_epoch",
@@ -840,6 +841,23 @@ def main() -> None:
                    "witness_proof_generate_per_sec": "proofs/s",
                    "witness_proof_bytes": "bytes",
                    "witness_vc_verifications_per_sec": "openings/s"},
+        ):
+            _emit(rec)
+
+    if not os.environ.get("BENCH_NO_KZG"):
+        # data-availability plane (round 23): blob-proof verification
+        # through da.kzg's batched fold — one RLC pairing check per
+        # batch at the registered kzg_msm buckets; the commitment-MSM
+        # rate and the fold's gain over per-blob pairings ride along
+        for rec in _bench_script(
+            "bench_kzg.py",
+            ("kzg_blob_verifications_per_sec",
+             "kzg_blob_commitments_per_sec",
+             "kzg_batch_fold_gain"),
+            float(os.environ.get("BENCH_KZG_BUDGET_S", "300")),
+            units={"kzg_blob_verifications_per_sec": "blobs/s",
+                   "kzg_blob_commitments_per_sec": "blobs/s",
+                   "kzg_batch_fold_gain": "x"},
         ):
             _emit(rec)
 

@@ -751,7 +751,7 @@ class BeaconApiServer:
         per-(entry, shape) rows — ONE attribution surface, not two.
         Offloaded route: the table snapshot copies under ops/aot._LOCK."""
         from ..ops import profile as ops_profile
-        from ..ops.aot import aot_stats, compile_profile, shape_buckets
+        from ..ops.aot import all_shape_buckets, aot_stats, compile_profile
 
         rows = compile_profile()
         roofline = {
@@ -767,10 +767,12 @@ class BeaconApiServer:
             "data": {
                 "stats": aot_stats(),
                 "warmed_buckets": {
-                    "attestation_entries": list(
-                        shape_buckets("attestation_entries")
-                    ),
-                    "witness_verify": list(shape_buckets("witness_verify")),
+                    # the two founding families stay present even when
+                    # empty (pinned by consumers); every other plane's
+                    # registration shows up as it lands
+                    "attestation_entries": [],
+                    "witness_verify": [],
+                    **{k: list(v) for k, v in all_shape_buckets().items()},
                 },
                 "executables": rows,
             }

@@ -174,9 +174,14 @@ class Fleet:
         fault_spec: FaultSpec | None = None,
         seed: int = 0,
         subnets: tuple[int, ...] = (0, 1),
+        blob_subnets=None,
         enable_range_sync: bool = True,
         seed_chain_on: tuple[int, ...] = (0,),
     ) -> "Fleet":
+        """``blob_subnets``: None (every member samples all columns), a
+        tuple applied fleet-wide, or a per-member list of tuples/None —
+        the DA-sampling layout where each member guards its own blob
+        columns (deneb; da/availability.py)."""
         os.makedirs(base_dir, exist_ok=True)
         self = cls(bundle)
         for i in range(n):
@@ -197,6 +202,11 @@ class Fleet:
                 enable_range_sync=enable_range_sync and bool(self.nodes),
                 wire=wire,
                 attnet_subnets=subnets,
+                blob_subnets=(
+                    blob_subnets[i]
+                    if isinstance(blob_subnets, list)
+                    else blob_subnets
+                ),
                 port_wrapper=factory,
                 node_label=f"n{i}",
             )

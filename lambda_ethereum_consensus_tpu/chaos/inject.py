@@ -57,6 +57,11 @@ class ChaosPort:
         self._faults = faults
         self.name = name
         self._blocked: set[bytes] = set()
+        # DA withholding (round 23): short topic names (e.g.
+        # "blob_sidecar_3") whose outbound publishes are silently
+        # swallowed — the adversary that advertises commitments but
+        # never serves the column
+        self.withhold_topics: set[str] = set()
         # peer node_id -> stable link label (fleet fills this in so the
         # fault schedule keys on deterministic names, not random ids)
         self.peer_names: dict[bytes, str] = {}
@@ -181,9 +186,34 @@ class ChaosPort:
         except PortError:
             pass  # sidecar died mid-fault; its seen-cache expires the id
 
+    # ------------------------------------------------------- withholding
+
+    def withhold(self, *topics: str) -> None:
+        """Start withholding publishes on the given short topic names
+        (the blob-sidecar adversary).  Observable like every fault:
+        each swallowed publish counts ``blob_withhold``."""
+        self.withhold_topics.update(topics)
+        get_recorder().record(
+            "inst", 0, "chaos_withhold",
+            {"node": self.name, "topics": sorted(self.withhold_topics)},
+        )
+
+    def serve_withheld(self) -> None:
+        """Stop withholding (the heal step — the caller republishes)."""
+        self.withhold_topics.clear()
+        get_recorder().record(
+            "inst", 0, "chaos_withhold", {"node": self.name, "topics": []}
+        )
+
     # --------------------------------------------------------- outbound
 
     async def publish(self, topic: str, payload: bytes, trace=None) -> None:
+        from ..network.gossip import _topic_short
+
+        if _topic_short(topic) in self.withhold_topics:
+            self._record("blob_withhold", topic=_topic_short(topic))
+            get_metrics().inc("da_blobs_withheld_total")
+            return
         decision = self._faults.decide(f"{self.name}->out")
         if decision.drop:
             self._record("drop")

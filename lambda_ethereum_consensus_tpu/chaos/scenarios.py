@@ -1227,6 +1227,252 @@ async def _fleet_obs(ctx: ScenarioContext) -> dict:
     }
 
 
+async def _da(ctx: ScenarioContext) -> dict:
+    """Deneb data-availability sampling under withholding (round 23): a
+    3-node fleet where each member guards its own blob columns — the
+    publisher/adversary advertises a block's KZG commitments but
+    withholds one column's sidecar (swallowed at the ``ChaosPort``
+    publish seam, observable as ``blob_withhold`` faults and
+    ``da_blobs_withheld_total``).  The member sampling the withheld
+    column must PARK the block at its DA gate while the non-sampling
+    member applies it immediately; a tampered sidecar (honest data under
+    a wrong index claim) must die on the commitment-linkage REJECT; and
+    after the adversary serves the withheld column the gate opens, the
+    fleet reconverges within the recovery budget, and the whole episode
+    lands in ``da_gate_wait_seconds`` — the family behind the
+    ``da_availability_p95`` SLO row."""
+    from ..da import (
+        blob_to_commitment,
+        compute_blob_proof,
+        trusted_setup,
+        versioned_hash,
+    )
+    from ..types.beacon import BeaconBlockHeader, SignedBeaconBlockHeader
+    from ..types.deneb import BlobSidecar
+    from ..validator import build_signed_block
+
+    # deneb from genesis: fork_at_epoch(0) activates the blob topic rows
+    # in the node's fork-aware topic table without changing the wire
+    # digest (which derives from the genesis fork version)
+    spec = soak_spec().replace(DENEB_FORK_EPOCH=0)
+    bundle = make_chain(n_keys=64, chain_len=3, spec=spec)
+    slot_s = float(SOAK_SECONDS_PER_SLOT)
+    kinds = ("blob_withhold", "da_tamper")
+    before = _fault_totals(kinds)
+    m = get_metrics()
+    withheld0 = m.get("da_blobs_withheld_total")
+    mismatch0 = m.get("da_sidecars_total", result="mismatch")
+    ok = True
+    with use_chain_spec(spec):
+        # sampling layout: the publisher guards every column; member 1
+        # samples the columns the block uses (including the withheld
+        # one); member 2 samples only columns this block does NOT use —
+        # the pure non-sampler that must apply without waiting
+        fleet = await Fleet.boot(
+            3, bundle, ctx.base_dir + "/da", fault_spec=FaultSpec(),
+            seed=ctx.seed + 6,
+            blob_subnets=[None, (0, 1, 2), (3, 4, 5)],
+        )
+        try:
+            seed_head = bundle.blocks[-1].message.hash_tree_root(spec)
+            assert await fleet.wait_converged(20.0, root=seed_head), (
+                "fleet never converged on the seed chain"
+            )
+            # three canonical blobs + their commitments/proofs (columns
+            # 0..2 under the 6-subnet minimal layout)
+            setup = trusted_setup(spec)
+            width = int(spec.FIELD_ELEMENTS_PER_BLOB)
+            subnet_count = int(spec.get("BLOB_SIDECAR_SUBNET_COUNT", 6))
+            blobs = [
+                b"".join(
+                    (j * width + k + 1).to_bytes(32, "big")
+                    for k in range(width)
+                )
+                for j in range(3)
+            ]
+            comms = [blob_to_commitment(b, setup) for b in blobs]
+            proofs = [
+                compute_blob_proof(b, c, setup)
+                for b, c in zip(blobs, comms)
+            ]
+            # the deneb block these sidecars belong to, at the next wall
+            # slot; sidecars carry its header so their block root links
+            cur = await _wait_for_slot(
+                fleet.nodes[0], int(bundle.tip_state.slot) + 1, spec
+            )
+            signed, _post = build_signed_block(
+                bundle.tip_state, cur, bundle.sks, spec=spec
+            )
+            root = signed.message.hash_tree_root(spec)
+            header = SignedBeaconBlockHeader(
+                message=BeaconBlockHeader(
+                    slot=signed.message.slot,
+                    proposer_index=signed.message.proposer_index,
+                    parent_root=bytes(signed.message.parent_root),
+                    state_root=bytes(signed.message.state_root),
+                    body_root=signed.message.body.hash_tree_root(spec),
+                ),
+                signature=bytes(signed.signature),
+            )
+            depth = int(spec.get("KZG_COMMITMENT_INCLUSION_PROOF_DEPTH", 9))
+            zero_proof = [b"\x00" * 32] * depth
+            sidecars = [
+                BlobSidecar(
+                    index=i, blob=blobs[i], kzg_commitment=comms[i],
+                    kzg_proof=proofs[i], signed_block_header=header,
+                    kzg_commitment_inclusion_proof=zero_proof,
+                )
+                for i in range(len(blobs))
+            ]
+            # the state-transition seam: register the block's advertised
+            # commitments (versioned-hash linkage cross-checked) on the
+            # honest samplers; the publisher holds its own data
+            hashes = [versioned_hash(c) for c in comms]
+            for node in fleet.nodes[1:]:
+                node.da.expect(root, comms, versioned_hashes=hashes)
+            sampler, nonsampler = fleet.nodes[1], fleet.nodes[2]
+            if sampler.da.is_available(root):
+                ok = False
+                ctx.violation("da", "the sampling member's gate opened "
+                                    "before any sidecar arrived")
+            if not nonsampler.da.is_available(root):
+                ok = False
+                ctx.violation("da", "the non-sampling member's gate did "
+                                    "not open immediately")
+            # the adversary: column 1's sidecar is advertised but never
+            # published (swallowed at the chaos publish seam)
+            fleet.chaos[0].port.withhold("blob_sidecar_1")
+            # tampered sidecar: blob 2's (self-consistent, KZG-valid)
+            # data under an index-0 claim — the linkage REJECT path
+            _count_fault("da_tamper")
+            await fleet.publish_raw(0, "blob_sidecar_0", BlobSidecar(
+                index=0, blob=blobs[2], kzg_commitment=comms[2],
+                kzg_proof=proofs[2], signed_block_header=header,
+                kzg_commitment_inclusion_proof=zero_proof,
+            ))
+            for sc in sidecars:
+                await fleet.publish_raw(
+                    0, f"blob_sidecar_{int(sc.index) % subnet_count}", sc
+                )
+            # publish the block; the withheld column parks it on the
+            # sampler while the non-sampler applies
+            deadline = time.monotonic() + 12.0
+            while time.monotonic() < deadline:
+                await fleet.publish_block(0, signed)
+                await asyncio.sleep(0.3)
+                for node in fleet.nodes[1:]:
+                    await node.pending.process_once()
+                if root in nonsampler.store.blocks and (
+                    sampler.pending.is_pending(root)
+                    or root in sampler.store.blocks
+                ):
+                    break
+            applied_nonsampler = root in nonsampler.store.blocks
+            # grace scans: the sampler must STILL be parked, not slow
+            for _ in range(3):
+                await sampler.pending.process_once()
+                await asyncio.sleep(0.2)
+            parked = (
+                sampler.pending.is_pending(root)
+                and root not in sampler.store.blocks
+                and not sampler.da.is_available(root)
+            )
+            if not applied_nonsampler:
+                ok = False
+                ctx.violation(
+                    "da", "the non-sampling member never applied the "
+                          "block — sampling did not exempt it",
+                )
+            if not parked:
+                ok = False
+                ctx.violation(
+                    "da", "the sampling member did not park the block "
+                          "behind its withheld column",
+                )
+            # heal: serve the withheld column and converge
+            fleet.chaos[0].port.serve_withheld()
+            t_heal = time.monotonic()
+            budget_slots = 8 if ctx.smoke else 12
+            heal_deadline = t_heal + budget_slots * slot_s
+            while (
+                time.monotonic() < heal_deadline
+                and not sampler.da.is_available(root)
+            ):
+                await fleet.publish_raw(0, "blob_sidecar_1", sidecars[1])
+                await asyncio.sleep(0.3)
+            converged = await fleet.wait_converged(
+                max(1.0, heal_deadline - time.monotonic()), root=root
+            )
+            recovery = _observe_recovery(
+                ctx, "da", time.monotonic() - t_heal, budget_slots,
+                recovered=converged,
+            )
+            ok = ok and recovery["recovered"]
+            if not converged:
+                ctx.violation(
+                    "da",
+                    "fleet did not reconverge after the withheld column "
+                    f"was served (heads={[h.hex()[:12] for h in fleet.heads()]})",
+                )
+        finally:
+            await fleet.stop()
+    injected = {
+        kind: m.get(_FAULT_COUNTER, kind=kind) - before[kind]
+        for kind in kinds
+    }
+    withheld_d = m.get("da_blobs_withheld_total") - withheld0
+    mismatch_d = m.get("da_sidecars_total", result="mismatch") - mismatch0
+    missing = [kind for kind, delta in injected.items() if delta <= 0]
+    if missing:
+        ok = False
+        ctx.violation("da", f"injected fault kinds unobserved: {missing}")
+    if withheld_d <= 0:
+        ok = False
+        ctx.violation(
+            "da", "da_blobs_withheld_total never counted the withheld "
+                  "sidecar — the adversary seam did not fire",
+        )
+    if mismatch_d <= 0:
+        ok = False
+        ctx.violation(
+            "da", "the tampered sidecar never hit the commitment-"
+                  "linkage REJECT (da_sidecars_total{result=mismatch})",
+        )
+    # anti-silent-green: the availability row must carry REAL gate-wait
+    # observations (the non-sampler's instant 0 and the sampler's
+    # withholding episode)
+    report = ctx.engine.evaluate(emit=False, snapshot=False)
+    da_row = next(
+        (r for r in report["slos"] if r["slo"] == "da_availability_p95"),
+        None,
+    )
+    if da_row is None or da_row["count"] <= 0:
+        ok = False
+        ctx.violation(
+            "da", "da_availability_p95 has no observations — the gate "
+                  "would be silently green",
+        )
+    elif da_row["ok"] is False:
+        ok = False
+        ctx.violation(
+            "da", "da_availability_p95 over budget",
+            observed=da_row["observed"], budget=da_row["budget"],
+        )
+    return {
+        "scenario": "da", "ok": ok, "nodes": 3,
+        "faults": injected, "withheld": withheld_d,
+        "linkage_rejects": mismatch_d,
+        "nonsampler_applied": applied_nonsampler, "sampler_parked": parked,
+        "da_slo": (
+            None if da_row is None else {
+                "count": da_row["count"], "observed": da_row["observed"],
+                "budget": da_row["budget"], "ok": da_row["ok"],
+            }
+        ),
+        "block_root": root.hex(), **recovery,
+    }
+
+
 SCENARIOS = {
     "steady": _steady,
     "storm": _storm,
@@ -1234,6 +1480,7 @@ SCENARIOS = {
     "equivocation": _equivocation,
     "churn": _churn,
     "fleet_obs": _fleet_obs,
+    "da": _da,
 }
 
 

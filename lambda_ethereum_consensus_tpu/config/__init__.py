@@ -76,7 +76,9 @@ class ChainSpec(Mapping):
 
     # -- derived helpers used across the consensus core
     def fork_version_at_epoch(self, epoch: int) -> bytes:
-        """Version of the active fork at ``epoch`` (capella-aware)."""
+        """Version of the active fork at ``epoch`` (deneb-aware)."""
+        if epoch >= self.DENEB_FORK_EPOCH:
+            return self.DENEB_FORK_VERSION
         if epoch >= self.CAPELLA_FORK_EPOCH:
             return self.CAPELLA_FORK_VERSION
         if epoch >= self.BELLATRIX_FORK_EPOCH:
@@ -86,6 +88,8 @@ class ChainSpec(Mapping):
         return self.GENESIS_FORK_VERSION
 
     def fork_at_epoch(self, epoch: int) -> str:
+        if epoch >= self.DENEB_FORK_EPOCH:
+            return "deneb"
         if epoch >= self.CAPELLA_FORK_EPOCH:
             return "capella"
         if epoch >= self.BELLATRIX_FORK_EPOCH:

@@ -80,6 +80,17 @@ MAINNET_CAPELLA = {
     "MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP": 2**14,
 }
 
+MAINNET_DENEB = {
+    # Blob / KZG geometry (EIP-4844 polynomial commitments)
+    "FIELD_ELEMENTS_PER_BLOB": 2**12,          # 4096
+    "MAX_BLOB_COMMITMENTS_PER_BLOCK": 2**12,
+    "MAX_BLOBS_PER_BLOCK": 6,
+    "KZG_COMMITMENT_INCLUSION_PROOF_DEPTH": 17,
+    # Networking
+    "BLOB_SIDECAR_SUBNET_COUNT": 6,
+    "MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS": 2**12,
+}
+
 # --- minimal preset -----------------------------------------------------------
 # Expressed as deltas on mainnet: only the customized keys differ.
 
@@ -110,8 +121,15 @@ MINIMAL_CAPELLA = dict(MAINNET_CAPELLA, **{
     "MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP": 16,
 })
 
+MINIMAL_DENEB = dict(MAINNET_DENEB, **{
+    # 4 field elements per blob keeps the minimal-preset trusted setup
+    # and every CI-path MSM tiny (consensus-specs minimal/deneb.yaml)
+    "FIELD_ELEMENTS_PER_BLOB": 4,
+    "KZG_COMMITMENT_INCLUSION_PROOF_DEPTH": 9,
+})
+
 # Fork-ordered merge, later fork wins (ref: lib/utils/config.ex:19-26).
-FORK_ORDER = ("phase0", "altair", "bellatrix", "capella")
+FORK_ORDER = ("phase0", "altair", "bellatrix", "capella", "deneb")
 
 PRESETS = {
     "mainnet": {
@@ -119,12 +137,14 @@ PRESETS = {
         "altair": MAINNET_ALTAIR,
         "bellatrix": MAINNET_BELLATRIX,
         "capella": MAINNET_CAPELLA,
+        "deneb": MAINNET_DENEB,
     },
     "minimal": {
         "phase0": MINIMAL_PHASE0,
         "altair": MINIMAL_ALTAIR,
         "bellatrix": MINIMAL_BELLATRIX,
         "capella": MINIMAL_CAPELLA,
+        "deneb": MINIMAL_DENEB,
     },
 }
 

@@ -1,0 +1,686 @@
+"""KZG polynomial commitments (EIP-4844) on the device G1 stack.
+
+A blob is ``FIELD_ELEMENTS_PER_BLOB`` scalars read as a polynomial in
+*evaluation form* over the bit-reversal-ordered roots-of-unity domain;
+its commitment is one multi-scalar multiplication against the
+Lagrange-form trusted setup
+
+    C = sum_i  blob_i * [L_i(tau)] G1
+
+— exactly the workload shape the duty-sign/witness ladders already
+serve, so the MSM routes through :func:`ops.bls_g1.batch_g1_mul` with
+the same AOT shape-bucket + warmup + guard-then-fallback discipline as
+``ops/bls_sign.py``.  Proof verification is pairing-based:
+
+    e(C - [y] G1, G2)  ==  e(Q, [tau - z] G2)
+
+and a batch of B blob proofs folds under a Fiat-Shamir random linear
+combination into ONE pairing check (the ``witness/vector_commitment.py``
+trick): with per-item challenges ``z_i``, claimed values ``y_i`` and
+128-bit fold coefficients ``r_i``,
+
+    C' = sum_i r_i (C_i - [y_i] G1 + [z_i] Q_i),   Q' = sum_i r_i Q_i
+    e(C', G2) * e(-Q', [tau] G2)  ==  1
+
+where C' and Q' come out of a single bucket-snapped ladder dispatch.
+Every path is bit-exact against the pure-host Jacobian oracle
+(``g1.multiply`` per term): affine coordinates are unique, so equal
+group math means equal verdicts and equal compressed bytes.
+
+**Trusted setup**: :func:`dev_setup` derives tau from SHA-256 — a
+DEV-ONLY insecure ceremony (tau is public!) that makes commitments
+reproducible across processes; :func:`load_trusted_setup` ingests real
+Lagrange-form points (48-byte compressed G1 per evaluation position plus
+``[tau] G2``) for networks with an actual ceremony.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from ..crypto.bls import curve as C
+from ..crypto.bls.fields import P, R
+from ..crypto.bls.pairing import pairing_check
+from ..ops.aot import (
+    aot_jit,
+    compile_context,
+    register_shape_bucket,
+    shape_buckets,
+)
+from ..ops.bls_g1 import (
+    SCALAR_BITS,
+    _ints_batch,
+    _limbs_batch,
+    _scalar_bits_batch,
+    batch_inv_mod,
+)
+from ..ops.profile import register_entry_plane
+from ..telemetry import device_fault, inc, span
+from ..utils.env import env_flag
+
+# HBM accounting: the KZG MSM ladder's compiled programs report as their
+# own plane (bases and scalars are per-dispatch transients), the
+# duty-sign discipline
+register_entry_plane("kzg_ladders", "kzg_msm")
+
+__all__ = [
+    "DEFAULT_KZG_BUCKETS",
+    "KzgError",
+    "TrustedSetup",
+    "blob_to_commitment",
+    "blob_to_field_elements",
+    "compute_blob_proof",
+    "compute_proof",
+    "dev_setup",
+    "load_trusted_setup",
+    "trusted_setup",
+    "verify_blob_batch",
+    "verify_blob_proof",
+    "verify_proof",
+    "versioned_hash",
+    "warm_kzg_programs",
+]
+
+log = logging.getLogger("da.kzg")
+
+#: One field element per 32-byte big-endian chunk of a blob.
+BYTES_PER_FIELD_ELEMENT = 32
+
+#: EIP-4844 versioned-hash discriminator byte.
+VERSIONED_HASH_VERSION_KZG = b"\x01"
+
+#: Registered on first plane use (and by :func:`warm_kzg_programs`):
+#: MSM dispatches snap up to one of these point counts.  16 covers the
+#: minimal-preset commitment (width 4) and small verify folds, 256 a
+#: full 6-blob batch fold with headroom, 4096 the mainnet-width blob.
+DEFAULT_KZG_BUCKETS = (16, 256, 4096)
+
+_DST_SETUP = b"lambda_ethereum_consensus_tpu/da-kzg/dev-setup/v1"
+_DST_CHALLENGE = b"lambda_ethereum_consensus_tpu/da-kzg/challenge/v1"
+_DST_RLC = b"lambda_ethereum_consensus_tpu/da-kzg/rlc/v1"
+
+
+class KzgError(ValueError):
+    """Malformed blob / commitment / setup input."""
+
+
+# ---------------------------------------------------------------- domain
+
+
+def _bit_reversal_permutation(width: int) -> list[int]:
+    bits = width.bit_length() - 1
+    return [int(format(i, f"0{bits}b")[::-1], 2) for i in range(width)]
+
+
+def _roots_of_unity(width: int) -> list[int]:
+    """The order-``width`` subgroup of Fr* in bit-reversal order (the
+    EIP-4844 evaluation domain).  7 generates Fr*, so ``7^((R-1)/w)``
+    is a primitive w-th root for any w dividing the 2^32 2-adicity."""
+    omega = pow(7, (R - 1) // width, R)
+    assert pow(omega, width, R) == 1 and pow(omega, width // 2, R) == R - 1
+    natural = []
+    acc = 1
+    for _ in range(width):
+        natural.append(acc)
+        acc = acc * omega % R
+    return [natural[i] for i in _bit_reversal_permutation(width)]
+
+
+# --------------------------------------------------------- trusted setup
+
+
+@dataclass(frozen=True)
+class TrustedSetup:
+    """Lagrange-form setup: ``g1_lagrange[i] = [L_i(tau)] G1`` over the
+    bit-reversal-ordered domain, plus ``g2_tau = [tau] G2``."""
+
+    width: int
+    domain: tuple  # bit-reversal-ordered roots of unity (ints mod R)
+    g1_lagrange: tuple  # affine G1 int pairs, one per domain position
+    g2_tau: object  # affine G2 point
+
+
+def load_trusted_setup(
+    g1_lagrange: Sequence[bytes], g2_tau: bytes
+) -> TrustedSetup:
+    """Ingest ceremony output: compressed Lagrange G1 points (one per
+    evaluation position, width a power of two) and ``[tau] G2``."""
+    width = len(g1_lagrange)
+    if width < 2 or width & (width - 1):
+        raise KzgError(f"setup width {width} is not a power of two >= 2")
+    try:
+        points = [C.g1_from_bytes(b) for b in g1_lagrange]
+        tau_g2 = C.g2_from_bytes(g2_tau)
+    except C.DeserializationError as exc:
+        raise KzgError(f"invalid setup point: {exc}") from exc
+    if any(pt is None for pt in points) or tau_g2 is None:
+        raise KzgError("setup contains the point at infinity")
+    return TrustedSetup(
+        width=width,
+        domain=tuple(_roots_of_unity(width)),
+        g1_lagrange=tuple(points),
+        g2_tau=tau_g2,
+    )
+
+
+_DEV_SETUPS: dict[int, TrustedSetup] = {}
+
+
+def dev_setup(width: int) -> TrustedSetup:
+    """Deterministic DEV-ONLY setup (tau is SHA-256-derived and thus
+    public — fine for devnets/tests, never for value).  Cached per
+    width; the mainnet width (4096) costs a few seconds of host scalar
+    multiplications on first use."""
+    setup = _DEV_SETUPS.get(width)
+    if setup is not None:
+        return setup
+    if width < 2 or width & (width - 1):
+        raise KzgError(f"setup width {width} is not a power of two >= 2")
+    domain = _roots_of_unity(width)
+    ctr = 0
+    while True:
+        tau = (
+            int.from_bytes(
+                hashlib.sha256(
+                    _DST_SETUP
+                    + width.to_bytes(8, "big")
+                    + ctr.to_bytes(4, "big")
+                ).digest(),
+                "big",
+            )
+            % R
+        )
+        # tau in the domain would zero a Lagrange denominator below
+        if tau != 0 and pow(tau, width, R) != 1:
+            break
+        ctr += 1
+    # L_i(tau) = d_i * (tau^w - 1) / (w * (tau - d_i)) over the domain
+    zw = (pow(tau, width, R) - 1) % R
+    denoms = [width * (tau - d) % R for d in domain]
+    scalars = [
+        d * zw % R * inv % R
+        for d, inv in zip(domain, batch_inv_mod(denoms, R))
+    ]
+    setup = TrustedSetup(
+        width=width,
+        domain=tuple(domain),
+        g1_lagrange=tuple(C.g1.multiply(C.G1_GENERATOR, s) for s in scalars),
+        g2_tau=C.g2.multiply(C.G2_GENERATOR, tau),
+    )
+    _DEV_SETUPS[width] = setup
+    return setup
+
+
+def trusted_setup(spec=None) -> TrustedSetup:
+    """The active spec's setup (``FIELD_ELEMENTS_PER_BLOB`` wide)."""
+    if spec is None:
+        from ..config import get_chain_spec
+
+        spec = get_chain_spec()
+    return dev_setup(int(spec.FIELD_ELEMENTS_PER_BLOB))
+
+
+# ------------------------------------------------------------- MSM plane
+
+
+def _shard_count() -> int:
+    """``GRAFT_KZG_SHARD``: split every MSM dispatch round-robin over N
+    shards — the single-host stand-in for a multi-chip MSM (each shard
+    is an independent ladder dispatch; partials recombine on host)."""
+    try:
+        return max(1, int(os.environ.get("GRAFT_KZG_SHARD", "1")))
+    except ValueError:
+        return 1
+
+
+def _use_device_plane() -> bool:
+    """Default device routing: TPU backends only.  ``KZG_NO_DEVICE``
+    wins, ``KZG_DEVICE=1`` forces — the crypto-plane polarity
+    discipline."""
+    if env_flag("KZG_NO_DEVICE"):
+        return False
+    if env_flag("KZG_DEVICE"):
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def _interpret_mode() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
+def _snap_batch(n: int) -> int:
+    buckets = shape_buckets("kzg_msm")
+    if not buckets:
+        for b in DEFAULT_KZG_BUCKETS:
+            register_shape_bucket("kzg_msm", b)
+        buckets = shape_buckets("kzg_msm")
+    for b in buckets:
+        if n <= b:
+            return b
+    return _pow2(n)
+
+
+_KERNELS: dict = {}  # (nbits, interpret) -> packed ladder callable
+
+
+def _get_msm_kernel(nbits: int, interpret: bool):
+    """The packed G1 plane ladder: affine bases as ``(32, B)`` limb
+    planes + MSB-first ``(nbits, B)`` scalar bit rows -> one flat
+    ``(3*32+1, B)`` Jacobian result array.  Jitted + AOT-cached on a
+    device backend; eager per-op dispatch in interpret mode."""
+    key = (nbits, interpret)
+    fn = _KERNELS.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import bigint as BI
+    from ..ops.bls_g1 import g1_plane_field
+    from ..ops.ladder import make_ladder
+
+    ladder = make_ladder(g1_plane_field(interpret), eager=interpret)
+
+    def packed(bx, by, kbits):
+        X, Y, Z, inf = ladder((bx, by), kbits)
+        return jnp.concatenate(
+            [X, Y, Z, inf[None].astype(jnp.int32)], axis=0
+        )
+
+    fn = packed if interpret else aot_jit(jax.jit(packed), "kzg_msm")
+    _KERNELS[key] = fn
+    return fn
+
+
+def _mul_batch_device(pairs: list, nbits: int) -> list:
+    """``[k_i * P_i]`` through the bucket-snapped G1 plane ladder; None
+    out for zero-scalar / infinity lanes (identical to the host oracle).
+    ``GRAFT_KZG_SHARD`` splits the work round-robin into independent
+    dispatches (the single-host stand-in for a multi-chip MSM)."""
+    import jax.numpy as jnp
+
+    from ..ops import bigint as BI
+
+    out: list = [None] * len(pairs)
+    live = [
+        i for i, (pt, k) in enumerate(pairs) if pt is not None and k % R != 0
+    ]
+    interpret = _interpret_mode()
+    kernel = _get_msm_kernel(nbits, interpret)
+    # dispatch REGISTERED shapes only: past the largest warmed bucket the
+    # batch runs in largest-bucket chunks (duty-sign discipline — an
+    # unregistered pow2 would trace a fresh program mid-slot)
+    max_bucket = max(shape_buckets("kzg_msm") or DEFAULT_KZG_BUCKETS)
+    for s in range(_shard_count()):
+        idxs = live[s :: _shard_count()]
+        for at in range(0, len(idxs), max_bucket):
+            chunk = idxs[at : at + max_bucket]
+            # every dispatch snaps to a registered bucket: the staged
+            # program-signature set stays closed (no mid-slot retrace);
+            # interpret-mode tests register tiny buckets so the same
+            # pad-and-drop logic runs without eager padded-lane cost
+            batch = _snap_batch(len(chunk))
+            pad = batch - len(chunk)
+            pts = [pairs[i][0] for i in chunk] + [C.G1_GENERATOR] * pad
+            ks = [pairs[i][1] % R for i in chunk] + [0] * pad
+            bx = _limbs_batch([pt[0] for pt in pts])
+            by = _limbs_batch([pt[1] for pt in pts])
+            kbits = _scalar_bits_batch(ks, nbits)
+            flat = np.asarray(
+                kernel(
+                    jnp.asarray(bx.T), jnp.asarray(by.T), jnp.asarray(kbits.T)
+                )
+            )
+            nl = BI.NLIMBS
+            X, Y, Z = flat[:nl].T, flat[nl : 2 * nl].T, flat[2 * nl : 3 * nl].T
+            inf = flat[3 * nl].astype(bool)
+            keep = [j for j in range(len(chunk)) if not bool(inf[j])]
+            n_c = len(chunk)
+            xs, ys, zs = (
+                _ints_batch(X[:n_c]),
+                _ints_batch(Y[:n_c]),
+                _ints_batch(Z[:n_c]),
+            )
+            zinvs = dict(
+                zip(keep, batch_inv_mod([zs[j] for j in keep], P))
+            ) if keep else {}
+            for j in keep:
+                zi = zinvs[j]
+                zi2 = zi * zi % P
+                out[chunk[j]] = (
+                    xs[j] * zi2 % P,
+                    ys[j] * zi2 % P * zi % P,
+                )
+    return out
+
+
+def _mul_batch(
+    pairs: list, device: bool | None = None, nbits: int = SCALAR_BITS
+) -> list:
+    """Per-pair scalar products with the plane guard: a raising device
+    dispatch falls back to the host Jacobian oracle — this plane can
+    never make a verdict wrong, only a cold start slower."""
+    if not pairs:
+        return []
+    if nbits % 8:
+        raise KzgError(f"ladder width must be a multiple of 8, got {nbits}")
+    if any(k % R >> nbits for _, k in pairs):
+        raise KzgError(f"scalar wider than the {nbits}-bit ladder")
+    if device is None:
+        device = _use_device_plane()
+    n = len(pairs)
+    if device:
+        try:
+            out = _mul_batch_device(pairs, nbits)
+            inc("kzg_msm_total", n, path="device")
+            return out
+        except Exception:
+            log.exception(
+                "device KZG MSM failed for %d terms; host fallback", n
+            )
+            device_fault("kzg_msm")
+            inc("kzg_msm_total", n, path="host_fallback")
+    else:
+        inc("kzg_msm_total", n, path="host")
+    return [C.g1.multiply(pt, k) if pt is not None else None for pt, k in pairs]
+
+
+def _msm(points, scalars, device: bool | None = None, nbits: int = SCALAR_BITS):
+    """``sum_i k_i * P_i`` (None = identity)."""
+    acc = None
+    for pt in _mul_batch(list(zip(points, scalars)), device, nbits):
+        acc = C.g1.affine_add(acc, pt)
+    return acc
+
+
+def warm_kzg_programs(batch: int | None = None) -> float:
+    """Register the ``kzg_msm`` buckets and, on a device backend,
+    compile/load the ladder at the first bucket so a slot's first
+    sidecar batch finds the program resident (drives the plane
+    internals, not the verify surface — a warmup compile landing in
+    ``kzg_verify_seconds`` would read as a phantom SLO violation)."""
+    t0 = time.perf_counter()
+    for b in DEFAULT_KZG_BUCKETS:
+        register_shape_bucket("kzg_msm", b)
+    if _use_device_plane() and not _interpret_mode():
+        b = int(batch) if batch else DEFAULT_KZG_BUCKETS[0]
+        with compile_context("warmup:kzg"):
+            _mul_batch_device([(C.G1_GENERATOR, 1)] * b, SCALAR_BITS)
+    return time.perf_counter() - t0
+
+
+# ------------------------------------------------------------ polynomial
+
+
+def blob_to_field_elements(blob: bytes, width: int) -> list[int]:
+    """Split a blob into its ``width`` 32-byte big-endian field
+    elements; non-canonical chunks (>= R) reject, as on gossip."""
+    if len(blob) != width * BYTES_PER_FIELD_ELEMENT:
+        raise KzgError(
+            f"blob is {len(blob)} bytes, expected {width * BYTES_PER_FIELD_ELEMENT}"
+        )
+    out = []
+    for i in range(width):
+        v = int.from_bytes(
+            blob[i * BYTES_PER_FIELD_ELEMENT : (i + 1) * BYTES_PER_FIELD_ELEMENT],
+            "big",
+        )
+        if v >= R:
+            raise KzgError(f"blob field element {i} is non-canonical")
+        out.append(v)
+    return out
+
+
+def _eval_at(evals: list[int], z: int, domain) -> int:
+    """Evaluate the polynomial given in evaluation form at ``z`` — the
+    barycentric formula out of domain, the stored value in domain."""
+    z %= R
+    for i, d in enumerate(domain):
+        if z == d:
+            return evals[i]
+    width = len(domain)
+    zw = (pow(z, width, R) - 1) % R
+    invs = batch_inv_mod([(z - d) % R for d in domain], R)
+    s = 0
+    for e, d, inv in zip(evals, domain, invs):
+        s = (s + e * d % R * inv) % R
+    return zw * pow(width, R - 2, R) % R * s % R
+
+
+def _quotient_evals(evals: list[int], z: int, y: int, domain) -> list[int]:
+    """Evaluation form of ``(p(X) - y) / (X - z)`` over the domain —
+    the well-known special-index formula when z IS a domain point."""
+    width = len(domain)
+    try:
+        m = domain.index(z % R)
+    except ValueError:
+        m = None
+    if m is None:
+        invs = batch_inv_mod([(d - z) % R for d in domain], R)
+        return [(e - y) % R * inv % R for e, inv in zip(evals, invs)]
+    q = [0] * width
+    others = [j for j in range(width) if j != m]
+    inv_jm = batch_inv_mod([(domain[j] - domain[m]) % R for j in others], R)
+    inv_dm = pow(domain[m], R - 2, R)
+    for j, inv in zip(others, inv_jm):
+        q[j] = (evals[j] - y) % R * inv % R
+        # the removable singularity at d_m:
+        #   q_m = sum_{j!=m} (p_j - y) d_j / (d_m (d_m - d_j))
+        #       = sum_{j!=m} -q_j d_j / d_m
+        q[m] = (q[m] - q[j] * domain[j] % R * inv_dm) % R
+    return q
+
+
+# --------------------------------------------------------------- surface
+
+
+def versioned_hash(commitment: bytes) -> bytes:
+    """EIP-4844: ``0x01 || sha256(commitment)[1:]``."""
+    if len(commitment) != 48:
+        raise KzgError(f"commitment must be 48 bytes, got {len(commitment)}")
+    return (
+        VERSIONED_HASH_VERSION_KZG + hashlib.sha256(commitment).digest()[1:]
+    )
+
+
+def blob_to_commitment(
+    blob: bytes, setup: TrustedSetup | None = None, device: bool | None = None
+) -> bytes:
+    """One MSM against the Lagrange setup; 48-byte compressed G1 out."""
+    setup = setup or trusted_setup()
+    evals = blob_to_field_elements(blob, setup.width)
+    return C.g1_to_bytes(_msm(setup.g1_lagrange, evals, device))
+
+
+def _compute_challenge(blob: bytes, commitment: bytes, width: int) -> int:
+    """Per-blob Fiat-Shamir evaluation point (EIP-4844-shaped)."""
+    return (
+        int.from_bytes(
+            hashlib.sha256(
+                _DST_CHALLENGE + width.to_bytes(8, "big") + blob + commitment
+            ).digest(),
+            "big",
+        )
+        % R
+    )
+
+
+def compute_proof(
+    blob: bytes,
+    z: int,
+    setup: TrustedSetup | None = None,
+    device: bool | None = None,
+) -> tuple[bytes, int]:
+    """Opening proof for the blob polynomial at ``z``: returns the
+    48-byte quotient commitment and the claimed value ``y = p(z)``."""
+    setup = setup or trusted_setup()
+    evals = blob_to_field_elements(blob, setup.width)
+    y = _eval_at(evals, z, setup.domain)
+    q = _quotient_evals(evals, z, y, setup.domain)
+    return C.g1_to_bytes(_msm(setup.g1_lagrange, q, device)), y
+
+
+def compute_blob_proof(
+    blob: bytes,
+    commitment: bytes,
+    setup: TrustedSetup | None = None,
+    device: bool | None = None,
+) -> bytes:
+    """The sidecar proof: an opening at the blob's own Fiat-Shamir
+    challenge point (what ``verify_blob_proof`` recomputes)."""
+    setup = setup or trusted_setup()
+    proof, _ = compute_proof(
+        blob, _compute_challenge(blob, commitment, setup.width), setup, device
+    )
+    return proof
+
+
+def verify_proof(
+    commitment: bytes,
+    z: int,
+    y: int,
+    proof: bytes,
+    setup: TrustedSetup | None = None,
+    device: bool | None = None,
+) -> bool:
+    """The per-proof pairing check ``e(C - [y]G1, G2) == e(Q, [tau-z]G2)``
+    — malformed or off-subgroup encodings reject like tampered ones."""
+    setup = setup or trusted_setup()
+    try:
+        c_pt = C.g1_from_bytes(commitment)
+        q_pt = C.g1_from_bytes(proof)
+    except C.DeserializationError:
+        return False
+    with span("kzg_verify"):
+        p_min_y = C.g1.affine_add(
+            c_pt, C.g1.affine_neg(C.g1.multiply(C.G1_GENERATOR, y))
+        )
+        x_min_z = C.g2.affine_add(
+            setup.g2_tau, C.g2.affine_neg(C.g2.multiply(C.G2_GENERATOR, z))
+        )
+        ok = pairing_check(
+            [(p_min_y, C.G2_GENERATOR), (C.g1.affine_neg(q_pt), x_min_z)]
+        )
+    inc("kzg_blobs_verified_total", 1, result="ok" if ok else "reject")
+    return ok
+
+
+def verify_blob_proof(
+    blob: bytes,
+    commitment: bytes,
+    proof: bytes,
+    setup: TrustedSetup | None = None,
+    device: bool | None = None,
+) -> bool:
+    """Single-sidecar verification: recompute the challenge, evaluate
+    the blob there, run the per-proof pairing check."""
+    setup = setup or trusted_setup()
+    try:
+        evals = blob_to_field_elements(blob, setup.width)
+    except KzgError:
+        return False
+    z = _compute_challenge(blob, commitment, setup.width)
+    y = _eval_at(evals, z, setup.domain)
+    return verify_proof(commitment, z, y, proof, setup, device)
+
+
+def _fold_scalars(commitments, zs, ys, proofs) -> list[int]:
+    """Fiat-Shamir RLC coefficients: one 128-bit odd scalar per item,
+    bound to the full transcript."""
+    h = hashlib.sha256(_DST_RLC)
+    for cb, z, y, pb in zip(commitments, zs, ys, proofs):
+        h.update(cb)
+        h.update(int(z).to_bytes(32, "big"))
+        h.update(int(y).to_bytes(32, "big"))
+        h.update(pb)
+    seed = h.digest()
+    return [
+        int.from_bytes(
+            hashlib.sha256(seed + j.to_bytes(4, "big")).digest()[:16], "big"
+        )
+        | 1  # never zero: every item must stay bound
+        for j in range(len(commitments))
+    ]
+
+
+def verify_blob_batch(
+    blobs: Sequence[bytes],
+    commitments: Sequence[bytes],
+    proofs: Sequence[bytes],
+    setup: TrustedSetup | None = None,
+    device: bool | None = None,
+) -> bool:
+    """B sidecars as ONE folded pairing check; a single tampered blob,
+    commitment or proof fails the whole fold (callers bisect, exactly
+    like the BLS batch verify).  The C'/Q' accumulators come out of a
+    single bucket-snapped ladder dispatch of ``3B + 1`` terms."""
+    if not (len(blobs) == len(commitments) == len(proofs)):
+        raise KzgError(
+            f"{len(blobs)} blobs / {len(commitments)} commitments / "
+            f"{len(proofs)} proofs"
+        )
+    if not blobs:
+        return True
+    setup = setup or trusted_setup()
+    n = len(blobs)
+    try:
+        c_pts = [C.g1_from_bytes(b) for b in commitments]
+        q_pts = [C.g1_from_bytes(b) for b in proofs]
+    except C.DeserializationError:
+        inc("kzg_blobs_verified_total", n, result="reject")
+        return False
+    try:
+        evals = [blob_to_field_elements(b, setup.width) for b in blobs]
+    except KzgError:
+        inc("kzg_blobs_verified_total", n, result="reject")
+        return False
+    zs = [
+        _compute_challenge(b, cb, setup.width)
+        for b, cb in zip(blobs, commitments)
+    ]
+    ys = [_eval_at(e, z, setup.domain) for e, z in zip(evals, zs)]
+    rs = _fold_scalars(commitments, zs, ys, proofs)
+    with span("kzg_verify"):
+        # C' = sum r_i C_i + sum (r_i z_i) Q_i - (sum r_i y_i) G1
+        # Q' = sum r_i Q_i           -- all 3n+1 products in one dispatch
+        pairs = (
+            [(pt, r) for pt, r in zip(c_pts, rs)]
+            + [(pt, r * z % R) for pt, r, z in zip(q_pts, rs, zs)]
+            + [
+                (
+                    C.G1_GENERATOR,
+                    (R - sum(r * y % R for r, y in zip(rs, ys)) % R) % R,
+                )
+            ]
+            + [(pt, r) for pt, r in zip(q_pts, rs)]
+        )
+        prods = _mul_batch(pairs, device)
+        c_fold = None
+        for pt in prods[: 2 * n + 1]:
+            c_fold = C.g1.affine_add(c_fold, pt)
+        q_fold = None
+        for pt in prods[2 * n + 1 :]:
+            q_fold = C.g1.affine_add(q_fold, pt)
+        ok = pairing_check(
+            [
+                (c_fold, C.G2_GENERATOR),
+                (C.g1.affine_neg(q_fold), setup.g2_tau),
+            ]
+        )
+    inc("kzg_blobs_verified_total", n, result="ok" if ok else "reject")
+    return ok

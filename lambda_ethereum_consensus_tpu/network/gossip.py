@@ -47,8 +47,19 @@ def topic_name(fork_digest: bytes, name: str) -> str:
     return f"/eth2/{fork_digest.hex()}/{name}/ssz_snappy"
 
 
-def fork_topic(spec: ChainSpec, genesis_validators_root: bytes, name: str) -> str:
-    epoch_version = spec.CAPELLA_FORK_VERSION
+def fork_topic(
+    spec: ChainSpec,
+    genesis_validators_root: bytes,
+    name: str,
+    epoch: int | None = None,
+) -> str:
+    """Topic path under the fork active at ``epoch`` (None keeps the
+    historical capella pin — this helper long predated a fork schedule
+    and hard-coded that digest)."""
+    if epoch is None:
+        epoch_version = spec.CAPELLA_FORK_VERSION
+    else:
+        epoch_version = spec.fork_version_at_epoch(int(epoch))
     digest = misc.compute_fork_digest(epoch_version, genesis_validators_root)
     return topic_name(digest, name)
 

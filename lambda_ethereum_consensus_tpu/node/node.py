@@ -17,6 +17,8 @@ from dataclasses import dataclass, field
 
 from ..api.beacon_api import BeaconApiServer
 from ..config import ChainSpec, constants, get_chain_spec
+from ..config.presets import FORK_ORDER
+from ..da import DataAvailability
 from ..fork_choice import (
     Store,
     attestation_batch_target,
@@ -58,6 +60,32 @@ log = logging.getLogger("node")
 # recorder-overwrite counter cursor (see _device_telemetry_tick): the
 # flight recorder is process-wide, so the export cursor must be too
 _trace_dropped_exported = 0
+
+
+@dataclass(frozen=True)
+class TopicSpec:
+    """One row of the fork-aware gossip topic table (round 23).
+
+    ``_start_network`` used to hard-code the capella topic set inline;
+    every fork since would have meant another copy of the subscription
+    boilerplate.  Now forks only ADD rows: a row joins the mesh when the
+    chain's current fork (``spec.fork_at_epoch``) has reached
+    ``since_fork``.  ``handler``/``sink`` are bound-method NAMES so the
+    table itself is a frozen value (rebuilt per network (re)start)."""
+
+    name: str  # short topic name (topic_name() adds digest + ssz_snappy)
+    ssz_type: object
+    handler: str  # BeaconNode method: async (batch) -> verdicts
+    lane: str = "other"  # ingest-scheduler lane
+    since_fork: str = "phase0"
+    max_batch: int = 64
+    max_queue: int = 1024
+    # shared-lane sink method: one flush spanning every topic of the
+    # lane (gossip.SharedLaneSink); None = per-topic flushes
+    sink: str | None = None
+    # subnet id baked into the handler (functools.partial) for
+    # subnet-family topics; None for singleton topics
+    subnet: int | None = None
 
 
 @dataclass
@@ -116,6 +144,18 @@ class NodeConfig:
     # ONE merged Perfetto export.  None = single-node (no stamping; the
     # pre-round-22 wire byte for byte).
     node_label: str | None = None
+    # data-availability sampling (round 23): the blob_sidecar_{i}
+    # subnets this node joins once deneb is active.  None = every
+    # subnet (a full-DA node); a proper subset makes the DA gate a
+    # SAMPLING node — block import waits only for blob indices whose
+    # column (index % BLOB_SIDECAR_SUBNET_COUNT) maps onto these
+    # subnets (da/availability.py)
+    blob_subnets: tuple[int, ...] | None = None
+    # blob lane flush deadline: sidecars should coalesce into one
+    # RLC-folded pairing check per block's worth, but must not hold
+    # block import hostage — tighter than attestations, looser than
+    # blocks
+    ingest_blob_deadline_ms: int = 50
 
 
 class BeaconNode:
@@ -136,6 +176,8 @@ class BeaconNode:
         self.port: Port | None = None
         self.peerbook = Peerbook()
         self.pending: PendingBlocks | None = None
+        self.da: DataAvailability | None = None
+        self._kzg_setup = None  # lazily-built trusted setup (spec width)
         self.api: BeaconApiServer | None = None
         self.slot_clock: SlotClock | None = None
         self.duties = None  # DutyScheduler when config.duty_keys is set
@@ -217,10 +259,18 @@ class BeaconNode:
         self.states_db.store_state(anchor_root, anchor_state, spec)
 
         self.chain = LiveChainView(self.store, self.blocks_db, spec)
+        # the DA gate exists on every node (pre-deneb it simply never
+        # registers an expectation, so is_available is always True) —
+        # the pending-blocks scan and the blob drain share this instance
+        self.da = DataAvailability(spec, subnets=self.config.blob_subnets)
         await self._start_network()
 
         self.pending = PendingBlocks(
-            self.store, spec, downloader=self.downloader, on_applied=self._on_applied
+            self.store,
+            spec,
+            downloader=self.downloader,
+            on_applied=self._on_applied,
+            da_gate=self.da,
         )
         self.pending.start()
 
@@ -496,57 +546,101 @@ class BeaconNode:
             self.ingest = sched = self._build_ingest_scheduler()
             sched.start()
 
-        # gossip topics (ref: gossipsub.ex:16-34 — block + aggregate topics)
-        block_topic = topic_name(digest, "beacon_block")
-        sub = TopicSubscription(
-            self.port, block_topic, self._on_block_batch,
-            ssz_type=SignedBeaconBlock, spec=self.spec, metrics=self.metrics,
-            scheduler=sched, lane="block" if sched else None,
-            node=self.config.node_label,
+        # gossip topics (ref: gossipsub.ex:16-34), now table-driven: one
+        # fork-aware TopicSpec row per topic instead of a hard-coded
+        # capella set.  Rows gated behind a later fork (deneb blob
+        # sidecars) activate when the chain's CURRENT fork reaches them;
+        # a sidecar restart after a fork transition picks up the new rows
+        # (subscriptions are rebuilt here on every (re)start).
+        from ..network.gossip import SharedLaneSink
+
+        import functools
+
+        epoch = int(self.store.current_slot(self.spec)) // int(
+            self.spec.SLOTS_PER_EPOCH
         )
-        await sub.start()
-        self._subs.append(sub)
-        # attestation channels take deep batches: the device drain's fixed
-        # dispatch cost amortizes across thousands of signatures, and one
-        # mainnet slot already carries ~1k aggregates
+        active_fork = FORK_ORDER.index(self.spec.fork_at_epoch(epoch))
+        sinks: dict[str, SharedLaneSink] = {}
+        for ts in self._topic_table():
+            if FORK_ORDER.index(ts.since_fork) > active_fork:
+                continue
+            handler = getattr(self, ts.handler)
+            if ts.subnet is not None:
+                handler = functools.partial(handler, ts.subnet)
+            sink = None
+            if sched is not None and ts.sink is not None:
+                # one sink per lane: a flush spanning N subnet topics is
+                # ONE batched verify, not N per-topic fragments
+                sink = sinks.get(ts.sink)
+                if sink is None:
+                    sink = sinks[ts.sink] = SharedLaneSink(
+                        getattr(self, ts.sink), label=f"{ts.lane}_lane"
+                    )
+            sub = TopicSubscription(
+                self.port, topic_name(digest, ts.name), handler,
+                ssz_type=ts.ssz_type, spec=self.spec,
+                max_batch=ts.max_batch, max_queue=ts.max_queue,
+                metrics=self.metrics,
+                scheduler=sched, lane=ts.lane if sched else None,
+                sink=sink, node=self.config.node_label,
+            )
+            await sub.start()
+            self._subs.append(sub)
+
+    def _blob_subnet_ids(self) -> tuple[int, ...]:
+        count = int(self.spec.get("BLOB_SIDECAR_SUBNET_COUNT", 6))
+        if self.config.blob_subnets is None:
+            return tuple(range(count))
+        subs = tuple(sorted({int(s) for s in self.config.blob_subnets}))
+        for s in subs:
+            if not 0 <= s < count:
+                # fail at startup, not inside the sidecar-restart loop
+                raise ValueError(f"blob subnet id out of range: {s}")
+        return subs
+
+    def _topic_table(self) -> list[TopicSpec]:
+        """The fork-aware gossip surface.  Forks append rows; nothing
+        else about subscription wiring changes per fork."""
+        from ..types.beacon import Attestation
+        from ..types.deneb import BlobSidecar
+
+        # attestation channels take deep batches: the device drain's
+        # fixed dispatch cost amortizes across thousands of signatures,
+        # and one mainnet slot already carries ~1k aggregates
         ATT_BATCH, ATT_QUEUE = 8192, 16384
-        agg_topic = topic_name(digest, "beacon_aggregate_and_proof")
-        agg = TopicSubscription(
-            self.port, agg_topic, self._on_aggregate_batch,
-            ssz_type=SignedAggregateAndProof, spec=self.spec,
-            max_batch=ATT_BATCH, max_queue=ATT_QUEUE, metrics=self.metrics,
-            scheduler=sched, lane="aggregate" if sched else None,
-            node=self.config.node_label,
-        )
-        await agg.start()
-        self._subs.append(agg)
+        table = [
+            TopicSpec(
+                name="beacon_block", ssz_type=SignedBeaconBlock,
+                handler="_on_block_batch", lane="block",
+            ),
+            TopicSpec(
+                name="beacon_aggregate_and_proof",
+                ssz_type=SignedAggregateAndProof,
+                handler="_on_aggregate_batch", lane="aggregate",
+                max_batch=ATT_BATCH, max_queue=ATT_QUEUE,
+            ),
+        ]
         # attestation subnets: unaggregated votes, one topic per subnet,
         # drained through the SAME batched-RLC verify as aggregates —
         # and, under the scheduler, one SHARED lane: a flood on any
         # subnet competes with the other subnets, never with blocks
-        from ..network.gossip import SharedLaneSink
-        from ..types.beacon import Attestation
-
-        import functools
-
-        # one sink for the whole subnet lane: a flush spanning N subnet
-        # topics is ONE batched verify, not N per-topic fragments
-        subnet_sink = (
-            SharedLaneSink(self._on_subnet_sink_batch, label="subnet_lane")
-            if sched else None
-        )
-        for i in subnets:
-            sub_topic = topic_name(digest, f"beacon_attestation_{i}")
-            att_sub = TopicSubscription(
-                self.port, sub_topic,
-                functools.partial(self._on_attestation_batch, i),
-                ssz_type=Attestation, spec=self.spec,
-                max_batch=ATT_BATCH, max_queue=ATT_QUEUE, metrics=self.metrics,
-                scheduler=sched, lane="subnet" if sched else None,
-                sink=subnet_sink, node=self.config.node_label,
-            )
-            await att_sub.start()
-            self._subs.append(att_sub)
+        for i in sorted(set(self.config.attnet_subnets)):
+            table.append(TopicSpec(
+                name=f"beacon_attestation_{i}", ssz_type=Attestation,
+                handler="_on_attestation_batch", lane="subnet",
+                max_batch=ATT_BATCH, max_queue=ATT_QUEUE,
+                sink="_on_subnet_sink_batch", subnet=i,
+            ))
+        # deneb blob sidecars: one topic per sampled column, one shared
+        # lane — a flush verifies in a single RLC-folded pairing check
+        for i in self._blob_subnet_ids():
+            table.append(TopicSpec(
+                name=f"blob_sidecar_{i}", ssz_type=BlobSidecar,
+                handler="_on_blob_sidecar_batch", lane="blob",
+                since_fork="deneb",
+                sink="_on_blob_sink_batch", subnet=i,
+            ))
+        return table
 
     def _build_ingest_scheduler(self) -> IngestScheduler:
         """Lane model (ISSUE 3 tentpole): blocks > aggregates > subnet
@@ -570,13 +664,25 @@ class BeaconNode:
             # a queued ancestor and orphaning its descendants
             shed_newest=True,
         ))
+        # blob sidecars sit between blocks and attestations: a block
+        # cannot apply until its sampled columns verify, so sidecars must
+        # not starve behind an attestation flood — but they coalesce to a
+        # block's worth so a flush is ONE RLC-folded pairing check.  A
+        # full lane sheds the incoming message (withholding adversaries
+        # must not evict queued honest sidecars).
         sched.add_lane(LaneConfig(
-            name="aggregate", priority=1, weight=4096, max_batch=8192,
+            name="blob", priority=1, weight=64, max_batch=64, max_queue=1024,
+            deadline_s=cfg.ingest_blob_deadline_ms / 1000.0,
+            coalesce_target=int(self.spec.get("MAX_BLOBS_PER_BLOCK", 6)),
+            shed_newest=True,
+        ))
+        sched.add_lane(LaneConfig(
+            name="aggregate", priority=2, weight=4096, max_batch=8192,
             max_queue=16384, deadline_s=att_deadline,
             coalesce_target=att_target, shape_kind="attestation_entries",
         ))
         sched.add_lane(LaneConfig(
-            name="subnet", priority=2, weight=4096, max_batch=8192,
+            name="subnet", priority=3, weight=4096, max_batch=8192,
             max_queue=16384, deadline_s=att_deadline,
             coalesce_target=att_target, shape_kind="attestation_entries",
         ))
@@ -584,7 +690,7 @@ class BeaconNode:
         # changes — future subscriptions); empty until one is wired, and
         # excluded from the budget picture by the explicit max_items
         sched.add_lane(LaneConfig(
-            name="other", priority=3, weight=64, max_batch=64, max_queue=1024,
+            name="other", priority=4, weight=64, max_batch=64, max_queue=1024,
             deadline_s=0.2, coalesce_target=16,
         ))
         return sched
@@ -744,6 +850,95 @@ class BeaconNode:
         return self._subnet_attestation_drain(
             [(int(sub.topic_label.rsplit("_", 1)[1]), msg) for sub, msg in pairs]
         )
+
+    async def _on_blob_sidecar_batch(self, subnet: int, batch) -> list[int]:
+        """Standalone-mode entry: one blob subnet topic's own drain."""
+        return self._blob_sidecar_drain([(subnet, msg) for msg in batch])
+
+    async def _on_blob_sink_batch(self, pairs) -> list[int]:
+        """Scheduler-mode entry: ONE flush spanning every subscribed
+        blob_sidecar topic (gossip.SharedLaneSink) — all sidecars in the
+        flush verify in a single RLC-folded pairing check."""
+        return self._blob_sidecar_drain(
+            [(int(sub.topic_label.rsplit("_", 1)[1]), msg) for sub, msg in pairs]
+        )
+
+    def _kzg_trusted_setup(self):
+        if self._kzg_setup is None:
+            from ..da import trusted_setup
+
+            self._kzg_setup = trusted_setup(self.spec)
+        return self._kzg_setup
+
+    def _blob_sidecar_drain(self, tagged) -> list[int]:
+        """blob_sidecar_{i} gossip validation (p2p spec deneb):
+
+        - REJECT structurally misrouted sidecars (index beyond
+          MAX_BLOBS_PER_BLOCK, or on the wrong subnet for its index) —
+          compliant peers penalize a node that re-propagates these
+        - REJECT commitment-linkage mismatches against a block's
+          advertised commitment list (the DA gate's expectation)
+        - the whole flush's KZG proofs fold into ONE pairing check
+          (da.kzg.verify_blob_batch); only a failing fold pays the
+          per-item bisect, so the all-valid common case is one pairing
+        - verified sidecars feed the DA gate: the sidecar that completes
+          a block's sampled column set unparks it in pending-blocks
+        """
+        from ..da import verify_blob_batch, verify_blob_proof
+        from ..telemetry import inc
+
+        spec = self.spec
+        max_blobs = int(spec.get("MAX_BLOBS_PER_BLOCK", 6))
+        subnet_count = int(spec.get("BLOB_SIDECAR_SUBNET_COUNT", 6))
+        verdicts: list[int | None] = [None] * len(tagged)
+        items = []  # (pos, root, sidecar, msg)
+        for pos, (subnet, msg) in enumerate(tagged):
+            sc = msg.value
+            self.metrics.inc("network_gossip_count", type="blob_sidecar")
+            index = int(sc.index)
+            if index >= max_blobs or index % subnet_count != subnet:
+                verdicts[pos] = VERDICT_REJECT
+                continue
+            root = sc.signed_block_header.message.hash_tree_root(spec)
+            # linkage pre-check against an already-registered block
+            # expectation: an advertised-commitment mismatch REJECTs
+            # before paying for the pairing check
+            expected = self.da.expected_commitment(root, index)
+            if expected is not None and expected != bytes(sc.kzg_commitment):
+                inc("da_sidecars_total", 1, result="mismatch")
+                verdicts[pos] = VERDICT_REJECT
+                continue
+            items.append((pos, root, sc, msg))
+        if items:
+            setup = self._kzg_trusted_setup()
+            blobs = [bytes(sc.blob) for _, _, sc, _ in items]
+            comms = [bytes(sc.kzg_commitment) for _, _, sc, _ in items]
+            proofs = [bytes(sc.kzg_proof) for _, _, sc, _ in items]
+            if verify_blob_batch(blobs, comms, proofs, setup=setup):
+                ok = [True] * len(items)
+            else:
+                # one bad sidecar must not take honest flush-mates down
+                # with it: re-check each item on its own
+                ok = [
+                    verify_blob_proof(b, c, p, setup=setup)
+                    for b, c, p in zip(blobs, comms, proofs)
+                ]
+            for (pos, root, sc, msg), valid in zip(items, ok):
+                if not valid:
+                    verdicts[pos] = VERDICT_REJECT
+                    continue
+                linkage = self.da.on_sidecar(
+                    root, int(sc.index), bytes(sc.kzg_commitment)
+                )
+                if linkage == "mismatch":
+                    verdicts[pos] = VERDICT_REJECT
+                elif linkage == "duplicate":
+                    verdicts[pos] = VERDICT_IGNORE
+                else:  # accept | complete | orphan (block not seen yet)
+                    verdicts[pos] = VERDICT_ACCEPT
+                if msg.trace is not None and linkage == "complete":
+                    msg.trace.event("apply", kind="da_complete")
+        return [VERDICT_IGNORE if v is None else v for v in verdicts]
 
     def _subnet_attestation_drain(self, tagged) -> list[int]:
         """Subnet gossip validation (p2p spec beacon_attestation_{i}; ADVICE

@@ -32,11 +32,16 @@ class PendingBlocks:
         spec: ChainSpec,
         downloader=None,
         on_applied=None,
+        da_gate=None,
     ):
         self.store = store
         self.spec = spec
         self.downloader = downloader
         self.on_applied = on_applied  # callback(root, signed_block)
+        # da.availability.DataAvailability (deneb): blocks whose sampled
+        # blob columns are still outstanding stay parked in the pending
+        # set — applied on a later scan once the gate opens
+        self.da_gate = da_gate
         self.pending: dict[bytes, SignedBeaconBlock] = {}
         self.invalid: set[bytes] = set()
         self.to_download: set[bytes] = set()
@@ -65,6 +70,10 @@ class PendingBlocks:
             if parent in self.invalid:
                 self._mark_invalid(root)
             elif parent in self.store.blocks:
+                if self.da_gate is not None and not self.da_gate.is_available(
+                    root
+                ):
+                    continue  # parked: data availability incomplete
                 try:
                     on_block(self.store, signed, spec=self.spec)
                 except SpecError as e:

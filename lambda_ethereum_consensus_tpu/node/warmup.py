@@ -28,6 +28,7 @@ __all__ = [
     "DrainShapes",
     "warm_drain_programs",
     "warm_duties",
+    "warm_kzg",
     "warm_sharded_programs",
     "warm_transition",
     "warm_witness",
@@ -186,6 +187,18 @@ def warm_duties() -> float:
     return dt
 
 
+def warm_kzg() -> float:
+    """Register the ``kzg_msm`` shape buckets and, on device backends,
+    compile/load the packed MSM ladder at its first bucket (da/kzg.py)
+    so a slot's first blob-sidecar flush dispatches a resident program
+    instead of tracing mid-slot."""
+    from ..da import warm_kzg_programs
+
+    dt = warm_kzg_programs()
+    observe("warmup_phase_seconds", dt, phase="kzg")
+    return dt
+
+
 def warm_witness() -> float:
     """Load/compile the batched witness-verification plane at its
     canonical serving shape (witness/verify.py) so the first real
@@ -235,6 +248,7 @@ def start_warmer(
             )
             stats["witness_s"] = round(warm_witness(), 1)
             stats["duties_s"] = round(warm_duties(), 1)
+            stats["kzg_s"] = round(warm_kzg(), 1)
         except Exception as e:  # visible, never fatal to boot
             stats["error"] = f"{type(e).__name__}: {e}"
 

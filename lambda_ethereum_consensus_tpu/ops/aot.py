@@ -231,6 +231,14 @@ def shape_buckets(kind: str) -> tuple[int, ...]:
         return tuple(sorted(_SHAPE_BUCKETS.get(kind, ())))
 
 
+def all_shape_buckets() -> dict[str, tuple[int, ...]]:
+    """Every registered bucket family, ascending per kind — the
+    /debug/compile inventory (hard-coding families there meant each new
+    plane silently vanished from the warmup report)."""
+    with _LOCK:
+        return {k: tuple(sorted(v)) for k, v in sorted(_SHAPE_BUCKETS.items())}
+
+
 def aot_dir() -> str | None:
     """Cache directory, or None when disabled (BLS_NO_AOT=1)."""
     if os.environ.get("BLS_NO_AOT"):

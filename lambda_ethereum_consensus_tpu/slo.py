@@ -225,6 +225,17 @@ SOAK_SLOS = DEFAULT_SLOS + STORAGE_SLOS + (
         # sized to the scenario windows, not to steady-state operation
         "fleet head-divergence episodes resolve within the soak window",
     ),
+    SloDef(
+        "da_availability_p95", "da_gate_wait_seconds",
+        0.95, 30.0,
+        # expectation registered -> every sampled blob column verified
+        # (da/availability.py): blocks with instant availability observe
+        # 0, a withholding episode observes its whole duration — so the
+        # budget bounds how long the DA scenario may withhold before the
+        # heal republish lands (sized to the soak windows, like the
+        # divergence row above)
+        "block DA gate: expected blob columns verified within the window",
+    ),
 )
 
 

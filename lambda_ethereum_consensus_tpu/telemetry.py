@@ -179,6 +179,13 @@ _HELP = {
     "storage_finalized_epoch": "finalized epoch whose snapshot pointer + fsync barrier are persisted",
     "device_fault_total": "device runtime faults contained by host fallbacks, by plane",
     "device_fault_latched": "1 after any contained device fault on this plane this process (see /debug/slo)",
+    "kzg_verify_seconds": "one batched blob-proof verification (RLC fold into a single pairing check)",
+    "kzg_msm_total": "G1 multi-scalar multiplications run by the KZG plane, by path (device|host)",
+    "kzg_blobs_verified_total": "blob proofs judged by the KZG plane, by result (ok|invalid)",
+    "da_gate_wait_seconds": "block arrival -> sampled blob-column set complete at the DA gate",
+    "da_sidecars_total": "blob sidecars judged by the DA gate, by result (accept|duplicate|orphan|mismatch|evicted)",
+    "da_blocks_pending": "blocks currently parked behind incomplete blob-column sets",
+    "da_blobs_withheld_total": "blob-sidecar publishes swallowed by the chaos withholding adversary",
 }
 
 

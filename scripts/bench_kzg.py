@@ -1,0 +1,127 @@
+"""KZG blob bench: batched proof-verification throughput on the DA plane.
+
+One JSON metric line per measurement (bench.py's guarded subprocess
+contract); the headline is ``kzg_blob_verifications_per_sec`` — complete
+blob proofs checked per second through ``da.kzg.verify_blob_batch``,
+where the whole batch folds into ONE random-linear-combination pairing
+check regardless of batch size.  On a CPU backend the measured MSMs run
+the host ladder; on a TPU backend the packed device plane at the
+registered ``kzg_msm`` buckets (the pairing itself always finalizes on
+host — see da/kzg.py).
+
+Riders (informational, not inventory-gated):
+
+- ``kzg_blob_commitments_per_sec`` — blob-to-commitment rate (one
+  width-sized G1 MSM per blob);
+- ``kzg_batch_fold_gain`` — batched verification speedup over the same
+  blobs verified one pairing at a time (the reason the fold exists).
+
+The default ``--width 64`` keeps a cold CPU run in seconds; pass
+``--width 4096`` for the mainnet blob shape (device recommended).
+
+Usage: python scripts/bench_kzg.py [--width W] [--blobs N] [--batch B]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.da import (  # noqa: E402
+    blob_to_commitment,
+    compute_blob_proof,
+    dev_setup,
+    verify_blob_batch,
+    verify_blob_proof,
+)
+
+
+def _emit(rec: dict) -> None:
+    print(json.dumps(rec), flush=True)
+
+
+def _make_blobs(width: int, n: int) -> list[bytes]:
+    # deterministic field elements, comfortably below the BLS12-381
+    # scalar modulus
+    return [
+        b"".join(
+            ((j * width + k) * 2654435761 % (1 << 200)).to_bytes(32, "big")
+            for k in range(width)
+        )
+        for j in range(n)
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--width", type=int, default=64,
+                    help="field elements per blob (default 64; mainnet 4096)")
+    ap.add_argument("--blobs", type=int, default=48,
+                    help="total blob verifications to measure (default 48)")
+    ap.add_argument("--batch", type=int, default=16,
+                    help="blobs per verify_blob_batch fold (default 16)")
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    setup = dev_setup(args.width)
+    blobs = _make_blobs(args.width, args.batch)
+
+    t0 = time.perf_counter()
+    comms = [blob_to_commitment(b, setup) for b in blobs]
+    commit_rate = len(blobs) / (time.perf_counter() - t0)
+    proofs = [
+        compute_blob_proof(b, c, setup) for b, c in zip(blobs, comms)
+    ]
+
+    # warm once (device program compiles on TPU, lazy host tables on
+    # CPU), then measure steady-state folds
+    assert verify_blob_batch(blobs, comms, proofs, setup=setup)
+    done = 0
+    t0 = time.perf_counter()
+    while done < args.blobs:
+        assert verify_blob_batch(blobs, comms, proofs, setup=setup), (
+            "bench blobs must verify"
+        )
+        done += args.batch
+    rate = done / (time.perf_counter() - t0)
+    _emit({
+        "metric": "kzg_blob_verifications_per_sec",
+        "value": round(rate, 2),
+        "unit": "blobs/s",
+        "backend": backend,
+        "width": args.width,
+        "batch": args.batch,
+        "blobs": done,
+        "note": "one RLC-folded pairing check per batch",
+    })
+    _emit({
+        "metric": "kzg_blob_commitments_per_sec",
+        "value": round(commit_rate, 2),
+        "unit": "blobs/s",
+        "width": args.width,
+    })
+
+    # the fold's win: the same batch, one pairing per blob
+    t0 = time.perf_counter()
+    for b, c, p in zip(blobs, comms, proofs):
+        assert verify_blob_proof(b, c, p, setup=setup)
+    single_rate = len(blobs) / (time.perf_counter() - t0)
+    _emit({
+        "metric": "kzg_batch_fold_gain",
+        "value": round(rate / single_rate, 2) if single_rate else None,
+        "unit": "x",
+        "batch": args.batch,
+        "note": "batched fold vs one pairing per blob, same inputs",
+    })
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

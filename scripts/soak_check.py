@@ -71,6 +71,7 @@ from lambda_ethereum_consensus_tpu.tracing import get_recorder  # noqa: E402
 
 SCENARIO_ORDER = (
     "steady", "storm", "partition", "equivocation", "churn", "fleet_obs",
+    "da",
 )
 
 # which scenarios drive which SLO rows: a row is EXERCISED (empty ==
@@ -84,7 +85,7 @@ EXERCISED_BY = {
     "gossip_drain_p95": {"partition", "equivocation", "churn"},
     "block_transition_p95": {"partition", "equivocation", "churn"},
     "chaos_recovery_p95": {
-        "storm", "partition", "equivocation", "churn", "fleet_obs",
+        "storm", "partition", "equivocation", "churn", "fleet_obs", "da",
     },
     "fleet_divergence_p95": {"partition", "fleet_obs"},
     # round 20: every DB resume (incl. the churn power-loss reboot)
@@ -94,6 +95,9 @@ EXERCISED_BY = {
     # origin publish -> remote admission over the real wire
     "fleet_propagation_p95": {"fleet_obs"},
     "peer_delivery_p95": {"fleet_obs"},
+    # round 23: the DA withholding scenario drives the availability-gate
+    # wait histogram (deneb blob sampling; da/availability.py)
+    "da_availability_p95": {"da"},
 }
 
 

@@ -19,6 +19,7 @@ def test_required_metrics_honors_env_gates():
     assert "aggregate_bls_verifications_per_sec" in everything
     assert "pipeline_overload_block_p95_ms" in everything
     assert "duty_signatures_per_sec" in everything
+    assert "kzg_blob_verifications_per_sec" in everything
     assert "api_requests_per_sec" in everything
     assert "api_cache_hit_ratio" in everything
     gated = bench.required_metrics(env={
@@ -26,7 +27,7 @@ def test_required_metrics_honors_env_gates():
         "BENCH_NO_PLANES": "1", "BENCH_NO_PIPELINE": "1",
         "BENCH_NO_TELEMETRY": "1", "BENCH_NO_TRACE": "1",
         "BENCH_NO_SHARD": "1", "BENCH_NO_STATE_SHARD": "1",
-        "BENCH_NO_WITNESS": "1",
+        "BENCH_NO_WITNESS": "1", "BENCH_NO_KZG": "1",
         "BENCH_NO_DUTIES": "1", "BENCH_NO_API": "1",
     })
     # the ungated headline pair survives every knob
@@ -246,7 +247,7 @@ def test_validate_cli_passes_on_covered_artifact(tmp_path):
     for knob in ("BENCH_NO_MAINNET", "BENCH_NO_INGEST", "BENCH_NO_PLANES",
                  "BENCH_NO_PIPELINE", "BENCH_NO_TELEMETRY", "BENCH_NO_TRACE",
                  "BENCH_NO_SHARD", "BENCH_NO_STATE_SHARD",
-                 "BENCH_NO_WITNESS", "BENCH_NO_DUTIES",
+                 "BENCH_NO_WITNESS", "BENCH_NO_KZG", "BENCH_NO_DUTIES",
                  "BENCH_NO_API"):
         env[knob] = "1"
     artifact = tmp_path / "BENCH_ok.json"

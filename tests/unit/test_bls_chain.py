@@ -203,6 +203,8 @@ def test_device_committee_cache_matches_host_sums():
 
 
 @pytest.mark.device
+@pytest.mark.slow  # round 23: over the tier-1 one-core wall budget;
+# test_device_committee_cache + the duties gate keep the path in-lane
 def test_chain_verify_cached_matches_host(hs):
     """The node-path drain: aggregate pubkeys from the epoch committee
     cache (full sum minus missing members, all on device) + RLC tail —

@@ -18,6 +18,8 @@ def host_mul(pt, k):
     return C.g2._multiply_py(pt, k)
 
 
+@pytest.mark.slow  # ~2.5 min ladder compile on one core (round 23);
+# the duty-sign plane re-proves the G2 ladder vs the host comb in-lane
 def test_g2_ladder_matches_host():
     base2 = host_mul(C.G2_GENERATOR, 123456789)
     pts = [C.G2_GENERATOR, base2, C.G2_GENERATOR, C.G2_GENERATOR, C.G2_GENERATOR]
@@ -33,6 +35,7 @@ def test_g2_empty_batch():
     assert batch_g2_mul([], []) == []
 
 
+@pytest.mark.slow  # round 23: over the tier-1 one-core wall budget
 def test_batch_verify_through_device_msm(monkeypatch):
     """The RLC batch verification with its scalar mults on device."""
     from lambda_ethereum_consensus_tpu.crypto import bls

@@ -303,6 +303,7 @@ def test_get_head_memo_invalidates_on_mutation(chain):
 
 
 @pytest.mark.device  # ~4 min of interpret-mode chain math on one core
+@pytest.mark.slow  # round 23: over the tier-1 one-core wall budget
 def test_on_attestation_batch_cached_matches_host(chain, monkeypatch):
     """The epoch-cache device drain (VERDICT r4 next #1: the node path
     must run the machinery the bench measures) against the host path:

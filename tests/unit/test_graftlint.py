@@ -591,6 +591,66 @@ def test_retrace_hazard_passes_with_duty_sign_bucket_snap(tmp_path):
     assert findings == []
 
 
+def test_retrace_hazard_fires_on_unsnapped_kzg_msm_batch(tmp_path):
+    """The kzg_msm bucket discipline (round 23): feeding the packed MSM
+    plane scalar rows shaped by however many blobs a gossip flush
+    happened to carry — no snap/pad in scope — would trace a fresh
+    pairing-stack program per blob count."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def _msm_plane(rows):
+                return rows
+
+            msm_kernel = jax.jit(_msm_plane)
+
+            def commit_batch(scalar_rows):
+                return msm_kernel(jnp.asarray(scalar_rows))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert len(findings) == 1 and "variable-length" in findings[0].message
+
+
+def test_retrace_hazard_passes_with_kzg_msm_bucket_snap(tmp_path):
+    """The shipped discipline (da/kzg.py): the blob batch snaps to the
+    registered kzg_msm shape buckets and pads with infinity-point lanes
+    before the jitted packed plane sees it."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def shape_buckets(kind):
+                return (8, 64)
+
+            def _msm_plane(rows):
+                return rows
+
+            msm_kernel = jax.jit(_msm_plane)
+
+            def commit_batch(scalar_rows):
+                batch = None
+                for b in shape_buckets("kzg_msm"):
+                    if len(scalar_rows) <= b:
+                        batch = b
+                        break
+                padded = list(scalar_rows) + [0] * (batch - len(scalar_rows))
+                return msm_kernel(jnp.asarray(padded))
+            """
+        },
+        rules=["retrace-hazard"],
+    )
+    assert findings == []
+
+
 def test_retrace_hazard_fires_on_uncoalesced_flush_shape(tmp_path):
     """The coalescer's bucket-snap discipline (round 17): a flush that
     concatenates whatever proofs happen to be parked and feeds the

@@ -22,7 +22,10 @@ from lambda_ethereum_consensus_tpu.slo import (  # noqa: E402
     FLEET_SLOS,
 )
 
-ALL = ("steady", "storm", "partition", "equivocation", "churn", "fleet_obs")
+ALL = (
+    "steady", "storm", "partition", "equivocation", "churn", "fleet_obs",
+    "da",
+)
 
 
 # ------------------------------------------------------------- inventory
@@ -191,7 +194,8 @@ def test_recorded_soak_artifact_is_green():
     by_name = {r["scenario"]: r for r in data["scenarios"]}
     assert set(by_name) == set(ALL)
     # recovery is the asserted property: every fault scenario recorded it
-    for name in ("storm", "partition", "equivocation", "churn", "fleet_obs"):
+    for name in ("storm", "partition", "equivocation", "churn", "fleet_obs",
+                 "da"):
         assert by_name[name]["recovered"] is True
         assert any(v > 0 for v in by_name[name]["faults"].values())
 
@@ -222,3 +226,29 @@ def test_recorded_fleetobs_artifact_is_green():
     # containment: both injected scrape faults observed
     assert record["faults"]["scrape_hang"] > 0
     assert record["faults"]["member_down"] > 0
+
+
+def test_recorded_da_artifact_is_green():
+    """The round-23 data-availability gate artifact: the withholding
+    adversary must have fired (anti-silent-green), the sampling member
+    parked while the non-sampler applied, the tampered sidecar died on
+    the linkage REJECT, and the da_availability_p95 row carries REAL
+    gate-wait observations within budget."""
+    path = os.path.join(REPO_ROOT, "DA_r01.json")
+    assert soak_check.validate_artifact(path) == []
+    with open(path) as fh:
+        data = json.load(fh)
+    assert data["ok"] is True
+    assert data["soak"]["scenarios_run"] == ["da"]
+    record = {r["scenario"]: r for r in data["scenarios"]}["da"]
+    assert record["ok"] is True
+    assert record["recovered"] is True
+    assert record["nonsampler_applied"] is True
+    assert record["sampler_parked"] is True
+    assert record["withheld"] > 0
+    assert record["linkage_rejects"] > 0
+    assert record["faults"]["blob_withhold"] > 0
+    assert record["faults"]["da_tamper"] > 0
+    row = record["da_slo"]
+    assert row["count"] > 0, "da_availability_p95 recorded with no observations"
+    assert row["ok"] is True
