@@ -111,6 +111,10 @@ _STAGE_METRICS: tuple[tuple[str | None, tuple[str, ...]], ...] = (
         "trace_overhead_pct",
         "trace_noop_overhead_pct",
     )),
+    ("BENCH_NO_FORENSICS", (
+        "forensics_overhead_pct",
+        "forensics_noop_overhead_pct",
+    )),
     ("BENCH_NO_SHARD", ("sharded_verify_entries_per_sec",)),
     ("BENCH_NO_STATE_SHARD", (
         "sharded_epoch_validators_per_sec",
@@ -810,6 +814,19 @@ def main() -> None:
             float(os.environ.get("BENCH_TRACE_BUDGET_S", "60")),
             units={"trace_overhead_pct": "%",
                    "trace_noop_overhead_pct": "%"},
+        ):
+            _emit(rec)
+
+    if not os.environ.get("BENCH_NO_FORENSICS"):
+        # consensus-forensics overhead on the same synthetic drain
+        # (round 24: per-vote/per-batch notes enabled <= 1%,
+        # FORENSICS_OFF <= 0.1%)
+        for rec in _bench_script(
+            "bench_forensics_overhead.py",
+            ("forensics_overhead_pct", "forensics_noop_overhead_pct"),
+            float(os.environ.get("BENCH_FORENSICS_BUDGET_S", "60")),
+            units={"forensics_overhead_pct": "%",
+                   "forensics_noop_overhead_pct": "%"},
         ):
             _emit(rec)
 

@@ -345,6 +345,12 @@ class BeaconApiServer:
             (r"/debug/compile", self._debug_compile),
             (r"/debug/profile", self._debug_profile),
             (r"/debug/slo", self._debug_slo),
+            # consensus forensics plane (round 24) — offloaded: the DAG
+            # snapshot walks the head-cache tree and the ring copies,
+            # none of which belongs on the event loop
+            (r"/debug/forkchoice", self._debug_forkchoice),
+            (r"/debug/reorgs", self._debug_reorgs),
+            (r"/debug/finality", self._debug_finality),
         ] + self._inline_routes()
 
     def _post_routes(self) -> list[tuple[str, Callable]]:
@@ -835,6 +841,48 @@ class BeaconApiServer:
         # a latched plane is an operator page, not a log line)
         report["device_health"] = device_fault_state()
         return self._json({"data": report})
+
+    def _forensics(self):
+        """The owning store's forensics plane, or None — attached by the
+        node at start(); hand-built stores and standalone servers answer
+        404 from the three routes below."""
+        return getattr(self.store, "forensics", None)
+
+    def _debug_forkchoice(self) -> tuple[str, str, bytes]:
+        """Weighted fork-DAG snapshot (round 24): every block in the
+        O(1) head-cache tree with its cached subtree weight, the memoized
+        head (``head_candidates`` — NEVER forces an uncached LMD-GHOST
+        recompute), and the last cold-walk decision audit."""
+        forensics = self._forensics()
+        if forensics is None or self.store is None:
+            return self._error(404, "no forensics plane attached")
+        return self._json(
+            {"data": forensics.forkchoice_view(self.store, self.spec)}
+        )
+
+    def _debug_reorgs(self) -> tuple[str, str, bytes]:
+        """Reorg post-mortems + the equivocation-evidence ledger: every
+        head transition's ReorgRecord (depth, common ancestor, orphaned
+        roots, weight-swing attribution) and the deduplicated
+        double-proposal/double-vote/slashing evidence."""
+        forensics = self._forensics()
+        if forensics is None:
+            return self._error(404, "no forensics plane attached")
+        return self._json({"data": {
+            "reorgs": forensics.reorgs(),
+            "reorg_count": forensics.reorg_count(),
+            "evidence": forensics.evidence(),
+            "stats": forensics.stats(),
+        }})
+
+    def _debug_finality(self) -> tuple[str, str, bytes]:
+        """Finality-lag decomposition: the latest per-epoch sample
+        (lag, participation by flag, missing votes by subnet) plus the
+        justification/finalization advance history."""
+        forensics = self._forensics()
+        if forensics is None:
+            return self._error(404, "no forensics plane attached")
+        return self._json({"data": forensics.finality_view()})
 
     def _debug_lanes(self) -> tuple[str, str, bytes]:
         """Live ingest scheduler snapshot (404 when the node runs the

@@ -411,6 +411,16 @@ async def _http_get(
     return await asyncio.wait_for(go(), timeout_s)
 
 
+def _count_by_kind(evidence) -> dict:
+    """Tally a scraped evidence list by ``kind`` (round-24 fleet view)."""
+    counts: dict[str, int] = {}
+    for record in evidence:
+        kind = (record or {}).get("kind")
+        if kind:
+            counts[kind] = counts.get(kind, 0) + 1
+    return counts
+
+
 def _parse_gauges(text: str, names=_FLEET_GAUGES) -> dict:
     """Lift a few label-less gauges out of a Prometheus exposition."""
     out: dict = {}
@@ -510,9 +520,27 @@ class FleetObservatory:
                 trace = await _http_get_json(
                     host, port, f"/debug/trace?node={name}", self.timeout_s
                 )
-                return metrics_status, metrics_body, slo, slot, peers, trace
+                # round-24 forensics: the memoized head snapshot and the
+                # reorg/evidence story ride the SAME one-budget pull.
+                # RuntimeError is the non-200 signature — a member
+                # without the plane answers 404 and its row simply
+                # carries no forensics; timeouts/conn failures still
+                # propagate and stale the whole row
+                async def maybe_json(path):
+                    try:
+                        return await _http_get_json(
+                            host, port, path, self.timeout_s
+                        )
+                    except RuntimeError:
+                        return None
 
-            (metrics_status, metrics_body, slo, slot, peers, trace) = (
+                forkchoice = await maybe_json("/debug/forkchoice")
+                reorgs = await maybe_json("/debug/reorgs")
+                return (metrics_status, metrics_body, slo, slot, peers,
+                        trace, forkchoice, reorgs)
+
+            (metrics_status, metrics_body, slo, slot, peers, trace,
+             forkchoice, reorgs) = (
                 await asyncio.wait_for(pull(), self.timeout_s)
             )
             if metrics_status != 200:
@@ -528,6 +556,8 @@ class FleetObservatory:
         slo_data = (slo or {}).get("data") or {}
         slot_data = (slot or {}).get("data") or {}
         peers_data = ((peers or {}).get("data") or {}).get("stats") or {}
+        fc_data = (forkchoice or {}).get("data") or {}
+        reorg_data = (reorgs or {}).get("data") or {}
         self._traces[name] = trace or {}
         self._rows[name] = {
             "member": name,
@@ -555,6 +585,17 @@ class FleetObservatory:
             },
             "delivery": peers_data.get("delivery") or {},
             "wire": peers_data.get("wire"),
+            # round-24 forensics columns: lifetime reorg count, the last
+            # post-mortem's depth, evidence tally by kind and the
+            # memoized head's freshness — None-shaped when the member
+            # answered 404 (no plane attached)
+            "reorgs": reorg_data.get("reorg_count"),
+            "last_reorg_depth": (
+                reorg_data["reorgs"][-1]["depth"]
+                if reorg_data.get("reorgs") else None
+            ),
+            "evidence": _count_by_kind(reorg_data.get("evidence") or ()),
+            "head_fresh": (fc_data.get("head_memo") or {}).get("fresh"),
         }
 
     # ------------------------------------------------------- merged views
@@ -607,6 +648,11 @@ class FleetObservatory:
                 max(head_slots) - min(head_slots) if head_slots else None
             ),
             "propagation_matrix": self.propagation_matrix(),
+            # round-24: per-member lifetime reorg counts at a glance
+            # (the full post-mortems stay on each member's /debug/reorgs)
+            "reorgs": {
+                r["member"]: r.get("reorgs") for r in rows
+            },
             "slo": report,
         }
 

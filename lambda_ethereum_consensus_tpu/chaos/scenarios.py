@@ -630,6 +630,26 @@ async def _equivocation(ctx: ScenarioContext) -> dict:
                 ctx.violation(
                     "equivocation", "an orphaned late block became a head"
                 )
+            # round-24 forensic gate (anti-silent-green): the injected
+            # twin block MUST survive as double-proposal evidence in at
+            # least one member's ledger — the receiving side applies both
+            # roots for one (slot, proposer) cell through on_block
+            evidence = [
+                e for node in fleet.nodes for e in node.forensics.evidence()
+            ]
+            double_proposals = [
+                e for e in evidence if e["kind"] == "double_proposal"
+            ]
+            double_votes = [
+                e for e in evidence if e["kind"] == "double_vote"
+            ]
+            if not double_proposals:
+                ok = False
+                ctx.violation(
+                    "equivocation",
+                    "the equivocating block pair left no double_proposal "
+                    "evidence in any member's forensic ledger",
+                )
         finally:
             await fleet.stop()
     injected = {
@@ -645,6 +665,8 @@ async def _equivocation(ctx: ScenarioContext) -> dict:
     return {
         "scenario": "equivocation", "ok": ok,
         "faults": injected, "converged_root": honest_root.hex(),
+        "forensic_double_proposals": len(double_proposals),
+        "forensic_double_votes": len(double_votes),
         **recovery,
     }
 
@@ -689,6 +711,7 @@ async def _partition(ctx: ScenarioContext) -> dict:
             fleet.sample_heads()
             fleet.heal()
             t_heal = time.monotonic()
+            t_heal_wall = time.time()  # ReorgRecord timestamps are wall clock
             # one more slot-clocked block after healing: its gossip
             # arrival hands the laggard a descendant whose ancestors it
             # back-fills through the (now unblocked) req/resp path
@@ -720,6 +743,27 @@ async def _partition(ctx: ScenarioContext) -> dict:
                     "fleet members did not reconverge on one head after "
                     f"healing (heads={[h.hex()[:12] for h in fleet.heads()]})",
                 )
+            # round-24 forensic gate (anti-silent-green): the healed
+            # laggard's post-heal ReorgRecord must pin a common ancestor
+            # from BEFORE the cut (ancestor at or under the seed tip,
+            # new head beyond it) — a member that secretly followed the
+            # majority would only mint post-heal records whose ancestors
+            # sit INSIDE the partition window
+            cut_slot = int(bundle.tip_state.slot)
+            heal_reorgs = [
+                r for r in fleet.nodes[2].forensics.reorgs()
+                if r["ts"] >= t_heal_wall
+                and r["ancestor_slot"] is not None
+                and r["ancestor_slot"] <= cut_slot
+                and r["slot"] > cut_slot
+            ]
+            if not heal_reorgs:
+                ok = False
+                ctx.violation(
+                    "partition",
+                    "healed laggard minted no ReorgRecord with a common "
+                    f"ancestor predating the cut (slot <= {cut_slot})",
+                )
         finally:
             await fleet.stop()
     m = get_metrics()
@@ -733,7 +777,12 @@ async def _partition(ctx: ScenarioContext) -> dict:
     return {
         "scenario": "partition", "ok": ok, "nodes": 3,
         "partition_slots": partition_slots, "diverged": diverged,
-        "faults": injected, "final_root": final_root.hex(), **recovery,
+        "faults": injected, "final_root": final_root.hex(),
+        "forensic_heal_reorgs": len(heal_reorgs),
+        "forensic_common_ancestors": sorted({
+            r["common_ancestor"][:14] for r in heal_reorgs
+        }),
+        **recovery,
     }
 
 

@@ -9,9 +9,12 @@ checkpoint (pulled-up tip) machinery.
 Layout: :mod:`.store` (the Store object + constructor), :mod:`.handlers`
 (``on_tick`` / ``on_block`` / ``on_attestation`` / ``on_attester_slashing``),
 :mod:`.head` (``get_head`` with batched vote-weight accumulation),
-:mod:`.tree` (incremental cached-head fork tree, ref: fork_choice/tree.ex).
+:mod:`.tree` (incremental cached-head fork tree, ref: fork_choice/tree.ex),
+:mod:`.forensics` (round-24 consensus audit plane: head-decision audits,
+reorg post-mortems, finality-lag decomposition, equivocation evidence).
 """
 
+from .forensics import ConsensusForensics, ReorgRecord
 from .handlers import (
     attestation_batch_target,
     on_attestation,
@@ -20,19 +23,22 @@ from .handlers import (
     on_block,
     on_tick,
 )
-from .head import get_head, get_weight
+from .head import get_head, get_weight, head_candidates
 from .store import ForkChoiceError, LatestMessage, Store, get_forkchoice_store
 from .tree import ForkTree
 
 __all__ = [
+    "ConsensusForensics",
     "ForkChoiceError",
     "ForkTree",
     "LatestMessage",
+    "ReorgRecord",
     "Store",
     "attestation_batch_target",
     "get_forkchoice_store",
     "get_head",
     "get_weight",
+    "head_candidates",
     "on_attestation",
     "on_attestation_batch",
     "on_attester_slashing",

@@ -62,12 +62,17 @@ def _on_tick_per_slot(store: Store, time: int, spec: ChainSpec) -> None:
 def update_checkpoints(
     store: Store, justified: Checkpoint, finalized: Checkpoint
 ) -> None:
+    forensics = getattr(store, "forensics", None)
     if justified.epoch > store.justified_checkpoint.epoch:
         store.justified_checkpoint = justified
         store.bump()
+        if forensics is not None:
+            forensics.note_justified(int(justified.epoch), bytes(justified.root))
     if finalized.epoch > store.finalized_checkpoint.epoch:
         store.finalized_checkpoint = finalized
         store.bump()
+        if forensics is not None:
+            forensics.note_finalized(int(finalized.epoch), bytes(finalized.root))
         if store.head_cache is not None:
             store.head_cache.prune(bytes(finalized.root))
         # checkpoint states + attestation contexts below the finalized
@@ -121,6 +126,12 @@ def on_block(
     )
     root = block.hash_tree_root(spec)
     store.add_block(root, block, state)
+    forensics = getattr(store, "forensics", None)
+    if forensics is not None:
+        # evidence ledger: a second distinct root for (slot, proposer)
+        # is a double proposal — observed here, AFTER full validation,
+        # so only blocks that actually entered fork choice count
+        forensics.note_block(root, int(block.slot), int(block.proposer_index))
 
     # proposer boost for timely blocks (first 1/INTERVALS_PER_SLOT of the slot)
     time_into_slot = (store.time - store.genesis_time) % spec.SECONDS_PER_SLOT
@@ -369,13 +380,20 @@ def on_attestation_batch(
     verify = _attestation_batch_cached if cached else _attestation_batch_host
     with span("attestation_batch_verify", path=path, n_devices=n_devices):
         verify(store, attestations, is_from_block, spec, results)
+    batch_id = None
     if live_traces:
         from ..tracing import record_verify_batch
 
-        record_verify_batch(
+        batch_id = record_verify_batch(
             traces, results, path, t0, _time.monotonic() - t0,
             n_devices=n_devices,
         )
+    forensics = getattr(store, "forensics", None)
+    if forensics is not None and attestations:
+        # weight-event log: this batch is a reorg-attribution candidate;
+        # batch_id joins it to the flight recorder's batch span (None
+        # when tracing was off — the forensic record still lands)
+        forensics.note_attestation_batch(batch_id, path, len(attestations))
     return results
 
 
@@ -713,3 +731,6 @@ def on_attester_slashing(
     if store.head_cache is not None:
         for i in equivocators:
             store.head_cache.on_equivocation(i)
+    forensics = getattr(store, "forensics", None)
+    if forensics is not None:
+        forensics.note_attester_slashing(equivocators)

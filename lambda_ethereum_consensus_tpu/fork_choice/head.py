@@ -139,15 +139,16 @@ def get_head(store: Store, spec: ChainSpec | None = None) -> bytes:
     # belt and braces: the sizes catch direct-mutation callers that grow
     # blocks/votes/equivocations without going through bump() (vote MOVES
     # at constant count still require bump(), which every handler does)
-    memo_key = (
-        store.mutations,
-        store.current_slot(spec),
-        len(store.blocks),
-        len(store.latest_messages),
-        len(store.equivocating_indices),
-    )
+    memo_key = _memo_key(store, spec)
     if store.head_memo is not None and store.head_memo[0] == memo_key:
         return store.head_memo[1]
+    # the forensics audit hook rides ONLY the cold walk (round 24): memo
+    # hits stay O(1) with zero instrumentation cost, and the scored lists
+    # below are the same _subtree_weight calls max() would have made
+    forensics = getattr(store, "forensics", None)
+    if forensics is not None and not forensics.enabled:
+        forensics = None
+    branch_points: list | None = [] if forensics is not None else None
     # only the cold walk is spanned: a memo hit must stay O(1) with zero
     # instrumentation cost (it runs per API request and per tick)
     with span("fork_choice_head_recompute"):
@@ -155,15 +156,79 @@ def get_head(store: Store, spec: ChainSpec | None = None) -> bytes:
         head = bytes(store.justified_checkpoint.root)
         # one vote scan per head call; the walk reuses it at every level
         vote_weights = _vote_weights_by_root(store, spec)
+        boost = bytes(store.proposer_boost_root)
         while True:
             children = [
                 root for root in store.children.get(head, []) if root in blocks
             ]
             if not children:
                 store.head_memo = (memo_key, head)
+                if branch_points is not None:
+                    forensics.note_head_audit(
+                        slot=store.current_slot(spec),
+                        head=head,
+                        branch_points=branch_points,
+                        # filter verdicts: stored blocks the viability
+                        # filter rejected from the walked tree (capped)
+                        filtered_out=[
+                            r for r in store.blocks if r not in blocks
+                        ][:16],
+                    )
                 return head
             # weight-descending, root as tiebreak (spec: lexicographic max)
-            head = max(
-                children,
-                key=lambda r: (_subtree_weight(store, r, vote_weights, spec), r),
-            )
+            scored = [
+                (_subtree_weight(store, r, vote_weights, spec), r)
+                for r in children
+            ]
+            if branch_points is not None and len(scored) > 1:
+                branch_points.append({
+                    "parent": "0x" + head.hex(),
+                    "candidates": [
+                        {
+                            "root": "0x" + r.hex(),
+                            "weight": int(w),
+                            "boost": (
+                                get_proposer_score(store, spec)
+                                if boost != b"\x00" * 32
+                                and store.get_ancestor(
+                                    boost, store.blocks[r].slot
+                                ) == r
+                                else 0
+                            ),
+                        }
+                        for w, r in sorted(scored, reverse=True)
+                    ],
+                })
+            head = max(scored)[1]
+
+
+def _memo_key(store: Store, spec: ChainSpec) -> tuple:
+    return (
+        store.mutations,
+        store.current_slot(spec),
+        len(store.blocks),
+        len(store.latest_messages),
+        len(store.equivocating_indices),
+    )
+
+
+def head_candidates(store: Store, spec: ChainSpec | None = None) -> dict:
+    """Cheap head snapshot off the existing ``(mutations, slot)`` memo
+    — the ``/debug/forkchoice`` accessor (round 24).  NEVER forces an
+    uncached full recompute: a stale memo is reported as ``fresh:
+    false`` with the last memoized head, and the candidate detail comes
+    from the forensics plane's last cold-walk audit (None until the
+    first recompute lands)."""
+    spec = spec or get_chain_spec()
+    memo = store.head_memo
+    fresh = memo is not None and memo[0] == _memo_key(store, spec)
+    forensics = getattr(store, "forensics", None)
+    return {
+        "head": "0x" + memo[1].hex() if memo is not None else None,
+        "fresh": bool(fresh),
+        "mutations": int(store.mutations),
+        "slot": int(store.current_slot(spec)),
+        "last_audit": (
+            forensics.last_audit() if forensics is not None else None
+        ),
+    }

@@ -20,6 +20,7 @@ from ..config import ChainSpec, constants, get_chain_spec
 from ..config.presets import FORK_ORDER
 from ..da import DataAvailability
 from ..fork_choice import (
+    ConsensusForensics,
     Store,
     attestation_batch_target,
     get_forkchoice_store,
@@ -183,6 +184,12 @@ class BeaconNode:
         self.duties = None  # DutyScheduler when config.duty_keys is set
         self._duty_task: asyncio.Task | None = None
         self._head_root: bytes | None = None  # last head seen by _on_applied
+        # consensus forensics plane (round 24): per-NODE for the same
+        # reason as the metrics registry above — co-resident fleet
+        # members each keep their own reorg/evidence story.  Attached to
+        # the store in start() so the free-function handlers reach it
+        # via getattr(store, "forensics", None).
+        self.forensics = ConsensusForensics()
         self._tasks: list[asyncio.Task] = []
         self._subs: list[TopicSubscription] = []
         self.ingest: IngestScheduler | None = None
@@ -231,6 +238,7 @@ class BeaconNode:
         self.store = get_forkchoice_store(
             anchor_state, anchor_block, spec, anchor_root=anchor_root
         )
+        self.store.forensics = self.forensics
         # catch the store up to wall clock immediately (ref: on_tick_now at
         # fork_choice/store.ex:65-82) so blocks are acceptable before the
         # first timer tick
@@ -730,6 +738,14 @@ class BeaconNode:
                 offset = observe_block_arrival(
                     self.slot_clock, int(block.message.slot)
                 )
+                # weight-event log: a late block that later flips the
+                # head is named (with this offset) in the ReorgRecord's
+                # attribution.  No root here — merkleizing on the gossip
+                # admission path would break the O(1)-per-event budget;
+                # the forensic join keys on (slot, arrival offset).
+                self.forensics.note_block_arrival(
+                    None, int(block.message.slot), offset
+                )
                 if msg.trace is not None:
                     msg.trace.event(
                         "slot_phase",
@@ -1009,8 +1025,19 @@ class BeaconNode:
                 or (epoch, key) in batch_keys
             ):
                 verdicts[pos] = VERDICT_IGNORE
+                # the IGNORE is correct for fork choice, but a duplicate
+                # cell carrying a DIFFERENT head root is a double vote —
+                # retained as ledger evidence instead of vanishing here
+                self.forensics.note_vote(
+                    (epoch,) + key, bytes(att.data.beacon_block_root)
+                )
                 continue
             batch_keys.add((epoch, key))
+            # first-seen root for the cell, recorded BEFORE the verify
+            # verdict lands so a same-batch twin still compares roots
+            self.forensics.note_vote(
+                (epoch,) + key, bytes(att.data.beacon_block_root)
+            )
             passed.append(msg)
             passed_pos.append(pos)
             passed_keys.append((epoch, key))
@@ -1089,6 +1116,10 @@ class BeaconNode:
              "root": head.hex()[:16],
              "delay_s": round(delay, 4)},
         )
+        # forensics post-mortem (round 24): EVERY transition mints a
+        # ReorgRecord — depth 0 for plain chain extension, and the
+        # depth/ancestor/attribution story for actual weight reorgs
+        self.forensics.observe_transition(self.store, prev, head)
 
     # ---------------------------------------------------------------- loops
 
@@ -1105,6 +1136,11 @@ class BeaconNode:
                 self._persist_finality()
                 self._sample_device_telemetry()
                 self._maybe_poll_gossip_stats()
+                # finality-lag decomposition: observes on the FIRST tick
+                # and then once per epoch change (internal dedup) — the
+                # first-tick sample guarantees every soak scenario emits
+                # at least one finality_lag_epochs observation
+                self.forensics.observe_epoch(self.store, self.spec)
                 # one SLO evaluation per tick: publishes the slo_* gauges
                 # and appends the burn-rate snapshot the multi-window
                 # evaluation (and /debug/slo) reads — at 1 Hz the engine's
@@ -1397,6 +1433,10 @@ class BeaconNode:
             # on) would silently consume the delta and lose the drops
             _trace_dropped_exported = rec["dropped_total"]
             proc_m.inc("trace_recorder_dropped_total", value=delta)
+        # forensic ring-drop deltas: the cursor lives ON the per-node
+        # forensics instance (unlike the process-wide recorder above),
+        # so co-resident fleet members each export their own drops
+        self.forensics.export_ring_drops(self.metrics)
 
     async def _range_sync(self) -> None:
         sync = SyncBlocks(self.store, self.pending, self.downloader, self.spec)

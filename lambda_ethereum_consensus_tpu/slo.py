@@ -236,6 +236,28 @@ SOAK_SLOS = DEFAULT_SLOS + STORAGE_SLOS + (
         # divergence row above)
         "block DA gate: expected blob columns verified within the window",
     ),
+    SloDef(
+        "reorg_depth_p95", "reorg_depth",
+        0.95, 4.0,
+        # the forensics plane (round 24) observes EVERY head transition,
+        # depth 0 for plain fast-forwards — so steady-state p95 sits at
+        # 0 and the budget bounds how deep the chaos scenarios' weight
+        # flips may actually orphan (a healed partition fast-forwards;
+        # a real competing-branch reorg deeper than a few blocks means
+        # votes were badly split for multiple slots)
+        "head transitions orphan at most a few blocks at p95",
+    ),
+    SloDef(
+        "finality_lag_p95", "finality_lag_epochs",
+        0.95, 32.0,
+        # soak fleets justify/finalize only when duty keys drive full
+        # committee participation, so lag GROWS over a keyless scenario
+        # at one epoch per epoch — the budget is an is-the-clock-sane
+        # ceiling sized to the soak windows (a 16 s minimal-spec epoch
+        # x 32 bounds scenarios well past the longest profile), not a
+        # mainnet finality target
+        "finality lag stays under the soak-window ceiling",
+    ),
 )
 
 

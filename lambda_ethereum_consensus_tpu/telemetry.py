@@ -186,6 +186,12 @@ _HELP = {
     "da_sidecars_total": "blob sidecars judged by the DA gate, by result (accept|duplicate|orphan|mismatch|evicted)",
     "da_blocks_pending": "blocks currently parked behind incomplete blob-column sets",
     "da_blobs_withheld_total": "blob-sidecar publishes swallowed by the chaos withholding adversary",
+    "reorg_depth": "blocks orphaned per head transition (0 = fast-forward onto a descendant)",
+    "finality_lag_epochs": "current epoch minus finalized epoch, sampled per epoch by the forensics tracker",
+    "participation_rate": "previous-epoch participation fraction, by Altair timeliness flag",
+    "subnet_missing_votes": "committee members with no current-epoch latest message, by attestation subnet",
+    "forensics_evidence_total": "equivocation evidence records minted, by kind (double_proposal|double_vote|attester_slashing)",
+    "forensics_ring_dropped_total": "forensic ring entries overwritten (overwrite-oldest), by ring",
 }
 
 

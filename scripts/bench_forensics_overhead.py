@@ -1,0 +1,234 @@
+"""Forensics-plane overhead micro-bench on a synthetic gossip drain.
+
+The round-24 consensus forensics plane (fork_choice/forensics.py) rides
+the hottest paths in the client: one ``note_vote`` per admitted subnet
+attestation, one ``note_attestation_batch`` per drain flush, one
+``note_block_arrival`` per gossip block.  The acceptance bar: enabled
+forensics < 1% of the drain-item cost, disabled (``FORENSICS_OFF``)
+< 0.1%.
+
+Measurement design mirrors ``bench_telemetry_overhead.py`` —
+**differential**, not whole-drain A/B: the forensic note is a lock +
+dict probe against a ~hundreds-of-microseconds drain item, far below
+the shared-host A/B noise floor.  This stage:
+
+1. times the REAL synthetic drain item (raw-snappy decompress + SSZ
+   ``Attestation`` decode + top-level ``AttestationData`` root) to get
+   the denominator;
+2. times tight paired loops of the exact per-item call the plane adds
+   (``note_vote`` on a steady-state cell — the first-seen map is
+   pre-seeded, so the timed path is the dict-hit path every admitted
+   duplicate-free vote pays) in all three modes (base loop / disabled
+   plane / enabled plane), mode order rotated per round, per-round
+   deltas, median;
+3. adds the per-batch note (``note_attestation_batch``, one per drain
+   flush) amortized over the batch.
+
+Emits one JSON line per metric (bench.py's guarded-subprocess contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from lambda_ethereum_consensus_tpu.compression.snappy import (  # noqa: E402
+    compress,
+    decompress,
+)
+from lambda_ethereum_consensus_tpu.config import (  # noqa: E402
+    minimal_spec,
+    use_chain_spec,
+)
+from lambda_ethereum_consensus_tpu.fork_choice.forensics import (  # noqa: E402
+    ConsensusForensics,
+)
+
+
+def _payloads(spec, batch: int) -> list[bytes]:
+    """One gossip batch: snappy-compressed SSZ attestations (distinct
+    slots so the decode work is not byte-identical across items)."""
+    from lambda_ethereum_consensus_tpu.ssz.bitfields import Bitlist
+    from lambda_ethereum_consensus_tpu.types.beacon import (
+        Attestation,
+        AttestationData,
+        Checkpoint,
+    )
+
+    out = []
+    for i in range(batch):
+        att = Attestation(
+            aggregation_bits=Bitlist(64, bytes([1 << (i % 8)]) + b"\x00" * 7),
+            data=AttestationData(
+                slot=8 + i,
+                index=i % 4,
+                beacon_block_root=bytes([i % 256]) * 32,
+                source=Checkpoint(epoch=0, root=b"\x11" * 32),
+                target=Checkpoint(epoch=1, root=b"\x22" * 32),
+            ),
+            signature=b"\xab" * 96,
+        )
+        out.append(compress(att.encode(spec)))
+    return out
+
+
+def _drain(payloads, spec, att_type) -> int:
+    """The synthetic drain's per-item work (the overhead denominator):
+    decompress + decode + the top-level data root."""
+    ok = 0
+    for raw in payloads:
+        att = att_type.decode(decompress(raw), spec)
+        att.data.hash_tree_root(spec)
+        ok += 1
+    return ok
+
+
+def _time_once(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def _median(xs) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else (xs[n // 2 - 1] + xs[n // 2]) / 2
+
+
+def _paired_deltas(mode_fns: dict, rounds: int) -> dict:
+    """Median of PER-ROUND deltas vs that round's ``base`` timing
+    (order rotated per round so monotonic drift cannot bias a fixed
+    position; the delta is taken within the round so a slow-machine
+    epoch inflates both arms and cancels)."""
+    names = list(mode_fns)
+    deltas: dict[str, list[float]] = {n: [] for n in names if n != "base"}
+    base_samples: list[float] = []
+    gc.disable()
+    try:
+        for r in range(rounds):
+            gc.collect()
+            t: dict[str, float] = {}
+            for i in range(len(names)):
+                name = names[(r + i) % len(names)]
+                t[name] = _time_once(mode_fns[name])
+            base_samples.append(t["base"])
+            for name in deltas:
+                deltas[name].append(t[name] - t["base"])
+    finally:
+        gc.enable()
+    out = {n: _median(s) for n, s in deltas.items()}
+    out["base"] = _median(base_samples)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--calls", type=int, default=2500,
+                    help="forensic notes per sample")
+    ap.add_argument("--rounds", type=int, default=51)
+    args = ap.parse_args()
+
+    with use_chain_spec(minimal_spec()) as spec:
+        from lambda_ethereum_consensus_tpu.types.beacon import Attestation
+
+        payloads = _payloads(spec, args.batch)
+        n = args.calls
+
+        # -- the denominator: real drain item cost
+        _drain(payloads, spec, Attestation)  # warm codec memos
+        drain_s = _median(
+            [_time_once(lambda: _drain(payloads, spec, Attestation))
+             for _ in range(9)]
+        )
+        item_s = drain_s / args.batch
+
+        # -- the differential: the exact per-item call the plane adds.
+        # Steady-state cells: pre-seed the first-seen map so the timed
+        # path is the dict-hit + root-compare every duplicate-free
+        # admitted vote pays (the first-insert path runs once per cell
+        # per epoch and is cheaper than the evidence mint it guards).
+        plane_on = ConsensusForensics(capacity=512, enabled=True)
+        plane_off = ConsensusForensics(capacity=512, enabled=False)
+        root = b"\x42" * 32
+        cells = [(1, 8 + (i % 64), i % 4, i % 128, b"\x33") for i in range(n)]
+        for cell in cells:
+            plane_on.note_vote(cell, root)
+
+        def votes_base():
+            for cell in cells:
+                pass
+
+        def votes_noop():
+            f = plane_off.note_vote
+            for cell in cells:
+                f(cell, root)
+
+        def votes_on():
+            f = plane_on.note_vote
+            for cell in cells:
+                f(cell, root)
+
+        votes_base(), votes_noop(), votes_on()  # warm
+        med = _paired_deltas(
+            {"base": votes_base, "noop": votes_noop, "on": votes_on},
+            args.rounds,
+        )
+        per_item_noop_s = max(0.0, med["noop"]) / n
+        per_item_on_s = max(0.0, med["on"]) / n
+
+        # -- per-batch note (one per drain flush), amortized
+        def batch_notes_on():
+            f = plane_on.note_attestation_batch
+            for _ in range(n):
+                f(7, "cached", args.batch)
+
+        def batch_notes_off():
+            f = plane_off.note_attestation_batch
+            for _ in range(n):
+                f(7, "cached", args.batch)
+
+        batch_notes_on(), batch_notes_off()  # warm
+        batch_on_s = _median(
+            [_time_once(batch_notes_on) for _ in range(5)]
+        ) / n
+        batch_noop_s = _median(
+            [_time_once(batch_notes_off) for _ in range(5)]
+        ) / n
+
+    on_pct = (per_item_on_s + batch_on_s / args.batch) / item_s * 100.0
+    noop_pct = (per_item_noop_s + batch_noop_s / args.batch) / item_s * 100.0
+    common = {
+        "unit": "%",
+        "batch": args.batch,
+        "rounds": args.rounds,
+        "drain_item_us": round(item_s * 1e6, 2),
+    }
+    print(json.dumps({
+        "metric": "forensics_overhead_pct",
+        "value": round(on_pct, 3),
+        "budget_pct": 1.0,
+        "within_budget": on_pct < 1.0,
+        "note_cost_us": round(per_item_on_s * 1e6, 3),
+        "batch_cost_us": round(batch_on_s * 1e6, 3),
+        **common,
+    }), flush=True)
+    print(json.dumps({
+        "metric": "forensics_noop_overhead_pct",
+        "value": round(noop_pct, 3),
+        "budget_pct": 0.1,
+        "within_budget": noop_pct < 0.1,
+        "note_cost_us": round(per_item_noop_s * 1e6, 3),
+        "batch_cost_us": round(batch_noop_s * 1e6, 3),
+        **common,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

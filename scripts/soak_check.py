@@ -98,6 +98,12 @@ EXERCISED_BY = {
     # round 23: the DA withholding scenario drives the availability-gate
     # wait histogram (deneb blob sampling; da/availability.py)
     "da_availability_p95": {"da"},
+    # round 24: the forensics plane observes reorg_depth on every head
+    # transition and finality_lag_epochs on every node's first tick +
+    # epoch change — both fleet-scenario rows, gated where the forensic
+    # story itself is asserted against the injected faults
+    "reorg_depth_p95": {"partition", "equivocation"},
+    "finality_lag_p95": {"partition", "equivocation"},
 }
 
 

@@ -26,6 +26,7 @@ def test_required_metrics_honors_env_gates():
         "BENCH_NO_MAINNET": "1", "BENCH_NO_INGEST": "1",
         "BENCH_NO_PLANES": "1", "BENCH_NO_PIPELINE": "1",
         "BENCH_NO_TELEMETRY": "1", "BENCH_NO_TRACE": "1",
+        "BENCH_NO_FORENSICS": "1",
         "BENCH_NO_SHARD": "1", "BENCH_NO_STATE_SHARD": "1",
         "BENCH_NO_WITNESS": "1", "BENCH_NO_KZG": "1",
         "BENCH_NO_DUTIES": "1", "BENCH_NO_API": "1",
@@ -246,6 +247,7 @@ def test_validate_cli_passes_on_covered_artifact(tmp_path):
     # narrow the required set to the two ungated metrics
     for knob in ("BENCH_NO_MAINNET", "BENCH_NO_INGEST", "BENCH_NO_PLANES",
                  "BENCH_NO_PIPELINE", "BENCH_NO_TELEMETRY", "BENCH_NO_TRACE",
+                 "BENCH_NO_FORENSICS",
                  "BENCH_NO_SHARD", "BENCH_NO_STATE_SHARD",
                  "BENCH_NO_WITNESS", "BENCH_NO_KZG", "BENCH_NO_DUTIES",
                  "BENCH_NO_API"):

@@ -381,26 +381,43 @@ _CANNED = {
 }
 
 
-async def _canned_member(reader, writer):
-    line = await reader.readline()
-    path = line.split()[1].decode().split("?")[0]
-    while (await reader.readline()) not in (b"\r\n", b""):
-        pass
-    if path.startswith("/debug/trace"):
-        body = json.dumps({"traceEvents": []}).encode()
-    else:
-        canned = _CANNED[path]
-        body = (
-            canned.encode() if isinstance(canned, str)
-            else json.dumps(canned).encode()
+def _member_handler(canned):
+    async def handler(reader, writer):
+        line = await reader.readline()
+        path = line.split()[1].decode().split("?")[0]
+        while (await reader.readline()) not in (b"\r\n", b""):
+            pass
+        if path.startswith("/debug/trace"):
+            body = json.dumps({"traceEvents": []}).encode()
+        elif path not in canned:
+            # a member without a route answers 404 like a real older
+            # build (pre-round-24 members have no forensics routes)
+            writer.write(
+                b"HTTP/1.1 404 Not Found\r\nConnection: close\r\n\r\nnope"
+            )
+            try:
+                await writer.drain()
+            finally:
+                writer.close()
+            return
+        else:
+            doc = canned[path]
+            body = (
+                doc.encode() if isinstance(doc, str)
+                else json.dumps(doc).encode()
+            )
+        writer.write(
+            b"HTTP/1.1 200 OK\r\nConnection: close\r\n\r\n" + body
         )
-    writer.write(
-        b"HTTP/1.1 200 OK\r\nConnection: close\r\n\r\n" + body
-    )
-    try:
-        await writer.drain()
-    finally:
-        writer.close()
+        try:
+            await writer.drain()
+        finally:
+            writer.close()
+
+    return handler
+
+
+_canned_member = _member_handler(_CANNED)
 
 
 def test_scrape_merges_member_row_and_propagation_matrix():
@@ -435,6 +452,50 @@ def test_scrape_merges_member_row_and_propagation_matrix():
             }},
         }
         assert m.get("fleet_scrape_errors_total", member="m0") == 0.0
+        # mixed-version containment: this member 404s the round-24
+        # forensics routes — None-shaped columns, row NOT stale
+        assert row["reorgs"] is None and row["last_reorg_depth"] is None
+        assert row["evidence"] == {} and row["head_fresh"] is None
+
+    run(main())
+
+
+def test_scrape_merges_forensic_columns_and_fleet_reorg_counts():
+    canned = dict(_CANNED)
+    canned["/debug/forkchoice"] = {"data": {
+        "nodes": [], "tree_head": "0xabc",
+        "head_memo": {"head": "0xabc", "fresh": True},
+    }}
+    canned["/debug/reorgs"] = {"data": {
+        "reorg_count": 3,
+        "reorgs": [{"depth": 0}, {"depth": 2}],
+        "evidence": [
+            {"kind": "double_proposal"}, {"kind": "double_vote"},
+            {"kind": "double_vote"},
+        ],
+        "stats": {},
+    }}
+
+    async def main():
+        srv = await asyncio.start_server(
+            _member_handler(canned), "127.0.0.1", 0
+        )
+        obs = FleetObservatory(
+            members=[("m0", "127.0.0.1", srv.sockets[0].getsockname()[1])],
+            timeout_s=2.0,
+            metrics=Metrics(enabled=True),
+        )
+        try:
+            view = await obs.scrape_once()
+        finally:
+            srv.close()
+            await srv.wait_closed()
+        row = view["members"][0]
+        assert row["stale"] is False
+        assert row["reorgs"] == 3 and row["last_reorg_depth"] == 2
+        assert row["evidence"] == {"double_proposal": 1, "double_vote": 2}
+        assert row["head_fresh"] is True
+        assert view["reorgs"] == {"m0": 3}
 
     run(main())
 

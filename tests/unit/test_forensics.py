@@ -1,0 +1,442 @@
+"""Round-24 consensus forensics plane: cold-walk head audits, reorg
+post-mortems with weight-event attribution, finality-lag decomposition
+naming the withheld subnet, the deduplicated equivocation ledger, ring
+bounds under the FORENSICS_* knobs, and the three debug routes served
+over live HTTP."""
+
+import asyncio
+import json
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from lambda_ethereum_consensus_tpu.config import (
+    constants,
+    minimal_spec,
+    use_chain_spec,
+)
+from lambda_ethereum_consensus_tpu.crypto import bls
+from lambda_ethereum_consensus_tpu.fork_choice import (
+    ConsensusForensics,
+    get_forkchoice_store,
+    get_head,
+    head_candidates,
+    on_attestation,
+    on_block,
+    on_tick,
+)
+from lambda_ethereum_consensus_tpu.fork_choice.store import LatestMessage
+from lambda_ethereum_consensus_tpu.state_transition import accessors, misc
+from lambda_ethereum_consensus_tpu.state_transition.genesis import (
+    build_genesis_state,
+)
+from lambda_ethereum_consensus_tpu.telemetry import Metrics
+from lambda_ethereum_consensus_tpu.types.beacon import (
+    Attestation,
+    AttestationData,
+    BeaconBlock,
+    BeaconBlockBody,
+    Checkpoint,
+)
+
+from .test_fork_choice import SKS, build_block
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+@pytest.fixture(scope="module")
+def chain():
+    with use_chain_spec(minimal_spec()) as spec:
+        genesis = build_genesis_state([bls.sk_to_pk(sk) for sk in SKS], spec=spec)
+        anchor_header = genesis.latest_block_header.copy(
+            state_root=genesis.hash_tree_root(spec)
+        )
+        anchor_block = BeaconBlock(
+            slot=0,
+            proposer_index=0,
+            parent_root=bytes(anchor_header.parent_root),
+            state_root=genesis.hash_tree_root(spec),
+            body=BeaconBlockBody(),
+        )
+        yield genesis, anchor_block, spec
+
+
+def _store_with_forensics(genesis, anchor_block, spec, **kw):
+    store = get_forkchoice_store(genesis, anchor_block, spec)
+    store.forensics = ConsensusForensics(**kw)
+    return store, anchor_block.hash_tree_root(spec)
+
+
+def _attest(store, root, committee_index, spec, anchor_root):
+    committee = accessors.get_beacon_committee(
+        store.block_states[root], 1, committee_index, spec
+    )
+    data = AttestationData(
+        slot=1,
+        index=committee_index,
+        beacon_block_root=root,
+        source=store.justified_checkpoint,
+        target=Checkpoint(epoch=0, root=anchor_root),
+    )
+    domain = accessors.get_domain(
+        store.block_states[root], constants.DOMAIN_BEACON_ATTESTER, 0, spec
+    )
+    signing_root = misc.compute_signing_root(data, domain)
+    sigs = [bls.sign(SKS[i], signing_root) for i in committee]
+    att = Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=bls.aggregate(sigs),
+    )
+    on_attestation(store, att, spec=spec)
+
+
+def _two_branch_store(genesis, anchor_block, spec, **kw):
+    """Anchor + two competing slot-1 blocks, forensics attached."""
+    store, anchor_root = _store_with_forensics(
+        genesis, anchor_block, spec, **kw
+    )
+    signed_a, _ = build_block(genesis, spec, 1, graffiti=b"\xaa" * 32)
+    signed_b, _ = build_block(genesis, spec, 1, graffiti=b"\xbb" * 32)
+    on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+    root_a = on_block(store, signed_a, spec=spec)
+    root_b = on_block(store, signed_b, spec=spec)
+    return store, anchor_root, root_a, root_b
+
+
+# --------------------------------------------------- cold-walk head audit
+
+
+def test_cold_walk_records_branch_points_and_memo_hits_stay_free(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root, root_a, root_b = _two_branch_store(
+            genesis, anchor_block, spec
+        )
+        head = get_head(store, spec)
+        audit = store.forensics.last_audit()
+        assert audit is not None
+        assert audit["head"] == "0x" + head.hex()
+        (bp,) = audit["branch_points"]
+        assert bp["parent"] == "0x" + anchor_root.hex()
+        cands = {c["root"] for c in bp["candidates"]}
+        assert cands == {"0x" + root_a.hex(), "0x" + root_b.hex()}
+        # zero-weight tie: candidates carry their weights, boost inactive
+        assert all(c["weight"] == 0 and c["boost"] == 0
+                   for c in bp["candidates"])
+        # a memo hit must not append a second audit
+        appended = store.forensics.stats()["rings"]["head_audit"]
+        assert get_head(store, spec) == head
+        assert (store.forensics.stats()["rings"]["head_audit"]["appended_total"]
+                == appended["appended_total"])
+
+
+def test_head_candidates_never_forces_a_recompute(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root, root_a, root_b = _two_branch_store(
+            genesis, anchor_block, spec
+        )
+        head = get_head(store, spec)
+        snap = head_candidates(store, spec)
+        assert snap["fresh"] is True
+        assert snap["head"] == "0x" + head.hex()
+        assert snap["last_audit"]["head"] == "0x" + head.hex()
+        # a vote moves the store: the snapshot goes stale but still
+        # reports the memoized head, and the memo itself is untouched
+        _attest(store, min(root_a, root_b), 0, spec, anchor_root)
+        memo_before = store.head_memo
+        snap = head_candidates(store, spec)
+        assert snap["fresh"] is False
+        assert snap["head"] == "0x" + memo_before[1].hex()
+        assert store.head_memo is memo_before
+
+
+# ------------------------------------------------------ reorg post-mortem
+
+
+def test_reorg_record_pins_depth_ancestor_and_attribution(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root, root_a, root_b = _two_branch_store(
+            genesis, anchor_block, spec
+        )
+        baseline = get_head(store, spec)
+        loser = min(root_a, root_b)
+        assert baseline == max(root_a, root_b)
+        # the weight events observed between transitions become the
+        # next record's attribution: one drained batch (trace batch id
+        # 5) and one late block arrival
+        store.forensics.note_attestation_batch(5, "cached", 3)
+        store.forensics.note_block_arrival(loser, 1, 3.25)
+        _attest(store, loser, 0, spec, anchor_root)
+        assert get_head(store, spec) == loser
+
+        rec = store.forensics.observe_transition(store, baseline, loser)
+        assert rec.depth == 1
+        assert rec.orphaned == ["0x" + baseline.hex()]
+        assert rec.common_ancestor == "0x" + anchor_root.hex()
+        assert rec.ancestor_slot == 0
+        kinds = [(e["kind"], e.get("batch"), e.get("offset_s"))
+                 for e in rec.attribution]
+        assert ("attestation_batch", 5, None) in kinds
+        assert ("block_arrival", None, 3.25) in kinds
+        assert store.forensics.reorg_count() == 1
+        assert store.forensics.reorgs()[-1]["new_head"] == "0x" + loser.hex()
+
+        # the attribution window advanced: a second flip with no new
+        # weight events attributes nothing (no double counting)
+        rec2 = store.forensics.observe_transition(store, loser, baseline)
+        assert rec2.attribution == []
+
+        # non-transitions and unknown roots mint nothing
+        assert store.forensics.observe_transition(store, loser, loser) is None
+        assert (
+            store.forensics.observe_transition(store, b"\x13" * 32, loser)
+            is None
+        )
+
+
+def test_fast_forward_is_depth_zero_with_pinned_ancestor(chain):
+    """A healed partition member jumps onto a descendant chain: nothing
+    is orphaned, but the record still pins where its stale view forked
+    (the partition-scenario gate keys on exactly this)."""
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = _store_with_forensics(
+            genesis, anchor_block, spec
+        )
+        signed1, post1 = build_block(genesis, spec, 1)
+        signed2, _ = build_block(post1, spec, 2)
+        on_tick(store, store.genesis_time + 2 * spec.SECONDS_PER_SLOT, spec)
+        root1 = on_block(store, signed1, spec=spec)
+        root2 = on_block(store, signed2, spec=spec)
+        rec = store.forensics.observe_transition(store, root1, root2)
+        assert rec.depth == 0 and rec.orphaned == []
+        assert rec.common_ancestor == "0x" + root1.hex()
+        assert rec.ancestor_slot == 1
+
+
+# ------------------------------------------------ finality decomposition
+
+
+def test_finality_decomposition_names_the_withheld_subnet(chain):
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store, anchor_root = _store_with_forensics(
+            genesis, anchor_block, spec
+        )
+        # advance the clock two epochs past the (genesis) finalized
+        # checkpoint: lag = 2
+        slot = 2 * spec.SLOTS_PER_EPOCH
+        on_tick(store, store.genesis_time + slot * spec.SECONDS_PER_SLOT, spec)
+        # a hand-built epoch-1 committee table (the shape the verify
+        # path caches): two committees of two on the epoch's first slot
+        start_slot = spec.SLOTS_PER_EPOCH
+        store.attestation_contexts[(1, b"\x01" * 32)] = SimpleNamespace(
+            committees_per_slot=2,
+            lengths=np.array([2, 2], np.int64),
+            start_slot=start_slot,
+            committees=np.array([[0, 1], [2, 3]], np.int32),
+        )
+        # committee 0 voted this epoch; committee 1's votes were withheld
+        store.latest_messages[0] = LatestMessage(epoch=1, root=anchor_root)
+        store.latest_messages[1] = LatestMessage(epoch=1, root=anchor_root)
+
+        rec = store.forensics.observe_epoch(store, spec)
+        assert rec["finality_lag_epochs"] == 2
+        assert rec["justification_lag_epochs"] == 2
+        assert rec["committee_table_epoch"] == 1
+        voted_subnet = str(int(
+            misc.compute_subnet_for_attestation(2, start_slot, 0, spec)
+        ))
+        withheld_subnet = str(int(
+            misc.compute_subnet_for_attestation(2, start_slot, 1, spec)
+        ))
+        assert rec["subnet_missing_votes"][withheld_subnet] == 2
+        assert rec["subnet_missing_votes"][voted_subnet] == 0
+        # participation by Altair flag off the head state (genesis: all
+        # flags unset)
+        assert set(rec["participation"]) == {"source", "target", "head"}
+        assert all(0.0 <= v <= 1.0 for v in rec["participation"].values())
+
+        # per-epoch dedup: a second tick in the same epoch returns the
+        # cached sample instead of re-walking the committee table
+        assert store.forensics.observe_epoch(store, spec) is rec
+        view = store.forensics.finality_view()
+        assert view["latest"] is rec
+        assert [r["kind"] for r in view["history"]] == ["epoch"]
+
+        # checkpoint advances land as kind-tagged resets in the ring
+        store.forensics.note_justified(1, anchor_root)
+        store.forensics.note_finalized(1, anchor_root)
+        kinds = [r["kind"] for r in store.forensics.finality_view()["history"]]
+        assert kinds == ["epoch", "justified", "finalized"]
+
+
+# --------------------------------------------------- equivocation ledger
+
+
+def test_evidence_ledger_mints_and_dedups():
+    plane = ConsensusForensics(capacity=16)
+    r1, r2 = b"\x0a" * 32, b"\x0b" * 32
+    # same (slot, proposer) + same root: no evidence; distinct root: one
+    assert plane.note_block(r1, 5, 7) is None
+    assert plane.note_block(r1, 5, 7) is None
+    ev = plane.note_block(r2, 5, 7)
+    assert ev["kind"] == "double_proposal"
+    assert ev["roots"] == ["0x" + r1.hex(), "0x" + r2.hex()]
+    # replayed equivocation: deduped, not re-minted
+    assert plane.note_block(r2, 5, 7) is None
+    assert plane.evidence_count("double_proposal") == 1
+
+    cell = (1, 9, 0, 3, b"\x33")
+    assert plane.note_vote(cell, r1) is None
+    assert plane.note_vote(cell, r1) is None
+    ev = plane.note_vote(cell, r2)
+    assert ev["kind"] == "double_vote"
+    assert ev["cell"] == [1, 9, 0, 3, "0x33"]
+    assert plane.note_vote(cell, r2) is None
+    assert plane.evidence_count("double_vote") == 1
+
+    plane.note_attester_slashing([3, 1])
+    plane.note_attester_slashing((1, 3))  # same set, any order: deduped
+    assert plane.evidence_count("attester_slashing") == 1
+    assert plane.evidence_count() == 3
+
+
+def test_forensics_off_knob_disables_every_organ(monkeypatch):
+    monkeypatch.setenv("FORENSICS_OFF", "1")
+    plane = ConsensusForensics()
+    assert plane.enabled is False
+    plane.note_attestation_batch(1, "cached", 2)
+    plane.note_block_arrival(b"\x01" * 32, 1, 0.5)
+    plane.note_head_audit(1, b"\x01" * 32, [], [])
+    assert plane.note_block(b"\x0a" * 32, 5, 7) is None
+    assert plane.note_block(b"\x0b" * 32, 5, 7) is None  # no ledger at all
+    assert plane.evidence_count() == 0
+    assert all(
+        r["appended_total"] == 0 for r in plane.stats()["rings"].values()
+    )
+    # runtime re-enable (the bench's both-polarity path) takes effect
+    plane.set_enabled(True)
+    plane.note_attestation_batch(1, "cached", 2)
+    assert plane.stats()["rings"]["weight_events"]["appended_total"] == 1
+
+
+# --------------------------------------------------- rings, knobs, export
+
+
+def test_ring_capacity_knob_and_drop_export(monkeypatch):
+    monkeypatch.setenv("FORENSICS_RING_CAPACITY", "4")
+    plane = ConsensusForensics()
+    for i in range(10):
+        plane.note_attestation_batch(i, "cached", 1)
+    stats = plane.stats()["rings"]["weight_events"]
+    assert stats == {
+        "capacity": 4, "entries": 4,
+        "appended_total": 10, "dropped_total": 6,
+    }
+    # counter-delta export: the cursor advances only when it records
+    dead = Metrics(enabled=False)
+    plane.export_ring_drops(dead)
+    m = Metrics(enabled=True)
+    plane.export_ring_drops(m)
+    assert m.get("forensics_ring_dropped_total", ring="weight_events") == 6
+    plane.export_ring_drops(m)  # no new drops: no double count
+    assert m.get("forensics_ring_dropped_total", ring="weight_events") == 6
+    for i in range(3):
+        plane.note_attestation_batch(i, "cached", 1)
+    plane.export_ring_drops(m)
+    assert m.get("forensics_ring_dropped_total", ring="weight_events") == 9
+
+
+def test_bad_capacity_env_falls_back_to_default(monkeypatch):
+    monkeypatch.setenv("FORENSICS_RING_CAPACITY", "lots")
+    assert (ConsensusForensics().stats()["rings"]["reorgs"]["capacity"]
+            == 512)
+
+
+# --------------------------------------------------- debug routes (HTTP)
+
+
+async def _http_get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = head.split(b"\r\n", 1)[0].decode()
+    return status, body
+
+
+def test_debug_routes_served_over_live_http(chain):
+    from lambda_ethereum_consensus_tpu.api.beacon_api import BeaconApiServer
+
+    genesis, anchor_block, spec = chain
+
+    async def main():
+        with use_chain_spec(spec):
+            store, anchor_root, root_a, root_b = _two_branch_store(
+                genesis, anchor_block, spec
+            )
+            head = get_head(store, spec)
+            _attest(store, min(root_a, root_b), 0, spec, anchor_root)
+            new_head = get_head(store, spec)
+            store.forensics.observe_transition(store, head, new_head)
+            store.forensics.observe_epoch(store, spec)
+            api = BeaconApiServer(store=store, spec=spec)
+            await api.start()
+            try:
+                status, body = await _http_get(api.port, "/debug/forkchoice")
+                assert status == "HTTP/1.1 200 OK"
+                data = json.loads(body)["data"]
+                roots = {n["root"] for n in data["nodes"]}
+                assert {"0x" + root_a.hex(), "0x" + root_b.hex()} <= roots
+                assert data["tree_head"] == "0x" + new_head.hex()
+                assert data["head_memo"]["head"] == "0x" + new_head.hex()
+                assert data["justified"] == "0x" + anchor_root.hex()
+                weights = {n["root"]: n["weight"] for n in data["nodes"]}
+                assert weights["0x" + new_head.hex()] > 0
+
+                status, body = await _http_get(api.port, "/debug/reorgs")
+                assert status == "HTTP/1.1 200 OK"
+                data = json.loads(body)["data"]
+                assert data["reorg_count"] == 1
+                (rec,) = data["reorgs"]
+                assert rec["new_head"] == "0x" + new_head.hex()
+                assert rec["common_ancestor"] == "0x" + anchor_root.hex()
+                # the two competing slot-1 blocks share a proposer: the
+                # on_block hook minted the double proposal on its own
+                (ev,) = data["evidence"]
+                assert ev["kind"] == "double_proposal"
+                assert set(ev["roots"]) == {
+                    "0x" + root_a.hex(), "0x" + root_b.hex(),
+                }
+                assert data["stats"]["rings"]["reorgs"]["entries"] == 1
+
+                status, body = await _http_get(api.port, "/debug/finality")
+                assert status == "HTTP/1.1 200 OK"
+                data = json.loads(body)["data"]
+                assert data["latest"]["finality_lag_epochs"] == 0
+                assert data["history"][-1]["kind"] == "epoch"
+            finally:
+                await api.stop()
+
+    run(main())
+
+
+def test_debug_routes_404_without_forensics_plane(chain):
+    from lambda_ethereum_consensus_tpu.api.beacon_api import BeaconApiServer
+
+    genesis, anchor_block, spec = chain
+    with use_chain_spec(spec):
+        store = get_forkchoice_store(genesis, anchor_block, spec)
+        api = BeaconApiServer(store=store, spec=spec)
+        for path in ("/debug/forkchoice", "/debug/reorgs", "/debug/finality"):
+            status, _, _ = api._route("GET", path)
+            assert status.startswith("404")
