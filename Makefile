@@ -13,12 +13,15 @@ native:
 	$(MAKE) -C native
 
 # Static analysis: graftlint (project-native rules — concurrency,
-# containment, retrace, metric contracts; see ARCHITECTURE.md "Static
-# analysis") + ruff (generic pyflakes-level issues, minimal rule set so
-# style noise never leaks into graftlint's scope).  ruff is optional in
-# the container; skip with a note rather than fail the target.
+# containment, retrace, env-knob, lifecycle, metric contracts; see
+# ARCHITECTURE.md "Static analysis") + ruff (generic pyflakes-level
+# issues, minimal rule set so style noise never leaks into graftlint's
+# scope).  ruff is optional in the container; skip with a note rather
+# than fail the target.  --timings prints per-rule wall seconds;
+# --budget-s 60 fails the target if the interprocedural pass ever
+# becomes the slowest step in `make test`.
 lint:
-	python -m tools.graftlint lambda_ethereum_consensus_tpu
+	python -m tools.graftlint lambda_ethereum_consensus_tpu --timings --budget-s 60
 	@if command -v ruff >/dev/null 2>&1; then \
 	  ruff check lambda_ethereum_consensus_tpu tools; \
 	else \

@@ -202,6 +202,7 @@ class BeaconNode:
         self.resume_report: dict = {}
         self.device_backend = None
         self._prev_hash_backend = None
+        self._warmer = None
         # subnet gossip validation state: committees-per-slot + shuffling
         # seed memo and the one-vote-per-validator-per-epoch IGNORE cache
         # (epoch -> cells)
@@ -1458,6 +1459,15 @@ class BeaconNode:
 
     async def stop(self) -> None:
         self._stopping = True
+        if self._warmer is not None:
+            # the drain-warmer is daemonized and bounded, but a stop()
+            # that returns while it still compiles programs races the
+            # hash-backend restore below and leaks the thread into the
+            # next test's process state — bound the wait off the loop
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._warmer.join, 10.0
+            )
+            self._warmer = None
         if self.device_backend is not None:
             # restore the process-global SSZ hash backend a start() on a
             # TPU host swapped in (multi-node-lifecycle processes, tests)

@@ -76,7 +76,11 @@ class PendingBlocks:
                     continue  # parked: data availability incomplete
                 try:
                     on_block(self.store, signed, spec=self.spec)
-                except SpecError as e:
+                except (SpecError, ValueError, TypeError) as e:
+                    # adversarial payloads can trip a Python-level error
+                    # (bad lengths, out-of-range indices) before the
+                    # transition names it a SpecError — either way the
+                    # block is invalid; only the scan must survive
                     log.warning("invalid block %s: %s", root.hex()[:16], e)
                     self._mark_invalid(root)
                     continue

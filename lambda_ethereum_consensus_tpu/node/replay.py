@@ -79,6 +79,11 @@ def prefetched(
             yield payload
     finally:
         stop.set()
+        # the stop flag frees the worker within one bounded-put timeout;
+        # join so generator close means the thread is actually gone — an
+        # unjoined prefetcher could still be calling prep() against
+        # state the consumer is tearing down
+        worker.join(timeout=2.0)
 
 
 def decode_signed_blocks(raws: Iterable[bytes], spec=None, depth: int = 2):

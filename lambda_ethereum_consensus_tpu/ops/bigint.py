@@ -28,6 +28,8 @@ against the host curve oracle.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..crypto.bls.fields import P
@@ -212,10 +214,15 @@ def make_ops():
 
 
 _OPS = None
+_OPS_LOCK = threading.Lock()
 
 
 def get_ops():
+    # double-checked: the warm-up thread, executor duty threads, and the
+    # event loop can all demand the kernels first
     global _OPS
     if _OPS is None:
-        _OPS = make_ops()
+        with _OPS_LOCK:
+            if _OPS is None:
+                _OPS = make_ops()
     return _OPS

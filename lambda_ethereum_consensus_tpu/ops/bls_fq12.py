@@ -23,6 +23,8 @@ from the host field module rather than transcribed.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..crypto.bls import fields as F
@@ -530,13 +532,17 @@ def make_fq12_ops(base=None, lay=None, eager: bool = False):
 
 
 _FQ12_OPS = None
+_FQ12_OPS_LOCK = threading.Lock()
 _FQ12_PLANE_OPS: dict = {}
 
 
 def get_fq12_ops():
+    # double-checked: warm-up thread vs. executor verify paths
     global _FQ12_OPS
     if _FQ12_OPS is None:
-        _FQ12_OPS = make_fq12_ops()
+        with _FQ12_OPS_LOCK:
+            if _FQ12_OPS is None:
+                _FQ12_OPS = make_fq12_ops()
     return _FQ12_OPS
 
 

@@ -16,6 +16,7 @@ environment alone.
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -33,28 +34,34 @@ __all__ = [
 ]
 
 _DEFAULT_MESH = None
+_DEFAULT_MESH_LOCK = threading.Lock()
 
 
 def default_mesh():
     """One process-wide ``("dp",)`` mesh over every local device — a fresh
     Mesh per call would defeat every id-keyed stage cache downstream
-    (each drain would re-jit)."""
+    (each drain would re-jit).  Double-checked: the warm-up thread and
+    the first drain race to build it."""
     global _DEFAULT_MESH
-    if _DEFAULT_MESH is None:
-        import jax
-        from jax.sharding import Mesh
+    if _DEFAULT_MESH is not None:
+        return _DEFAULT_MESH
+    with _DEFAULT_MESH_LOCK:
+        if _DEFAULT_MESH is None:
+            import jax
+            from jax.sharding import Mesh
 
-        _DEFAULT_MESH = Mesh(np.array(jax.devices()), axis_names=("dp",))
-        # one timeline instant on the flight recorder: the mesh coming up
-        # is the moment the sharded plane's program identities are fixed,
-        # so every later retrace/compile instant reads against it
-        from ..tracing import get_recorder
+            _DEFAULT_MESH = Mesh(np.array(jax.devices()), axis_names=("dp",))
+            # one timeline instant on the flight recorder: the mesh
+            # coming up is the moment the sharded plane's program
+            # identities are fixed, so every later retrace/compile
+            # instant reads against it
+            from ..tracing import get_recorder
 
-        get_recorder().record(
-            "inst", 0, "mesh_init",
-            {"devices": int(_DEFAULT_MESH.devices.size),
-             "backend": jax.default_backend()},
-        )
+            get_recorder().record(
+                "inst", 0, "mesh_init",
+                {"devices": int(_DEFAULT_MESH.devices.size),
+                 "backend": jax.default_backend()},
+            )
     return _DEFAULT_MESH
 
 

@@ -323,6 +323,10 @@ class ForkChoiceRunner:
                 )
                 valid = step.get("valid", True)
                 try:
+                    # graftlint: disable=exception-containment — conformance
+                    # contract: invalid vectors must be rejected with a
+                    # SpecError SPECIFICALLY; any other exception is an
+                    # implementation bug and must crash the runner
                     on_block(store, signed, spec=spec)
                     assert valid, "invalid block accepted"
                 except SpecError:
@@ -335,6 +339,8 @@ class ForkChoiceRunner:
                 )
                 valid = step.get("valid", True)
                 try:
+                    # graftlint: disable=exception-containment — see the
+                    # on_block step: non-SpecError means implementation bug
                     on_attestation(store, att, is_from_block=False, spec=spec)
                     assert valid, "invalid attestation accepted"
                 except SpecError:
@@ -346,6 +352,8 @@ class ForkChoiceRunner:
                     spec,
                 )
                 try:
+                    # graftlint: disable=exception-containment — see the
+                    # on_block step: non-SpecError means implementation bug
                     on_attester_slashing(store, slashing, spec)
                 except SpecError:
                     assert not step.get("valid", True)

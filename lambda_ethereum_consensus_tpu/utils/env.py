@@ -4,6 +4,7 @@
 from __future__ import annotations
 
 import os
+import threading
 
 __all__ = ["env_flag", "device_default"]
 
@@ -17,6 +18,7 @@ def env_flag(name: str) -> bool:
 
 
 _DEVICE_DEFAULT: bool | None = None
+_DEVICE_DEFAULT_LOCK = threading.Lock()
 
 
 def device_default() -> bool:
@@ -36,14 +38,20 @@ def device_default() -> bool:
     if env_flag("BLS_NO_DEVICE"):
         return False
     if _DEVICE_DEFAULT is None:
+        # double-checked: the warm-up thread, executor duty/API threads,
+        # and the event loop can all ask first — only one may pay (and
+        # observe a half-initialized) jax backend probe
         platforms = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-        # "axon" is the tunneled-TPU plugin: its backend REPORTS "tpu",
-        # so it must not short-circuit to the host path (that silently
-        # routed every node on tunneled hardware to Python crypto)
-        if platforms and "tpu" not in platforms and "axon" not in platforms:
-            _DEVICE_DEFAULT = False
-        else:
-            import jax
+        with _DEVICE_DEFAULT_LOCK:
+            if _DEVICE_DEFAULT is None:
+                # "axon" is the tunneled-TPU plugin: its backend REPORTS
+                # "tpu", so it must not short-circuit to the host path
+                # (that silently routed every node on tunneled hardware
+                # to Python crypto)
+                if platforms and "tpu" not in platforms and "axon" not in platforms:
+                    _DEVICE_DEFAULT = False
+                else:
+                    import jax
 
-            _DEVICE_DEFAULT = jax.default_backend() == "tpu"
+                    _DEVICE_DEFAULT = jax.default_backend() == "tpu"
     return _DEVICE_DEFAULT

@@ -13,6 +13,25 @@ sys.path.insert(0, REPO_ROOT)
 import bench  # noqa: E402
 
 
+def test_bench_knob_inventory_is_complete():
+    """The BENCH_NO_* inventory, pinned so graftlint's env-knob-contract
+    rule has an anchor: bench.py's stage gates plus the
+    "BENCH_NO_REPLAY" gate scripts/bench_mainnet.py reads around its
+    full-registry replay section."""
+    inventory = {
+        "BENCH_NO_MAINNET", "BENCH_NO_INGEST", "BENCH_NO_PLANES",
+        "BENCH_NO_PIPELINE", "BENCH_NO_TELEMETRY", "BENCH_NO_TRACE",
+        "BENCH_NO_FORENSICS", "BENCH_NO_SHARD", "BENCH_NO_STATE_SHARD",
+        "BENCH_NO_WITNESS", "BENCH_NO_KZG", "BENCH_NO_DUTIES",
+        "BENCH_NO_API", "BENCH_NO_REPLAY",
+    }
+    stage_knobs = {k for k, _ in bench._STAGE_METRICS if k}
+    assert stage_knobs <= inventory
+    extra = inventory - stage_knobs
+    # the only non-stage knob belongs to the mainnet-scale bench script
+    assert extra == {"BENCH_NO_REPLAY"}
+
+
 def test_required_metrics_honors_env_gates():
     everything = bench.required_metrics(env={})
     assert "ssz_merkle_node_hashes_per_sec" in everything

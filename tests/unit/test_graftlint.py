@@ -1120,6 +1120,558 @@ def test_metric_contract_round18_families_fire(tmp_path):
     assert len(findings) == 4
 
 
+# ------------------------------------------------- thread-shared-state
+
+
+def test_thread_shared_state_fires_across_contexts(tmp_path):
+    """The PR 10 defect class: an executor-offloaded method rewrites a
+    ``self`` attribute the event-loop side reads, no lock anywhere."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "node.py": """
+            class Node:
+                def __init__(self):
+                    self.preset = None
+
+                def _retune(self):
+                    self.preset = dict(gain=2)
+
+                async def tick(self, loop):
+                    await loop.run_in_executor(None, self._retune)
+
+                async def status(self):
+                    return self.preset
+            """
+        },
+        rules=["thread-shared-state"],
+    )
+    assert len(findings) == 1
+    assert "self.preset written on the executor thread" in findings[0].message
+    assert "loop" in findings[0].message
+
+
+def test_thread_shared_state_lock_protected_exempt(tmp_path):
+    """Every cross-context write under ``with self._lock`` is the
+    accepted story — lock-free reads stay allowed."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "node.py": """
+            import threading
+
+            class Node:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.preset = None
+
+                def _retune(self):
+                    with self._lock:
+                        self.preset = dict(gain=2)
+
+                async def tick(self, loop):
+                    await loop.run_in_executor(None, self._retune)
+
+                async def status(self):
+                    return self.preset
+            """
+        },
+        rules=["thread-shared-state"],
+    )
+    assert findings == []
+
+
+def test_thread_shared_state_safe_containers_and_contextvar_exempt(tmp_path):
+    """Queue handoffs and ContextVar pins (the PR 10 fix idiom) are
+    internally synchronized — method calls on them are not writes."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "node.py": """
+            import contextvars
+            import queue
+
+            class Node:
+                def __init__(self):
+                    self.inbox = queue.Queue()
+                    self._pin = contextvars.ContextVar("pin")
+
+                def _drain(self):
+                    self._pin.set("worker")
+                    self.inbox.put(self._pin.get())
+
+                async def tick(self, loop):
+                    await loop.run_in_executor(None, self._drain)
+
+                async def status(self):
+                    return self.inbox.get()
+            """
+        },
+        rules=["thread-shared-state"],
+    )
+    assert findings == []
+
+
+def test_thread_shared_state_constant_stop_flag_exempt(tmp_path):
+    """``self._stop = True`` shutdown signals are benign torn reads."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "node.py": """
+            class Node:
+                def __init__(self):
+                    self._stop = False
+
+                def _run(self):
+                    while not self._stop:
+                        pass
+
+                def start(self):
+                    import threading
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                async def stop(self):
+                    self._stop = True
+                    self._t.join()
+            """
+        },
+        rules=["thread-shared-state"],
+    )
+    assert findings == []
+
+
+def test_thread_shared_state_module_global_memo(tmp_path):
+    """A module global rebound off-lock from one context and read from
+    another fires; the double-checked-locking memo pattern passes."""
+    racy = {
+        "memo.py": """
+        _PRESET = None
+
+        def _rebuild():
+            global _PRESET
+            _PRESET = dict(gain=2)
+
+        async def tick(loop):
+            await loop.run_in_executor(None, _rebuild)
+
+        async def status():
+            return _PRESET
+        """
+    }
+    findings = lint_sources(tmp_path, racy, rules=["thread-shared-state"])
+    assert len(findings) == 1
+    assert "module global _PRESET rebound" in findings[0].message
+    assert "double-checked-locking" in findings[0].message
+
+    locked = {
+        "memo2.py": """
+        import threading
+
+        _PRESET = None
+        _PRESET_LOCK = threading.Lock()
+
+        def _rebuild():
+            global _PRESET
+            with _PRESET_LOCK:
+                if _PRESET is None:
+                    _PRESET = dict(gain=2)
+            return _PRESET
+
+        async def tick(loop):
+            await loop.run_in_executor(None, _rebuild)
+
+        async def status():
+            return _PRESET
+        """
+    }
+    assert lint_sources(tmp_path / "locked", locked, rules=["thread-shared-state"]) == []
+
+
+def test_thread_shared_state_suppression_needs_rationale(tmp_path):
+    """A bare disable of this rule is itself a finding; trailing prose
+    after the rule list satisfies it."""
+    bare = {
+        "mod.py": """
+        class Node:
+            def __init__(self):
+                self.preset = None
+
+            def _retune(self):
+                self.preset = dict(gain=2)  # graftlint: disable=thread-shared-state
+
+            async def tick(self, loop):
+                await loop.run_in_executor(None, self._retune)
+
+            async def status(self):
+                return self.preset
+        """
+    }
+    findings = lint_sources(tmp_path, bare, rules=["thread-shared-state"])
+    assert len(findings) == 1
+    assert "without a written rationale" in findings[0].message
+
+    justified = {
+        "mod2.py": """
+        class Node:
+            def __init__(self):
+                self.preset = None
+
+            def _retune(self):
+                self.preset = dict(gain=2)  # graftlint: disable=thread-shared-state — single-writer by protocol
+            async def tick(self, loop):
+                await loop.run_in_executor(None, self._retune)
+
+            async def status(self):
+                return self.preset
+        """
+    }
+    assert (
+        lint_sources(tmp_path / "justified", justified, rules=["thread-shared-state"])
+        == []
+    )
+
+
+# ------------------------------------------------- env-knob-contract
+
+
+def test_env_knob_contract_undocumented_read_fires(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+
+            def tune():
+                return os.environ.get("GHOST_KNOB", "")
+            """
+        },
+        rules=["env-knob-contract"],
+        extra_files={"README.md": "# repo\n\nNo knobs documented here.\n"},
+    )
+    assert len(findings) == 1
+    assert "GHOST_KNOB is read here but appears nowhere" in findings[0].message
+
+
+def test_env_knob_contract_dead_doc_fires(tmp_path):
+    """A README table row for a knob nothing reads is stale advice; a
+    dynamically-composed family prefix (f-string) keeps its rows live."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+
+            def tune(name):
+                flag = f"SOAK_NO_{name.upper()}"
+                return os.environ.get(flag, "")
+            """
+        },
+        rules=["env-knob-contract"],
+        extra_files={
+            "README.md": (
+                "# repo\n\n"
+                "| Knob | Meaning |\n|---|---|\n"
+                "| `STALE_KNOB` | removed three rounds ago |\n"
+                "| `SOAK_NO_STEADY` | composed dynamically |\n"
+            )
+        },
+    )
+    assert len(findings) == 1
+    assert findings[0].path == "README.md"
+    assert "STALE_KNOB but nothing in the repo reads it" in findings[0].message
+
+
+def test_env_knob_contract_polarity_pair_fires(tmp_path):
+    """KZG_DEVICE/KZG_NO_DEVICE read through two ad-hoc parsers in two
+    different functions: both the bypass and the never-resolved ladder
+    fire."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import os
+
+            def env_flag(name):
+                return os.environ.get(name, "") not in ("", "0")
+
+            def force_on():
+                return os.environ.get("KZG_DEVICE", "")
+
+            def opt_out():
+                return env_flag("KZG_NO_DEVICE")
+            """
+        },
+        rules=["env-knob-contract"],
+        extra_files={
+            "README.md": "Use `KZG_DEVICE` to force, `KZG_NO_DEVICE` to opt out.\n"
+        },
+    )
+    messages = "\n".join(f.message for f in findings)
+    assert "bypasses the shared env_flag helper" in messages
+    assert "never resolved in one function" in messages
+
+
+def test_env_knob_contract_polarity_pair_passes_via_helper(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            def env_flag(name):
+                import os
+                return os.environ.get(name, "") not in ("", "0")
+
+            def device_enabled():
+                if env_flag("KZG_NO_DEVICE"):
+                    return False
+                return env_flag("KZG_DEVICE")
+            """
+        },
+        rules=["env-knob-contract"],
+        extra_files={
+            "README.md": "Use `KZG_DEVICE` to force, `KZG_NO_DEVICE` to opt out.\n"
+        },
+    )
+    assert findings == []
+
+
+def test_env_knob_contract_inventory_fires_and_passes(tmp_path):
+    """A BENCH_NO_* knob read anywhere must appear literally in the
+    bench validator's inventory test."""
+    src = {
+        "mod.py": """
+        def env_flag(name):
+            import os
+            return os.environ.get(name, "") not in ("", "0")
+
+        def maybe_skip():
+            return env_flag("BENCH_NO_FASTPATH")
+        """
+    }
+    findings = lint_sources(
+        tmp_path,
+        src,
+        rules=["env-knob-contract"],
+        extra_files={
+            "README.md": "# repo\n",
+            "tests/unit/test_bench_validate.py": "KNOWN = set()\n",
+        },
+    )
+    assert len(findings) == 1
+    assert "missing from the tests/unit/test_bench_validate.py" in findings[0].message
+
+    findings = lint_sources(
+        tmp_path,
+        {"mod2.py": src["mod.py"]},
+        rules=["env-knob-contract"],
+        extra_files={
+            "README.md": "# repo\n",
+            "tests/unit/test_bench_validate.py": 'KNOWN = {"BENCH_NO_FASTPATH"}\n',
+        },
+    )
+    assert findings == []
+
+
+# ------------------------------------------------- lifecycle-teardown
+
+
+def test_lifecycle_teardown_fires_on_leaked_thread(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "svc.py": """
+            import threading
+
+            class Service:
+                def start(self):
+                    self._worker = threading.Thread(target=self._run, daemon=True)
+                    self._worker.start()
+
+                def _run(self):
+                    pass
+            """
+        },
+        rules=["lifecycle-teardown"],
+    )
+    assert len(findings) == 1
+    assert "self._worker holds a thread" in findings[0].message
+    assert "ever tears it down" in findings[0].message
+
+
+def test_lifecycle_teardown_passes_with_join(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "svc.py": """
+            import threading
+
+            class Service:
+                def start(self):
+                    self._worker = threading.Thread(target=self._run, daemon=True)
+                    self._worker.start()
+
+                def _run(self):
+                    pass
+
+                def stop(self):
+                    self._worker.join(timeout=5)
+            """
+        },
+        rules=["lifecycle-teardown"],
+    )
+    assert findings == []
+
+
+def test_lifecycle_teardown_resolves_factory_hop(tmp_path):
+    """``self._warmer = start_warmer()`` where the factory lives in
+    ANOTHER module and returns a started thread: the interprocedural hop
+    keeps the resource attributable."""
+    sources = {
+        "warm.py": """
+        import threading
+
+        def start_warmer(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+        """,
+        "node.py": """
+        from warm import start_warmer
+
+        class Node:
+            def start(self):
+                self._warmer = start_warmer(self._warm)
+
+            def _warm(self):
+                pass
+        """,
+    }
+    findings = lint_sources(tmp_path, sources, rules=["lifecycle-teardown"])
+    assert len(findings) == 1
+    assert "self._warmer holds a thread" in findings[0].message
+
+    sources["node.py"] += (
+        "\n"
+        "            async def stop(self):\n"
+        "                self._warmer.join(timeout=10)\n"
+        "                self._warmer = None\n"
+    )
+    assert lint_sources(tmp_path, sources, rules=["lifecycle-teardown"]) == []
+
+
+def test_lifecycle_teardown_fires_on_dropped_local(tmp_path):
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import threading
+
+            def fire(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+            """
+        },
+        rules=["lifecycle-teardown"],
+    )
+    assert len(findings) == 1
+    assert "local thread `t`" in findings[0].message
+    assert "handle is dropped" in findings[0].message
+
+
+def test_lifecycle_teardown_local_exemptions(tmp_path):
+    """Returned, with-managed, joined, stored, and passed-on locals all
+    transfer or close ownership."""
+    findings = lint_sources(
+        tmp_path,
+        {
+            "mod.py": """
+            import socket
+            import threading
+
+            def factory(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+
+            def probe(addr):
+                with socket.socket() as s:
+                    s.connect(addr)
+
+            def run_sync(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+
+            def register(reg, fn):
+                t = threading.Thread(target=fn)
+                reg.add(t)
+            """
+        },
+        rules=["lifecycle-teardown"],
+    )
+    assert findings == []
+
+
+# ------------------------------------------------- interprocedural engine
+
+
+def test_call_graph_resolves_reexport_hop(tmp_path):
+    """``from pkg import apply_block`` where pkg/__init__ re-exports it
+    from pkg/impl: the call edge lands on the DEFINING module's key."""
+    for rel, src in {
+        "pkg/__init__.py": "from .impl import apply_block\n",
+        "pkg/impl.py": "def apply_block(b):\n    return b\n",
+        "main.py": (
+            "from pkg import apply_block\n\n"
+            "def drive(b):\n    return apply_block(b)\n"
+        ),
+    }.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    from tools.graftlint.rules.common import get_call_graph
+
+    project = Project.load(tmp_path, [tmp_path])
+    graph = get_call_graph(project)
+    assert graph.callees("main.py:drive") == ["pkg/impl.py:apply_block"]
+    assert "main.py:drive" in graph.callers["pkg/impl.py:apply_block"]
+
+
+def test_thread_contexts_classify_entry_points(tmp_path):
+    """Async defs run on the loop; Thread targets and run_in_executor
+    args get their own classes; contexts propagate caller -> sync callee."""
+    (tmp_path / "mod.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            def _shared_leaf():
+                pass
+
+            def _worker():
+                _shared_leaf()
+
+            def _offloaded():
+                _shared_leaf()
+
+            async def handle(loop):
+                threading.Thread(target=_worker).start()
+                await loop.run_in_executor(None, _offloaded)
+                _shared_leaf()
+            """
+        )
+    )
+    from tools.graftlint.rules.common import get_thread_contexts
+
+    project = Project.load(tmp_path, [tmp_path])
+    contexts = get_thread_contexts(project)
+    assert contexts.of("mod.py:handle") == {"loop"}
+    assert contexts.of("mod.py:_worker") == {"thread"}
+    assert contexts.of("mod.py:_offloaded") == {"executor"}
+    # the leaf is reachable from all three classes
+    assert contexts.of("mod.py:_shared_leaf") == {"loop", "thread", "executor"}
+
+
 # ------------------------------------------------- suppression and baseline
 
 
@@ -1208,6 +1760,42 @@ def test_cli_json_and_exit_codes(tmp_path, capsys):
     )
     capsys.readouterr()
     assert rc == 0
+
+
+def test_cli_sarif_format(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text(
+        "import time\n\nasync def f():\n    time.sleep(1)\n"
+    )
+    rc = cli_main(
+        [str(tmp_path / "mod.py"), "--root", str(tmp_path),
+         "--format", "sarif", "--baseline", str(tmp_path / "bl.json")]
+    )
+    sarif = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "async-blocking" in rule_ids and "thread-shared-state" in rule_ids
+    (result,) = run["results"]
+    assert result["ruleId"] == "async-blocking"
+    assert result["locations"][0]["physicalLocation"]["region"]["startLine"] == 4
+    assert result["partialFingerprints"]["graftlintId"]
+
+
+def test_cli_timings_and_budget(tmp_path, capsys):
+    (tmp_path / "mod.py").write_text("def ok():\n    return 1\n")
+    base = [str(tmp_path / "mod.py"), "--root", str(tmp_path),
+            "--baseline", str(tmp_path / "bl.json")]
+    rc = cli_main(base + ["--timings", "--budget-s", "300"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "parse+index" in captured.err and "TOTAL" in captured.err
+    # an impossible budget turns a clean run into exit 1
+    rc = cli_main(base + ["--budget-s", "0"])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "exceeded" in captured.err
 
 
 # ----------------------------------------------------------- durable-rename
@@ -1468,24 +2056,27 @@ def test_shard_rules_silent_without_a_table(tmp_path):
     assert findings == []
 
 
-def test_list_rules_names_six_active_rules(capsys):
+def test_list_rules_names_ten_active_rules(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for name in (
         "async-blocking",
         "await-under-lock",
         "durable-rename",
+        "env-knob-contract",
         "exception-containment",
+        "lifecycle-teardown",
         "retrace-hazard",
         "metric-contract",
         "shard-rules",
+        "thread-shared-state",
     ):
         assert name in out
 
 
 def test_repo_lints_clean():
     """The whole package (and the Grafana dashboards) must stay clean
-    under all seven rules with the checked-in (empty) baseline — real
+    under all ten rules with the checked-in (empty) baseline — real
     defects get fixed, intended patterns get inline suppressions."""
     rc = cli_main(
         [

@@ -1,0 +1,53 @@
+"""Regression: PendingBlocks.process_once must survive adversarial
+blocks whose state transition trips a Python-level error (ValueError/
+TypeError) before a SpecError names it — the block is marked invalid
+and the scan continues instead of the tick loop dying (found by
+graftlint's exception-containment rule once the fork_choice re-export
+hop resolved)."""
+
+import asyncio
+
+import pytest
+
+from lambda_ethereum_consensus_tpu.node import pending_blocks as pb_mod
+
+
+class _Msg:
+    def __init__(self, root, parent, slot):
+        self._root = root
+        self.parent_root = parent
+        self.slot = slot
+
+    def hash_tree_root(self, spec):
+        return self._root
+
+
+class _Signed:
+    def __init__(self, root, parent, slot=1):
+        self.message = _Msg(root, parent, slot)
+
+
+class _Store:
+    def __init__(self, known):
+        self.blocks = dict(known)
+
+
+@pytest.mark.parametrize("exc", [ValueError, TypeError, pb_mod.SpecError])
+def test_process_once_contains_transition_errors(monkeypatch, exc):
+    parent = b"\x01" * 32
+    bad_root = b"\x02" * 32
+    child_root = b"\x03" * 32
+
+    def exploding_on_block(store, signed, spec=None):
+        raise exc("malformed payload")
+
+    monkeypatch.setattr(pb_mod, "on_block", exploding_on_block)
+    pb = pb_mod.PendingBlocks(_Store({parent: object()}), spec=None)
+    pb.add_block(_Signed(bad_root, parent, slot=1))
+    pb.add_block(_Signed(child_root, bad_root, slot=2))
+
+    applied = asyncio.run(pb.process_once())
+    assert applied == 0
+    # invalid, and its queued descendant transitively invalidated
+    assert bad_root in pb.invalid and child_root in pb.invalid
+    assert not pb.pending

@@ -1,9 +1,14 @@
 """graftlint CLI: ``python -m tools.graftlint [paths...]``.
 
-Exit codes: 0 = clean (after suppressions + baseline), 1 = findings,
-2 = usage/internal error.  ``--json`` prints a machine-readable report
-for CI; ``--write-baseline`` accepts the current findings into the
-baseline file so later runs only surface NEW findings.
+Exit codes: 0 = clean (after suppressions + baseline), 1 = findings (or
+a blown ``--budget-s`` wall-time budget), 2 = usage/internal error.
+``--format json`` prints a machine-readable report for CI, ``--format
+sarif`` emits SARIF 2.1.0 so findings render as code annotations;
+``--write-baseline`` accepts the current findings into the baseline
+file so later runs only surface NEW findings.  ``--timings`` prints
+per-rule wall seconds (the interprocedural engine's shared analyses —
+function index, call graph, thread contexts — are attributed to the
+first rule that demands them).
 """
 
 from __future__ import annotations
@@ -11,19 +16,33 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from pathlib import Path
 
-from .core import Project, apply_baseline, load_baseline, run_rules, write_baseline
+from .core import (
+    Finding,
+    Project,
+    apply_baseline,
+    load_baseline,
+    run_rules,
+    write_baseline,
+)
 from .rules import ALL_RULES, make_rules
 
 DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
         description="project-native static analysis (concurrency, containment, "
-        "retrace, and metric contracts)",
+        "retrace, env-knob, lifecycle, and metric contracts)",
     )
     p.add_argument(
         "paths",
@@ -31,12 +50,33 @@ def build_parser() -> argparse.ArgumentParser:
         default=["lambda_ethereum_consensus_tpu"],
         help="files/directories to lint (default: the package)",
     )
-    p.add_argument("--json", action="store_true", help="machine-readable output")
+    p.add_argument(
+        "--format",
+        choices=["human", "json", "sarif"],
+        default=None,
+        help="output format (default: human)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable output (alias for --format json)",
+    )
     p.add_argument(
         "--rules",
         help="comma-separated rule subset (default: all)",
     )
     p.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    p.add_argument(
+        "--timings",
+        action="store_true",
+        help="print per-rule wall seconds to stderr",
+    )
+    p.add_argument(
+        "--budget-s",
+        type=float,
+        default=None,
+        help="fail (exit 1) when total lint wall time exceeds this many seconds",
+    )
     p.add_argument(
         "--root",
         default=".",
@@ -58,8 +98,53 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def render_sarif(rules: list, findings: list[Finding]) -> dict:
+    """Minimal-but-valid SARIF 2.1.0: one run, one driver, one result per
+    finding, content-addressed ids carried as partial fingerprints so CI
+    diffing matches the baseline discipline."""
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": "tools/graftlint",
+                        "rules": [
+                            {
+                                "id": r.name,
+                                "shortDescription": {"text": r.description},
+                            }
+                            for r in rules
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {"startLine": max(f.line, 1)},
+                                }
+                            }
+                        ],
+                        "partialFingerprints": {"graftlintId": f.finding_id},
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    fmt = args.format or ("json" if args.json else "human")
     if args.list_rules:
         for cls in ALL_RULES:
             rule = cls()
@@ -79,8 +164,18 @@ def main(argv: list[str] | None = None) -> int:
             f"no such path: {', '.join(str(p) for p in missing)}", file=sys.stderr
         )
         return 2
+    t0 = time.perf_counter()
     project = Project.load(root, paths)
-    findings = run_rules(project, rules)
+    parse_s = time.perf_counter() - t0
+    timings: dict[str, float] = {}
+    findings = run_rules(project, rules, timings=timings)
+    total_s = time.perf_counter() - t0
+
+    if args.timings:
+        print(f"  {'parse+index':28} {parse_s:7.2f}s", file=sys.stderr)
+        for name, dt in sorted(timings.items(), key=lambda kv: -kv[1]):
+            print(f"  {name:28} {dt:7.2f}s", file=sys.stderr)
+        print(f"  {'TOTAL':28} {total_s:7.2f}s", file=sys.stderr)
 
     baseline_path = Path(args.baseline)
     if args.write_baseline:
@@ -90,7 +185,7 @@ def main(argv: list[str] | None = None) -> int:
     accepted = set() if args.no_baseline else load_baseline(baseline_path)
     fresh = apply_baseline(findings, accepted)
 
-    if args.json:
+    if fmt == "json":
         print(
             json.dumps(
                 {
@@ -98,10 +193,14 @@ def main(argv: list[str] | None = None) -> int:
                     "modules": len(project.modules),
                     "findings": [f.as_dict() for f in fresh],
                     "baselined": len(findings) - len(fresh),
+                    "timings_s": {k: round(v, 3) for k, v in timings.items()},
+                    "total_s": round(total_s, 3),
                 },
                 indent=1,
             )
         )
+    elif fmt == "sarif":
+        print(json.dumps(render_sarif(rules, fresh), indent=1))
     else:
         for f in fresh:
             print(f.render())
@@ -109,6 +208,14 @@ def main(argv: list[str] | None = None) -> int:
         suffix = f" ({baselined} baselined)" if baselined else ""
         print(
             f"graftlint: {len(fresh)} finding(s) in {len(project.modules)} "
-            f"module(s), {len(rules)} rule(s){suffix}"
+            f"module(s), {len(rules)} rule(s){suffix} [{total_s:.1f}s]"
         )
+    if args.budget_s is not None and total_s > args.budget_s:
+        print(
+            f"graftlint: wall time {total_s:.1f}s exceeded the "
+            f"--budget-s {args.budget_s:.0f}s budget — the interprocedural "
+            "pass may not silently become the slowest step in make test",
+            file=sys.stderr,
+        )
+        return 1
     return 1 if fresh else 0

@@ -19,6 +19,7 @@ import ast
 import hashlib
 import io
 import json
+import time
 import tokenize
 from dataclasses import dataclass
 from pathlib import Path
@@ -34,6 +35,9 @@ class Finding:
     message: str
     symbol: str = ""  # enclosing function/class, for stable ids + context
     finding_id: str = ""
+    # meta-findings ABOUT a suppression comment (e.g. a missing rationale)
+    # must not be silenced by the very comment they police
+    unsuppressable: bool = False
 
     def as_dict(self) -> dict:
         return {
@@ -63,6 +67,9 @@ class Module:
         # A standalone suppression comment covers the next code line, an
         # inline one covers its own line.
         self.suppressions: dict[int, set[str]] = {}
+        # raw (lineno, comment-text) pairs for rules that audit the
+        # suppressions themselves (e.g. rationale requirements)
+        self.suppression_comments: list[tuple[int, str]] = []
         self._collect_suppressions()
         # line -> enclosing def/class qualname (innermost), for finding ids
         self._symbols: dict[int, str] = {}
@@ -82,6 +89,7 @@ class Module:
                 rules = _parse_suppression(tok.string)
                 if not rules:
                     continue
+                self.suppression_comments.append((tok.start[0], tok.string))
                 line_text = self.lines[tok.start[0] - 1]
                 if line_text.strip().startswith("#"):
                     # standalone comment: applies to the next code line
@@ -199,15 +207,25 @@ def assign_ids(project: Project, findings: list[Finding]) -> None:
         f.finding_id = hashlib.sha256(raw.encode()).hexdigest()[:12]
 
 
-def run_rules(project: Project, rules: list) -> list[Finding]:
+def run_rules(
+    project: Project, rules: list, timings: dict[str, float] | None = None
+) -> list[Finding]:
+    """Run rules over the project.  When ``timings`` is given it is
+    filled with per-rule wall seconds (shared-analysis construction is
+    attributed to the first rule that demands it — honest accounting
+    for where a lint run actually spends its time)."""
     findings: list[Finding] = []
     for rule in rules:
-        for f in rule.check(project):
+        t0 = time.perf_counter()
+        rule_findings = rule.check(project)
+        if timings is not None:
+            timings[rule.name] = time.perf_counter() - t0
+        for f in rule_findings:
             mod = project.by_rel.get(f.path)
             if mod is not None:
                 if not f.symbol:
                     f.symbol = mod.symbol_at(f.line)
-                if mod.suppressed(rule.name, f.line):
+                if mod.suppressed(rule.name, f.line) and not f.unsuppressable:
                     continue
             findings.append(f)
     assign_ids(project, findings)

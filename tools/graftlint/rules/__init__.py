@@ -11,19 +11,25 @@ from __future__ import annotations
 from .async_blocking import AsyncBlockingRule
 from .await_under_lock import AwaitUnderLockRule
 from .durable_rename import DurableRenameRule
+from .env_knob_contract import EnvKnobContractRule
 from .exception_containment import ExceptionContainmentRule
+from .lifecycle_teardown import LifecycleTeardownRule
 from .metric_contract import MetricContractRule
 from .retrace_hazard import RetraceHazardRule
 from .shard_rules import ShardRulesRule
+from .thread_shared_state import ThreadSharedStateRule
 
 ALL_RULES = [
     AsyncBlockingRule,
     AwaitUnderLockRule,
     DurableRenameRule,
+    EnvKnobContractRule,
     ExceptionContainmentRule,
+    LifecycleTeardownRule,
     RetraceHazardRule,
     MetricContractRule,
     ShardRulesRule,
+    ThreadSharedStateRule,
 ]
 
 

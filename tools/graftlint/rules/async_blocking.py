@@ -27,7 +27,14 @@ from __future__ import annotations
 import ast
 
 from ..core import Finding, Module, Project
-from .common import FuncInfo, call_name, dotted, module_functions, walk_excluding_nested
+from .common import (
+    EXECUTOR_WRAPPER_NAMES,
+    FuncInfo,
+    call_name,
+    dotted,
+    module_functions,
+    walk_excluding_nested,
+)
 
 # terminal call name -> (required dotted prefixes or None, reason)
 _BLOCKING = {
@@ -49,8 +56,6 @@ _BLOCKING = {
     "process_slots": (None, "slot processing is span-instrumented as CPU-heavy"),
 }
 _OPEN_REASON = "sync file I/O on the event loop"
-_EXECUTOR_NAMES = {"run_in_executor", "to_thread"}
-
 
 class AsyncBlockingRule:
     name = "async-blocking"
@@ -145,7 +150,7 @@ class AsyncBlockingRule:
         for node in nodes:
             if isinstance(node, ast.Call):
                 cname = call_name(node)
-                if cname in _EXECUTOR_NAMES:
+                if cname in EXECUTOR_WRAPPER_NAMES:
                     for arg in list(node.args) + [kw.value for kw in node.keywords]:
                         for sub in ast.walk(arg):
                             exempt.add(id(sub))

@@ -1,11 +1,32 @@
-"""Shared AST analyses for graftlint rules.
+"""Shared AST analyses for graftlint rules — the v2 interprocedural
+engine lives here.
 
 Everything here is name-based static analysis: no imports of the checked
 code, no type inference.  Resolution is deliberately conservative —
 same-module functions, same-class methods, project-relative ``from``
-imports, and (for attribute calls) a project-wide method table capped at
-a small ambiguity limit — because a project linter that guesses wrong is
-worse than one that stays silent.
+imports (with one re-export hop through a package ``__init__``), and
+(for attribute calls) a project-wide method table capped at a small
+ambiguity limit — because a project linter that guesses wrong is worse
+than one that stays silent.
+
+The round-25 engine layers three cached project-wide analyses on top of
+the per-module helpers (each built once per lint run, shared by every
+rule through ``project.caches``):
+
+- :func:`get_function_index` — every function/method in the project,
+  addressable by module, by (module, class) and by bare name, with the
+  re-export table for one ``from ..pkg import name`` hop;
+- :func:`get_call_graph` — module-crossing caller->callee edges with
+  the same attribute/alias resolution the exception-containment rule
+  pioneered (unique targets stay strings, ambiguous attr-calls become
+  candidate tuples so consumers can demand must-hold-for-all);
+- :func:`get_thread_contexts` — entry-point classification: which
+  functions run on the asyncio event loop (async handlers, the node
+  tick loop, scrape/drain loops) vs. on worker threads
+  (``run_in_executor``/``asyncio.to_thread``/``Executor.submit``
+  targets vs. ``threading.Thread`` targets), propagated transitively
+  through the call graph so a sync helper three frames below an
+  executor target still knows which thread class runs it.
 """
 
 from __future__ import annotations
@@ -105,8 +126,11 @@ def import_map(module: Module, project: Project) -> dict[str, str]:
                 out[alias.asname or alias.name.split(".")[0]] = alias.name
         elif isinstance(node, ast.ImportFrom):
             if node.level:
-                # relative: strip the module's own name + (level-1) parents
-                prefix = base[: len(base) - node.level]
+                # relative: strip the module's own name + (level-1)
+                # parents — except in an __init__.py, whose dotted name
+                # IS the package a level-1 import resolves against
+                level = node.level - (1 if module.rel.endswith("__init__.py") else 0)
+                prefix = base[: len(base) - level] if level else base
                 mod = ".".join(prefix + ([node.module] if node.module else []))
             else:
                 mod = node.module or ""
@@ -202,3 +226,333 @@ def covered_by(raised: str, caught: list[str] | None, table: dict[str, list[str]
         return True
     ancestors = exception_ancestors(raised, table)
     return any(c in ancestors for c in caught)
+
+
+# ----------------------------------------------------- interprocedural engine
+#
+# Generalized from the resolution machinery that grew up private to
+# exception_containment.py (function index + callee resolution) and
+# async_blocking.py (executor-target extraction): one cached instance
+# per lint run, shared by every rule.
+
+AMBIGUITY_CAP = 3  # attr-call resolution: skip names defined more often
+
+
+def module_dotted(module: Module) -> str:
+    """``pkg.sub.mod`` dotted path for a module (path-derived, no
+    project needed — matches :meth:`Project.dotted_name`)."""
+    rel = module.rel
+    if rel.endswith("/__init__.py"):
+        rel = rel[: -len("/__init__.py")]
+    elif rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+def func_key(fi: FuncInfo) -> str:
+    """Stable project-wide function id: ``path/mod.py:Class.method``."""
+    return f"{fi.module.rel}:{fi.qualname}"
+
+
+class FunctionIndex:
+    """Project-wide function lookup: by (module, name), (module, class,
+    name), and bare method name (with definition counts for the
+    ambiguity cap).  ``reexports`` holds each module's import map so a
+    ``from ..fork_choice import on_block`` resolves through the package
+    ``__init__`` to the defining module (one hop)."""
+
+    def __init__(self, project: Project):
+        self.by_module: dict[tuple[str, str], FuncInfo] = {}
+        self.by_class: dict[tuple[str, str, str], FuncInfo] = {}
+        self.by_bare: dict[str, list[FuncInfo]] = {}
+        self.by_key: dict[str, FuncInfo] = {}
+        self.reexports: dict[str, dict[str, str]] = {}
+        for module in project.modules:
+            dotted_mod = project.dotted_name(module)
+            self.reexports[dotted_mod] = import_map(module, project)
+            for fi in module_functions(module):
+                if fi.class_name is None:
+                    self.by_module[(dotted_mod, fi.name)] = fi
+                else:
+                    self.by_class[(dotted_mod, fi.class_name, fi.name)] = fi
+                self.by_bare.setdefault(fi.name, []).append(fi)
+                self.by_key[func_key(fi)] = fi
+
+    def module_function(self, mod: str, func: str) -> FuncInfo | None:
+        hit = self.by_module.get((mod, func))
+        if hit is not None:
+            return hit
+        # one re-export hop through the target module's own imports
+        target = self.reexports.get(mod, {}).get(func)
+        if target is not None:
+            mod2, _, func2 = target.rpartition(".")
+            return self.by_module.get((mod2, func2))
+        return None
+
+
+def get_function_index(project: Project) -> FunctionIndex:
+    if "function_index" not in project.caches:
+        project.caches["function_index"] = FunctionIndex(project)
+    return project.caches["function_index"]
+
+
+def resolve_callee(
+    call: ast.Call,
+    fi: FuncInfo,
+    module: Module,
+    imports: dict[str, str],
+    index: FunctionIndex,
+):
+    """Resolve a call to a function key, a tuple of candidate keys
+    (ambiguous ``obj.method()`` under the cap — a fact must hold for ALL
+    candidates to be attributable), or ``None``."""
+    cname = call_name(call)
+    if cname is None:
+        return None
+    dotted_mod = module_dotted(module)
+    if isinstance(call.func, ast.Name):
+        hit = index.by_module.get((dotted_mod, cname))
+        if hit is not None:
+            return func_key(hit)
+        target = imports.get(cname)
+        if target is not None:
+            mod, _, func = target.rpartition(".")
+            hit = index.module_function(mod, func)
+            if hit is not None:
+                return func_key(hit)
+        return None
+    if is_self_call(call) and fi.class_name is not None:
+        hit = index.by_class.get((dotted_mod, fi.class_name, cname))
+        if hit is not None:
+            return func_key(hit)
+    # module-attribute call through an import: ``mod.func(...)``
+    if isinstance(call.func, ast.Attribute) and isinstance(
+        call.func.value, ast.Name
+    ):
+        base = imports.get(call.func.value.id)
+        if base is not None:
+            hit = index.module_function(base, cname)
+            if hit is not None:
+                return func_key(hit)
+    # obj.method(): bare-name method table under the ambiguity cap
+    candidates = [c for c in index.by_bare.get(cname, []) if c.class_name is not None]
+    if 0 < len(candidates) <= AMBIGUITY_CAP:
+        return tuple(func_key(c) for c in candidates)
+    return None
+
+
+def resolve_func_ref(
+    node: ast.AST,
+    fi: FuncInfo,
+    module: Module,
+    imports: dict[str, str],
+    index: FunctionIndex,
+) -> list[str]:
+    """Resolve a function REFERENCE (not a call) — a ``Thread(target=X)``
+    / ``run_in_executor(None, X)`` argument — to function keys.  Handles
+    bare names, ``self.method``, imported names, ``functools.partial``
+    wrappers, attr-chains (``self.duties.on_tick``, via the bare-name
+    method table under the ambiguity cap), and closures — lambdas and
+    nested ``def``s resolve to the calls INSIDE their body, since the
+    closure itself has no project-wide identity but everything it calls
+    does."""
+    dotted_mod = module_dotted(module)
+    if isinstance(node, ast.Call):
+        cname = call_name(node)
+        if cname == "partial" and node.args:
+            return resolve_func_ref(node.args[0], fi, module, imports, index)
+        return []
+    if isinstance(node, ast.Lambda):
+        return _body_callees(node.body, fi, module, imports, index)
+    if isinstance(node, ast.Name):
+        hit = index.by_module.get((dotted_mod, node.id))
+        if hit is not None:
+            return [func_key(hit)]
+        target = imports.get(node.id)
+        if target is not None:
+            mod, _, func = target.rpartition(".")
+            hit = index.module_function(mod, func)
+            if hit is not None:
+                return [func_key(hit)]
+        # a nested def in the same function: resolve its internal calls
+        for sub in ast.walk(fi.node):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not fi.node
+                and sub.name == node.id
+            ):
+                return _body_callees(sub, fi, module, imports, index)
+        return []
+    if isinstance(node, ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            if fi.class_name is not None:
+                hit = index.by_class.get((dotted_mod, fi.class_name, node.attr))
+                if hit is not None:
+                    return [func_key(hit)]
+            return []
+        if isinstance(node.value, ast.Name):
+            base = imports.get(node.value.id)
+            if base is not None:
+                hit = index.module_function(base, node.attr)
+                if hit is not None:
+                    return [func_key(hit)]
+        # obj.method / self.obj.method: bare-name method table under the
+        # cap — every candidate is seeded (conservative for race rules)
+        candidates = [
+            c for c in index.by_bare.get(node.attr, []) if c.class_name is not None
+        ]
+        if 0 < len(candidates) <= AMBIGUITY_CAP:
+            return [func_key(c) for c in candidates]
+    return []
+
+
+def _body_callees(body_node, fi, module, imports, index) -> list[str]:
+    out: list[str] = []
+    for sub in ast.walk(body_node):
+        if isinstance(sub, ast.Call):
+            t = resolve_callee(sub, fi, module, imports, index)
+            if isinstance(t, str):
+                out.append(t)
+    return out
+
+
+class CallGraph:
+    """Module-crossing call graph.  ``edges[key]`` is a list of
+    ``(target, lineno)`` where ``target`` is a resolved function key or
+    a tuple of ambiguous candidates; ``callers`` is the unique-target
+    reverse index."""
+
+    def __init__(self, project: Project, index: FunctionIndex):
+        self.index = index
+        self.edges: dict[str, list[tuple]] = {}
+        self.callers: dict[str, list[str]] = {}
+        for module in project.modules:
+            imports = import_map(module, project)
+            for fi in module_functions(module):
+                key = func_key(fi)
+                out: list[tuple] = []
+                for node in walk_excluding_nested(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = resolve_callee(node, fi, module, imports, index)
+                    if target is not None:
+                        out.append((target, node.lineno))
+                self.edges[key] = out
+                for target, _ in out:
+                    if isinstance(target, str):
+                        self.callers.setdefault(target, []).append(key)
+
+    def callees(self, key: str, *, unique_only: bool = True) -> list[str]:
+        out = []
+        for target, _ in self.edges.get(key, ()):
+            if isinstance(target, str):
+                out.append(target)
+            elif not unique_only:
+                out.extend(target)
+        return out
+
+
+def get_call_graph(project: Project) -> CallGraph:
+    if "call_graph" not in project.caches:
+        project.caches["call_graph"] = CallGraph(
+            project, get_function_index(project)
+        )
+    return project.caches["call_graph"]
+
+
+# ------------------------------------------------- entry-point classification
+
+CTX_LOOP = "loop"  # asyncio event-loop thread: async handlers, the node
+#                    tick loop, the fleet-observatory scrape loop, drains
+CTX_EXECUTOR = "executor"  # run_in_executor / to_thread / Executor.submit
+CTX_THREAD = "thread"  # dedicated threading.Thread targets
+
+# calls that move a sync callable onto a worker thread: the engine uses
+# these as executor seeds and async-blocking as its offload exemption
+EXECUTOR_WRAPPER_NAMES = {"run_in_executor", "to_thread"}
+_SUBMIT_DISPATCH = {"submit"}  # executor.submit(fn, ...)
+
+
+class ThreadContexts:
+    """``contexts[key]`` = thread classes that can run the function;
+    ``origins[(key, ctx)]`` = one human-readable seed attribution
+    (``"run_in_executor target in node/node.py:123"``) for messages."""
+
+    def __init__(self, project: Project, graph: CallGraph):
+        index = graph.index
+        self.contexts: dict[str, set[str]] = {}
+        self.origins: dict[tuple[str, str], str] = {}
+        # --- seeds
+        for module in project.modules:
+            imports = import_map(module, project)
+            for fi in module_functions(module):
+                key = func_key(fi)
+                if fi.is_async:
+                    self._seed(key, CTX_LOOP, f"async def in {module.rel}")
+                for node in walk_excluding_nested(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cname = call_name(node)
+                    refs: list[ast.AST] = []
+                    ctx = None
+                    if cname == "run_in_executor" and len(node.args) >= 2:
+                        ctx, refs = CTX_EXECUTOR, [node.args[1]]
+                    elif cname == "to_thread" and node.args:
+                        ctx, refs = CTX_EXECUTOR, [node.args[0]]
+                    elif cname in _SUBMIT_DISPATCH and node.args:
+                        ctx, refs = CTX_EXECUTOR, [node.args[0]]
+                    elif cname == "Thread":
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                ctx, refs = CTX_THREAD, [kw.value]
+                    if ctx is None:
+                        continue
+                    for ref in refs:
+                        for target in resolve_func_ref(
+                            ref, fi, module, imports, index
+                        ):
+                            self._seed(
+                                target,
+                                ctx,
+                                f"{cname} target in {module.rel}:{node.lineno}",
+                            )
+        # --- propagation: contexts flow caller -> sync callee (an async
+        # callee always runs on the loop it is awaited on, never on its
+        # caller's worker thread)
+        changed = True
+        while changed:
+            changed = False
+            for key, ctxs in list(self.contexts.items()):
+                for callee in graph.callees(key):
+                    target_fi = index.by_key.get(callee)
+                    if target_fi is None or target_fi.is_async:
+                        continue
+                    have = self.contexts.setdefault(callee, set())
+                    for ctx in ctxs:
+                        if ctx not in have:
+                            have.add(ctx)
+                            self.origins.setdefault(
+                                (callee, ctx),
+                                f"called from {key.rsplit(':', 1)[1]}",
+                            )
+                            changed = True
+
+    def _seed(self, key: str, ctx: str, origin: str) -> None:
+        have = self.contexts.setdefault(key, set())
+        if ctx not in have:
+            have.add(ctx)
+            self.origins.setdefault((key, ctx), origin)
+
+    def of(self, key: str) -> set[str]:
+        return self.contexts.get(key, set())
+
+    def origin(self, key: str, ctx: str) -> str:
+        return self.origins.get((key, ctx), ctx)
+
+
+def get_thread_contexts(project: Project) -> ThreadContexts:
+    if "thread_contexts" not in project.caches:
+        project.caches["thread_contexts"] = ThreadContexts(
+            project, get_call_graph(project)
+        )
+    return project.caches["thread_contexts"]

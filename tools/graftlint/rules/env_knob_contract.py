@@ -1,0 +1,362 @@
+"""env-knob-contract: machine-checked contract between env-knob reads,
+README documentation, polarity pairs, and the bench/soak/crash knob
+inventories.
+
+29+ modules steer themselves off ``os.environ`` — device-plane
+polarity ladders (``BLS_SHARD``/``BLS_NO_SHARD``), cache bounds,
+scrape cadences.  Nothing ties a read to its documentation, so knobs
+drift: a new knob ships undocumented, a renamed knob leaves its README
+row behind as dead advice, a polarity pair grows a second ad-hoc
+parser.  Four checks:
+
+1. **undocumented read** — every string-literal knob read in the linted
+   tree (``os.getenv``/``os.environ.get``/``os.environ[...]``/
+   ``env_flag``) must appear in a backticked README mention.  External
+   runtime variables (``JAX_PLATFORMS``, ``XLA_FLAGS``, …) are
+   allowlisted; ``BENCH_NO_*``/``SOAK_NO_*``/``CRASH_NO_*`` are the
+   inventory check's jurisdiction.
+2. **dead doc** — a knob DECLARED by the README (first cell of a
+   ``| `KNOB` | … |`` table row, or the lead tokens of a ``- `KNOB=1```
+   bullet) but read nowhere in the repo — package, ``bench.py``,
+   ``scripts/``, ``tests/``, ``__graft_entry__.py`` — is stale advice.
+3. **polarity pair** — when both ``X`` and its ``NO`` twin are read
+   (``KZG_DEVICE``/``KZG_NO_DEVICE``; the ``NO`` token is matched as a
+   token subsequence so ``DUTY_SIGN_DEVICE``/``DUTY_NO_DEVICE`` pairs
+   too), every read of either member must route through the shared
+   ``env_flag`` helper, and at least one function must read BOTH
+   members — the one place the NO-wins/force/auto ladder resolves.
+4. **inventory** — ``BENCH_NO_*``/``SOAK_NO_*``/``CRASH_NO_*`` knobs
+   read anywhere must appear literally in the corresponding
+   ``tests/unit/test_{bench,soak,crash}_validate.py`` so the validators
+   keep rejecting artifacts that claim unknown stage skips.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Module, Project
+from .common import call_name, dotted, module_functions, walk_excluding_nested
+
+# variables owned by the runtime/platform, not this repo's contract
+EXTERNAL_VARS = {
+    "JAX_PLATFORMS",
+    "XLA_FLAGS",
+    "LIBTPU_INIT_ARGS",
+    "PYTHONHASHSEED",
+    "PATH",
+    "HOME",
+    "TMPDIR",
+    "CI",
+}
+_INVENTORY_FAMILIES = {
+    "BENCH_NO_": "tests/unit/test_bench_validate.py",
+    "SOAK_NO_": "tests/unit/test_soak_validate.py",
+    "CRASH_NO_": "tests/unit/test_crash_validate.py",
+}
+_KNOB_RE = re.compile(r"[A-Z][A-Z0-9_]{2,}")
+_BACKTICK_KNOB_RE = re.compile(r"`([A-Z][A-Z0-9_]{2,})(?:=[^`]*)?`")
+_LITERAL_KNOB_RE = re.compile(r"\"([A-Z][A-Z0-9_]{2,})\"")
+# f"SOAK_NO_{name.upper()}"-style composition: the prefix marks the whole
+# knob family as read, even though no member appears as a full literal
+_DYNAMIC_PREFIX_RE = re.compile(r"f\"([A-Z][A-Z0-9_]*_)\{")
+# repo surfaces outside the linted tree that legitimately read knobs
+_EXTRA_SURFACES = ("bench.py", "__graft_entry__.py", "scripts", "tests")
+
+
+class _Read:
+    __slots__ = ("name", "module", "lineno", "via_helper", "func")
+
+    def __init__(self, name, module, lineno, via_helper, func):
+        self.name = name
+        self.module = module
+        self.lineno = lineno
+        self.via_helper = via_helper
+        self.func = func  # enclosing FuncInfo qualname key, or module rel
+
+
+def _knob_reads(module: Module) -> list[_Read]:
+    """String-literal env reads in one module, with the enclosing
+    function recorded (module-scope reads key on the module itself)."""
+    out: list[_Read] = []
+    scopes = [(None, module.tree.body)]
+    for fi in module_functions(module):
+        scopes.append((f"{module.rel}:{fi.qualname}", [fi.node]))
+
+    def scan(nodes, func_label, *, top_level):
+        stack = list(nodes)
+        while stack:
+            node = stack.pop()
+            if top_level and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue  # functions are scanned as their own scope
+            name = lineno = via = None
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                full = dotted(node.func) or ""
+                if cname == "env_flag" and node.args:
+                    name, via = _literal(node.args[0]), True
+                elif cname == "getenv" and node.args:
+                    name, via = _literal(node.args[0]), False
+                elif (
+                    cname in ("get", "setdefault")
+                    and full.endswith("environ." + cname)
+                    and node.args
+                ):
+                    name, via = _literal(node.args[0]), False
+                lineno = node.lineno
+            elif isinstance(node, ast.Subscript):
+                base = dotted(node.value) or ""
+                if base.endswith("environ"):
+                    name, via, lineno = _literal(node.slice), False, node.lineno
+            if name:
+                out.append(_Read(name, module, lineno, via, func_label or module.rel))
+            stack.extend(ast.iter_child_nodes(node))
+
+    for label, nodes in scopes:
+        if label is None:
+            scan(nodes, None, top_level=True)
+        else:
+            for fn in nodes:
+                scan(list(ast.iter_child_nodes(fn)), label, top_level=False)
+    return out
+
+
+def _literal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if _KNOB_RE.fullmatch(node.value) else None
+    return None
+
+
+def _readme_tokens(text: str) -> tuple[set[str], dict[str, int]]:
+    """(documented, declared) README knob sets.  ``documented`` is every
+    backticked ALL_CAPS token anywhere (liberal — a prose mention is
+    documentation enough to satisfy check 1).  ``declared`` maps knob ->
+    line for declaring positions only: first table cell or bullet lead
+    (before the em-dash), the rows check 2 holds to account."""
+    documented: set[str] = set()
+    declared: dict[str, int] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        documented.update(_BACKTICK_KNOB_RE.findall(line))
+        stripped = line.strip()
+        if stripped.startswith("|"):
+            cells = stripped.split("|")
+            if len(cells) > 2:
+                for tok in _BACKTICK_KNOB_RE.findall(cells[1]):
+                    declared.setdefault(tok, i)
+        elif stripped.startswith("- `"):
+            lead = re.split("—|--", stripped)[0]
+            for tok in _BACKTICK_KNOB_RE.findall(lead):
+                declared.setdefault(tok, i)
+    return documented, declared
+
+
+def _strip_no(name: str) -> str | None:
+    toks = name.split("_")
+    if "NO" not in toks:
+        return None
+    toks.remove("NO")
+    return "_".join(toks)
+
+
+def _is_pair(positive: str, negative_stripped: str) -> bool:
+    """``negative_stripped`` (NO removed) pairs with ``positive`` when
+    its tokens form a subsequence of the positive's tokens sharing the
+    first and last token — DUTY_DEVICE pairs DUTY_SIGN_DEVICE but not
+    WITNESS_DEVICE_MIN."""
+    a, b = negative_stripped.split("_"), positive.split("_")
+    if not a or not b or a[0] != b[0] or a[-1] != b[-1]:
+        return False
+    it = iter(b)
+    return all(tok in it for tok in a)
+
+
+class EnvKnobContractRule:
+    name = "env-knob-contract"
+    description = "env reads vs README docs, polarity pairs, knob inventories"
+
+    def check(self, project: Project) -> list[Finding]:
+        readme = project.root / "README.md"
+        if not readme.exists():
+            return []
+        documented, declared = _readme_tokens(readme.read_text())
+        reads: list[_Read] = []
+        for module in project.modules:
+            reads.extend(_knob_reads(module))
+        findings: list[Finding] = []
+        findings.extend(self._check_undocumented(reads, documented))
+        findings.extend(self._check_dead_docs(project, reads, declared))
+        findings.extend(self._check_polarity(reads))
+        findings.extend(self._check_inventories(project, reads))
+        return findings
+
+    # -------------------------------------------------------------- check 1
+
+    def _check_undocumented(self, reads, documented):
+        findings = []
+        flagged: set[str] = set()
+        for r in reads:
+            if r.name in documented or r.name in EXTERNAL_VARS or r.name in flagged:
+                continue
+            if any(r.name.startswith(p) for p in _INVENTORY_FAMILIES):
+                continue
+            flagged.add(r.name)
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=r.module.rel,
+                    line=r.lineno,
+                    symbol=r.name,
+                    message=(
+                        f"env knob {r.name} is read here but appears nowhere "
+                        "in README.md — add it to the knob tables (or the "
+                        "multichip bullet list) so operators can find it"
+                    ),
+                )
+            )
+        return findings
+
+    # -------------------------------------------------------------- check 2
+
+    def _check_dead_docs(self, project: Project, reads, declared):
+        used = {r.name for r in reads}
+        prefixes: set[str] = set()
+        for module in project.modules:
+            prefixes.update(_DYNAMIC_PREFIX_RE.findall(module.source))
+        for rel in _EXTRA_SURFACES:
+            p = project.root / rel
+            files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+            for f in files:
+                if f.exists():
+                    try:
+                        text = f.read_text()
+                    except OSError:
+                        continue
+                    used.update(_LITERAL_KNOB_RE.findall(text))
+                    prefixes.update(_DYNAMIC_PREFIX_RE.findall(text))
+        findings = []
+        for knob, lineno in sorted(declared.items()):
+            if knob in used or knob in EXTERNAL_VARS:
+                continue
+            if any(knob.startswith(p) for p in prefixes):
+                continue
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path="README.md",
+                    line=lineno,
+                    symbol=knob,
+                    message=(
+                        f"README documents env knob {knob} but nothing in the "
+                        "repo reads it — dead advice; delete the row or "
+                        "restore the read"
+                    ),
+                )
+            )
+        return findings
+
+    # -------------------------------------------------------------- check 3
+
+    def _check_polarity(self, reads):
+        by_name: dict[str, list[_Read]] = {}
+        for r in reads:
+            by_name.setdefault(r.name, []).append(r)
+        pairs: list[tuple[str, str]] = []
+        for neg in by_name:
+            stripped = _strip_no(neg)
+            if stripped is None:
+                continue
+            for pos in by_name:
+                if pos != neg and _strip_no(pos) is None and _is_pair(pos, stripped):
+                    pairs.append((pos, neg))
+        findings = []
+        for pos, neg in sorted(pairs):
+            members = by_name[pos] + by_name[neg]
+            for r in members:
+                if not r.via_helper:
+                    findings.append(
+                        Finding(
+                            rule=self.name,
+                            path=r.module.rel,
+                            line=r.lineno,
+                            symbol=r.name,
+                            message=(
+                                f"polarity pair {pos}/{neg}: this read of "
+                                f"{r.name} bypasses the shared env_flag helper "
+                                "— two truthiness parsers for one pair drift"
+                            ),
+                        )
+                    )
+            funcs_pos = {r.func for r in by_name[pos]}
+            funcs_neg = {r.func for r in by_name[neg]}
+            if not (funcs_pos & funcs_neg):
+                r = by_name[pos][0]
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=r.module.rel,
+                        line=r.lineno,
+                        symbol=pos,
+                        message=(
+                            f"polarity pair {pos}/{neg} is never resolved in "
+                            "one function — the NO-wins/force/auto ladder "
+                            "must live in a single shared helper"
+                        ),
+                    )
+                )
+        return findings
+
+    # -------------------------------------------------------------- check 4
+
+    def _check_inventories(self, project: Project, reads):
+        # family knobs read anywhere (linted tree + extra surfaces)
+        family_reads: dict[str, list[tuple[str, str, int]]] = {}
+        for r in reads:
+            for prefix in _INVENTORY_FAMILIES:
+                if r.name.startswith(prefix):
+                    family_reads.setdefault(prefix, []).append(
+                        (r.name, r.module.rel, r.lineno)
+                    )
+        for rel in _EXTRA_SURFACES:
+            p = project.root / rel
+            files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+            for f in files:
+                if not f.exists() or "test_" in f.name:
+                    continue
+                try:
+                    text = f.read_text()
+                except OSError:
+                    continue
+                for i, line in enumerate(text.splitlines(), 1):
+                    for name in _LITERAL_KNOB_RE.findall(line):
+                        for prefix in _INVENTORY_FAMILIES:
+                            if name.startswith(prefix):
+                                family_reads.setdefault(prefix, []).append(
+                                    (name, f.relative_to(project.root).as_posix(), i)
+                                )
+        findings = []
+        seen: set[str] = set()
+        for prefix, sites in sorted(family_reads.items()):
+            inv_path = project.root / _INVENTORY_FAMILIES[prefix]
+            inventory = inv_path.read_text() if inv_path.exists() else ""
+            for name, rel, lineno in sites:
+                if name in seen or f'"{name}"' in inventory:
+                    continue
+                seen.add(name)
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=rel,
+                        line=lineno,
+                        symbol=name,
+                        message=(
+                            f"{name} is read here but missing from the "
+                            f"{_INVENTORY_FAMILIES[prefix]} knob inventory — "
+                            "the validator will accept artifacts produced "
+                            "with a knob it does not know"
+                        ),
+                    )
+                )
+        return findings

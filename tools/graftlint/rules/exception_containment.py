@@ -26,23 +26,24 @@ from __future__ import annotations
 
 import ast
 
-from ..core import Finding, Module, Project
+from ..core import Finding, Project
 from .common import (
-    FuncInfo,
+    FunctionIndex,
     call_name,
     covered_by,
     dotted,
     exception_table,
+    func_key,
+    get_function_index,
     handler_names,
     import_map,
     is_exception_class,
-    is_self_call,
     module_functions,
+    resolve_callee,
     walk_excluding_nested,
 )
 
 PROPAGATION_DEPTH = 2  # raise signatures travel at most this many call levels
-AMBIGUITY_CAP = 3  # attr-call resolution: skip names defined more often
 
 
 class ExceptionContainmentRule:
@@ -51,7 +52,7 @@ class ExceptionContainmentRule:
 
     def check(self, project: Project) -> list[Finding]:
         table = exception_table(project)
-        index = _FunctionIndex(project)
+        index = get_function_index(project)
         signatures = _raise_signatures(project, table, index)
         findings: list[Finding] = []
         for module in project.modules:
@@ -94,7 +95,7 @@ class ExceptionContainmentRule:
                         raised = {name}
                         context = f"raise {name}"
                 elif isinstance(node, ast.Call):
-                    target = _resolve_callee(node, fi, module, imports, index)
+                    target = resolve_callee(node, fi, module, imports, index)
                     if target is not None:
                         raised = _candidate_raises(target, signatures)
                         context = f"{call_name(node)}() may raise"
@@ -123,45 +124,6 @@ class ExceptionContainmentRule:
 # ------------------------------------------------------------- resolution
 
 
-class _FunctionIndex:
-    """Project-wide function lookup: by (module, name), (module, class,
-    name), and bare method name (with definition counts for the
-    ambiguity cap)."""
-
-    def __init__(self, project: Project):
-        self.by_module: dict[tuple[str, str], FuncInfo] = {}
-        self.by_class: dict[tuple[str, str, str], FuncInfo] = {}
-        self.by_bare: dict[str, list[FuncInfo]] = {}
-        # module dotted path -> its import map, for one re-export hop
-        # (``from ..fork_choice import on_block`` resolves through the
-        # package __init__ to the defining module)
-        self.reexports: dict[str, dict[str, str]] = {}
-        for module in project.modules:
-            dotted_mod = project.dotted_name(module)
-            self.reexports[dotted_mod] = import_map(module, project)
-            for fi in module_functions(module):
-                if fi.class_name is None:
-                    self.by_module[(dotted_mod, fi.name)] = fi
-                else:
-                    self.by_class[(dotted_mod, fi.class_name, fi.name)] = fi
-                self.by_bare.setdefault(fi.name, []).append(fi)
-
-    def module_function(self, mod: str, func: str) -> FuncInfo | None:
-        hit = self.by_module.get((mod, func))
-        if hit is not None:
-            return hit
-        # one re-export hop through the target module's own imports
-        target = self.reexports.get(mod, {}).get(func)
-        if target is not None:
-            mod2, _, func2 = target.rpartition(".")
-            return self.by_module.get((mod2, func2))
-        return None
-
-
-def _func_key(fi: FuncInfo) -> str:
-    return f"{fi.module.rel}:{fi.qualname}"
-
-
 def _candidate_raises(target, signatures: dict) -> set[str]:
     """Raise set for a resolved callee.  A unique resolution keeps its
     full signature; an ambiguous attr-call (tuple of candidate keys under
@@ -177,43 +139,6 @@ def _candidate_raises(target, signatures: dict) -> set[str]:
     return set.intersection(*sets) if sets else set()
 
 
-def _resolve_callee(call: ast.Call, fi, module, imports, index: _FunctionIndex):
-    """Resolve a call to a function key, or None."""
-    cname = call_name(call)
-    if cname is None:
-        return None
-    dotted_mod = _module_dotted(module)
-    if isinstance(call.func, ast.Name):
-        hit = index.by_module.get((dotted_mod, cname))
-        if hit is not None:
-            return _func_key(hit)
-        target = imports.get(cname)
-        if target is not None:
-            mod, _, func = target.rpartition(".")
-            hit = index.module_function(mod, func)
-            if hit is not None:
-                return _func_key(hit)
-        return None
-    if is_self_call(call) and fi.class_name is not None:
-        hit = index.by_class.get((dotted_mod, fi.class_name, cname))
-        if hit is not None:
-            return _func_key(hit)
-    # obj.method(): bare-name method table under the ambiguity cap
-    candidates = [c for c in index.by_bare.get(cname, []) if c.class_name is not None]
-    if 0 < len(candidates) <= AMBIGUITY_CAP:
-        return tuple(_func_key(c) for c in candidates)
-    return None
-
-
-def _module_dotted(module: Module) -> str:
-    rel = module.rel
-    if rel.endswith("/__init__.py"):
-        rel = rel[: -len("/__init__.py")]
-    elif rel.endswith(".py"):
-        rel = rel[:-3]
-    return rel.replace("/", ".")
-
-
 def _raised_name(exc: ast.AST) -> str | None:
     if isinstance(exc, ast.Call):
         exc = exc.func
@@ -224,7 +149,7 @@ def _raised_name(exc: ast.AST) -> str | None:
 # ----------------------------------------------------------- raise tables
 
 
-def _raise_signatures(project, table, index: _FunctionIndex) -> dict:
+def _raise_signatures(project, table, index: FunctionIndex) -> dict:
     """Function key -> set of exception names escaping it, propagated
     ``PROPAGATION_DEPTH`` call levels.  A raise (or callee raise) inside
     a try whose handlers cover it locally does not escape."""
@@ -233,7 +158,7 @@ def _raise_signatures(project, table, index: _FunctionIndex) -> dict:
     for module in project.modules:
         imports = import_map(module, project)
         for fi in module_functions(module):
-            key = _func_key(fi)
+            key = func_key(fi)
             direct: set[str] = set()
             callee_sites: list = []
             trys = _enclosing_try_map(fi.node)
@@ -253,7 +178,7 @@ def _raise_signatures(project, table, index: _FunctionIndex) -> dict:
                     ):
                         direct.add(name)
                 elif isinstance(node, ast.Call):
-                    target = _resolve_callee(node, fi, module, imports, index)
+                    target = resolve_callee(node, fi, module, imports, index)
                     if target is not None:
                         callee_sites.append((target, covering))
             sigs[key] = direct

@@ -1,0 +1,284 @@
+"""lifecycle-teardown: resources created by long-lived objects with no
+reachable teardown path.
+
+The defect class (PR 8's leaked replay-prefetch thread): an object
+spawns a ``threading.Thread``/``ThreadPoolExecutor``/socket/server in
+``__init__`` or ``start()``, stores it on ``self``, and its
+``stop()``/``close()`` forgets one of them — the process "shuts down"
+but a non-daemon thread pins the interpreter, or a bound port leaks
+into the next test.
+
+Mechanics: for every class, collect ``self.X = <resource-ctor>``
+assignments (``threading.Thread``, ``ThreadPoolExecutor``,
+``socket.socket``, ``subprocess.Popen`` — plus ``self.X = f()`` where
+``f`` is a project function that RETURNS one of those, one
+interprocedural hop through the call graph's function index, which is
+how a ``start_warmer()`` factory's thread stays attributable).  The
+class must then contain SOME method (or async method) that performs a
+teardown call on that attribute: ``self.X.join()``, ``.cancel()``,
+``.close()``, ``.shutdown()``, ``.stop()``, ``.kill()``,
+``.terminate()``, ``.wait_closed()``, ``.aclose()``, or ``del``/
+re-assignment to ``None`` inside a ``finally``.  Locals are exempt
+when they are returned (ownership transfer to the caller), used as a
+``with`` context manager, or torn down in the same function.
+
+Daemon threads are NOT exempt: the repo's own warm-up threads are
+daemonized precisely so a leak is survivable, but they still burn a
+core and hold references — the rule wants an explicit stop path or a
+suppression with rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Project
+from .common import (
+    call_name,
+    dotted,
+    get_function_index,
+    import_map,
+    module_functions,
+    walk_excluding_nested,
+)
+
+# terminal constructor name -> resource kind
+_RESOURCE_CTORS = {
+    "Thread": "thread",
+    "Timer": "thread",
+    "ThreadPoolExecutor": "executor",
+    "ProcessPoolExecutor": "executor",
+    "socket": "socket",
+    "Popen": "process",
+}
+_TEARDOWN_METHODS = {
+    "join",
+    "cancel",
+    "close",
+    "shutdown",
+    "stop",
+    "kill",
+    "terminate",
+    "wait_closed",
+    "wait",
+    "aclose",
+    "unsubscribe",
+    "detach",
+}
+
+
+def _resource_kind(value: ast.AST) -> str | None:
+    """``threading.Thread(...)`` -> ``thread``; non-calls -> None."""
+    if isinstance(value, ast.Call):
+        name = dotted(value.func)
+        if name:
+            return _RESOURCE_CTORS.get(name.split(".")[-1])
+    return None
+
+
+def _returns_resource(func_node) -> str | None:
+    """Kind when a function returns a freshly-constructed resource or a
+    local holding one (the factory pattern: build thread, start, return)."""
+    local_kinds: dict[str, str] = {}
+    for node in walk_excluding_nested(func_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            kind = _resource_kind(node.value)
+            if isinstance(t, ast.Name) and kind:
+                local_kinds[t.id] = kind
+    for node in walk_excluding_nested(func_node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            kind = _resource_kind(node.value)
+            if kind:
+                return kind
+            if isinstance(node.value, ast.Name) and node.value.id in local_kinds:
+                return local_kinds[node.value.id]
+    return None
+
+
+class LifecycleTeardownRule:
+    name = "lifecycle-teardown"
+    description = "threads/executors/sockets stored on self with no teardown path"
+
+    def check(self, project: Project) -> list[Finding]:
+        index = get_function_index(project)
+        # one interprocedural hop: project functions that return resources
+        factory_kinds: dict[str, str] = {}  # func key -> kind
+        for key, fi in index.by_key.items():
+            kind = _returns_resource(fi.node)
+            if kind:
+                factory_kinds[key] = kind
+        findings: list[Finding] = []
+        for module in project.modules:
+            findings.extend(
+                self._check_module(module, project, index, factory_kinds)
+            )
+        return findings
+
+    def _check_module(self, module: Module, project: Project, index, factory_kinds):
+        findings: list[Finding] = []
+        imports = import_map(module, project)
+        # group methods by class
+        classes: dict[str, list] = {}
+        for fi in module_functions(module):
+            if fi.class_name is not None:
+                classes.setdefault(fi.class_name, []).append(fi)
+        for cls, methods in classes.items():
+            # attr -> (kind, fi, lineno) for resource-holding assignments
+            held: dict[str, tuple] = {}
+            torn: set[str] = set()
+            for fi in methods:
+                for node in walk_excluding_nested(fi.node):
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            attr = _self_attr(t)
+                            if attr is None:
+                                continue
+                            kind = _resource_kind(node.value)
+                            if kind is None and isinstance(node.value, ast.Call):
+                                kind = self._factory_kind(
+                                    node.value, fi, module, imports, index, factory_kinds
+                                )
+                            if kind is not None:
+                                held.setdefault(attr, (kind, fi, node.lineno))
+                            # ``self.X = None`` anywhere (reset slot)
+                            elif (
+                                isinstance(node.value, ast.Constant)
+                                and node.value.value is None
+                            ):
+                                torn.add(attr)
+                    elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute
+                    ):
+                        # self.X.join() / self.X.close() ...
+                        if node.func.attr in _TEARDOWN_METHODS:
+                            attr = _self_attr(node.func.value)
+                            if attr is not None:
+                                torn.add(attr)
+                    elif isinstance(node, ast.Delete):
+                        for t in node.targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                torn.add(attr)
+            for attr, (kind, fi, lineno) in sorted(held.items()):
+                if attr in torn:
+                    continue
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=module.rel,
+                        line=lineno,
+                        symbol=f"{cls}.{attr}",
+                        message=(
+                            f"self.{attr} holds a {kind} created in "
+                            f"{fi.qualname}() but no method of {cls} ever "
+                            "tears it down (join/close/shutdown/stop/...) — "
+                            "leaked threads pin the interpreter and leaked "
+                            "ports poison the next bind"
+                        ),
+                    )
+                )
+        findings.extend(
+            self._check_local_leaks(
+                module, module_functions(module), imports, index, factory_kinds
+            )
+        )
+        return findings
+
+    def _factory_kind(self, call, fi, module, imports, index, factory_kinds):
+        """``self.X = start_warmer(...)``: resolve the callee and look it
+        up in the returns-a-resource table."""
+        from .common import resolve_callee
+
+        target = resolve_callee(call, fi, module, imports, index)
+        if isinstance(target, str):
+            return factory_kinds.get(target)
+        if isinstance(target, tuple):
+            kinds = {factory_kinds.get(t) for t in target}
+            if len(kinds) == 1:
+                return kinds.pop()
+        return None
+
+    def _check_local_leaks(self, module, methods, imports, index, factory_kinds):
+        """A LOCAL resource that is started but neither returned, stored,
+        torn down, nor used as a context manager leaks on function exit
+        with no handle left to stop it."""
+        findings: list[Finding] = []
+        for fi in methods:
+            locals_held: dict[str, tuple] = {}
+            cleared: set[str] = set()
+            for node in walk_excluding_nested(fi.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name):
+                        kind = _resource_kind(node.value)
+                        if kind:
+                            locals_held[t.id] = (kind, node.lineno)
+                elif isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        expr = item.context_expr
+                        if isinstance(expr, ast.Name):
+                            cleared.add(expr.id)
+                        if item.optional_vars is not None and isinstance(
+                            item.optional_vars, ast.Name
+                        ):
+                            cleared.add(item.optional_vars.id)
+                        if isinstance(expr, ast.Call) and _resource_kind(expr):
+                            # ``with socket.socket() as s``: managed
+                            if isinstance(item.optional_vars, ast.Name):
+                                cleared.add(item.optional_vars.id)
+                elif isinstance(node, ast.Return) and isinstance(
+                    node.value, ast.Name
+                ):
+                    cleared.add(node.value.id)
+                elif isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Attribute):
+                        if node.func.attr in _TEARDOWN_METHODS and isinstance(
+                            node.func.value, ast.Name
+                        ):
+                            cleared.add(node.func.value.id)
+                    # passing the handle onward transfers ownership
+                    for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]:
+                        if isinstance(arg, ast.Name):
+                            cleared.add(arg.id)
+                elif isinstance(node, ast.Assign):
+                    # self.X = local / container.append(local) style stores
+                    for t in node.targets:
+                        if _self_attr(t) is not None and isinstance(
+                            node.value, ast.Name
+                        ):
+                            cleared.add(node.value.id)
+                elif isinstance(node, (ast.Tuple, ast.List, ast.Dict)):
+                    for elt in ast.iter_child_nodes(node):
+                        if isinstance(elt, ast.Name):
+                            cleared.add(elt.id)
+            for name, (kind, lineno) in sorted(locals_held.items()):
+                if name in cleared:
+                    continue
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=module.rel,
+                        line=lineno,
+                        symbol=fi.qualname,
+                        message=(
+                            f"local {kind} `{name}` in {fi.qualname}() is "
+                            "never joined/closed, stored, returned, or "
+                            "passed on — the handle is dropped while the "
+                            f"{kind} may still be running"
+                        ),
+                    )
+                )
+        return findings
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None

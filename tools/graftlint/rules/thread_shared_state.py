@@ -1,0 +1,372 @@
+"""thread-shared-state: module globals and ``self`` attributes written
+from one thread class and read from another with no synchronization.
+
+The defect class (PR 10's live bug): ``on_tick`` offloaded to executor
+threads read a module-level preset that the event loop rewrote between
+ticks — every unit test drove both sides on one thread, so the race
+never fired until a fleet soak.  The engine's entry-point
+classification (``get_thread_contexts``) tells this rule which thread
+class runs every function: the asyncio event loop (async handlers, the
+node tick loop, scrape/drain loops), executor workers
+(``run_in_executor``/``to_thread``/``submit`` targets), or dedicated
+``threading.Thread`` targets.  A mutable location touched from two
+different classes needs a story.
+
+Accepted stories (exemptions):
+
+- **lock-protected** — every cross-context write sits lexically under
+  ``with <lock>`` where the lock is a ``threading.Lock``/``RLock``/
+  ``Condition``/``Semaphore`` created in ``__init__`` (``self._lock``)
+  or at module scope (the double-checked-locking global memo pattern:
+  reads may be lock-free, the WRITE side must hold the lock);
+- **single-assignment-then-frozen** — written only in ``__init__`` /
+  at module import time, read everywhere else;
+- **safe containers** — ``queue.Queue``/``asyncio.Queue``/``deque``/
+  ``threading.Event``/``ContextVar`` handoffs: mutating METHOD calls on
+  these are internally synchronized, only rebinding the name counts as
+  a write;
+- **ContextVar pin** — values threaded through ``ContextVar.set()`` are
+  per-thread by construction (the PR 10 fix);
+- **constant stop-flags** — attributes only ever assigned literal
+  ``True``/``False``/``None``: a boolean torn read is benign (this is
+  the idiomatic ``self._stop = True`` shutdown signal).
+
+Suppressions must carry rationale: a bare ``# graftlint:
+disable=thread-shared-state`` with no trailing justification text is
+itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Module, Project
+from .common import (
+    CTX_LOOP,
+    FuncInfo,
+    call_name,
+    dotted,
+    func_key,
+    get_thread_contexts,
+    module_functions,
+    walk_excluding_nested,
+)
+
+# constructors whose instances synchronize their own mutation
+_LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_SAFE_CONTAINER_TYPES = {
+    "Queue",
+    "LifoQueue",
+    "PriorityQueue",
+    "SimpleQueue",
+    "deque",
+    "Event",
+    "ContextVar",
+    "Barrier",
+}
+_INIT_METHODS = {"__init__", "__post_init__"}
+
+
+class _ClassState:
+    __slots__ = ("locks", "safe", "writes", "reads", "init_written")
+
+    def __init__(self):
+        self.locks: set[str] = set()  # attr names holding lock objects
+        self.safe: set[str] = set()  # attr names holding safe containers
+        # attr -> list of (ctx, fi, lineno, under_lock, is_constant)
+        self.writes: dict[str, list] = {}
+        # attr -> list of (ctx, fi, lineno, under_lock)
+        self.reads: dict[str, list] = {}
+        self.init_written: set[str] = set()
+
+
+def _ctor_type(value: ast.AST) -> str | None:
+    """Terminal constructor name for ``threading.Lock()`` / ``Queue()``
+    / ``contextvars.ContextVar("x")`` -> ``Lock``/``Queue``/…"""
+    if isinstance(value, ast.Call):
+        name = dotted(value.func)
+        if name:
+            return name.split(".")[-1]
+    return None
+
+
+def _lock_names_under(node_stack: list[ast.AST]) -> set[str]:
+    """Names/attrs of every ``with``-guard in the enclosing stack:
+    ``with self._lock:`` -> ``_lock``; ``with _ENGINE_LOCK:`` ->
+    ``_ENGINE_LOCK``; ``Condition`` guards count the same way."""
+    out: set[str] = set()
+    for node in node_stack:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):  # cv.wait_for(...) style
+                    expr = expr.func
+                name = dotted(expr)
+                if name:
+                    out.add(name.split(".")[-1])
+    return out
+
+
+def _is_constant_write(value: ast.AST) -> bool:
+    return isinstance(value, ast.Constant) and (
+        value.value is True or value.value is False or value.value is None
+    )
+
+
+def _walk_with_stack(func_node):
+    """Yield ``(node, enclosing-with-stack)`` excluding nested scopes."""
+    stack: list[tuple[ast.AST, list]] = [
+        (c, []) for c in ast.iter_child_nodes(func_node)
+    ]
+    while stack:
+        node, withs = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        yield node, withs
+        child_withs = (
+            withs + [node] if isinstance(node, (ast.With, ast.AsyncWith)) else withs
+        )
+        stack.extend((c, child_withs) for c in ast.iter_child_nodes(node))
+
+
+class ThreadSharedStateRule:
+    name = "thread-shared-state"
+    description = (
+        "state written from one thread class and read from another unsynchronized"
+    )
+
+    def check(self, project: Project) -> list[Finding]:
+        contexts = get_thread_contexts(project)
+        findings: list[Finding] = []
+        for module in project.modules:
+            findings.extend(self._check_module(module, project, contexts))
+        findings.extend(self._check_suppression_rationale(project))
+        return findings
+
+    # ------------------------------------------------------ self attributes
+
+    def _check_module(self, module: Module, project: Project, contexts):
+        findings: list[Finding] = []
+        classes: dict[str, _ClassState] = {}
+        for fi in module_functions(module):
+            if fi.class_name is None:
+                continue
+            state = classes.setdefault(fi.class_name, _ClassState())
+            ctxs = contexts.of(func_key(fi))
+            if fi.is_async:
+                ctxs = ctxs | {CTX_LOOP}
+            is_init = fi.name in _INIT_METHODS
+            for node, withs in _walk_with_stack(fi.node):
+                held = _lock_names_under(withs)
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    value = node.value
+                    for t in targets:
+                        attr = self._self_attr(t)
+                        if attr is None:
+                            continue
+                        if is_init:
+                            state.init_written.add(attr)
+                            ctor = _ctor_type(value) if value is not None else None
+                            if ctor in _LOCK_TYPES:
+                                state.locks.add(attr)
+                            elif ctor in _SAFE_CONTAINER_TYPES:
+                                state.safe.add(attr)
+                            continue
+                        for ctx in ctxs:
+                            state.writes.setdefault(attr, []).append(
+                                (
+                                    ctx,
+                                    fi,
+                                    node.lineno,
+                                    bool(held),
+                                    value is not None
+                                    and _is_constant_write(value)
+                                    and not isinstance(node, ast.AugAssign),
+                                )
+                            )
+                elif isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    attr = self._self_attr(node)
+                    if attr is None or is_init:
+                        continue
+                    for ctx in ctxs:
+                        state.reads.setdefault(attr, []).append(
+                            (ctx, fi, node.lineno, bool(held))
+                        )
+        for cls, state in classes.items():
+            findings.extend(self._judge_class(module, cls, state))
+        findings.extend(self._check_globals(module, contexts))
+        return findings
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> str | None:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _judge_class(self, module: Module, cls: str, state: _ClassState):
+        findings: list[Finding] = []
+        for attr, writes in sorted(state.writes.items()):
+            if attr in state.locks or attr in state.safe:
+                continue
+            reads = state.reads.get(attr, [])
+            write_ctxs = {w[0] for w in writes}
+            read_ctxs = {r[0] for r in reads}
+            # cross-context = the accesses span more than one thread
+            # class (a second writer counts as an access too)
+            if len(write_ctxs | read_ctxs) <= 1:
+                continue
+            if all(w[3] for w in writes):  # every write under a lock
+                continue
+            if all(w[4] for w in writes):  # constant stop-flag writes only
+                continue
+            w = next(w for w in writes if not w[3])
+            ctx, fi, lineno, _, _ = w
+            other_ctxs = sorted((write_ctxs | read_ctxs) - {ctx}) or sorted(
+                write_ctxs - {ctx}
+            )
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=module.rel,
+                    line=lineno,
+                    symbol=f"{cls}.{attr}",
+                    message=(
+                        f"self.{attr} written on the {ctx} thread in "
+                        f"{fi.qualname}() without a lock, but also touched "
+                        f"from the {', '.join(other_ctxs)} context — guard "
+                        "every write with the owning lock, hand off through "
+                        "a queue, or pin per-thread with a ContextVar"
+                    ),
+                )
+            )
+        return findings
+
+    # --------------------------------------------------------- module globals
+
+    def _check_globals(self, module: Module, contexts):
+        findings: list[Finding] = []
+        # module-scope lock objects and safe containers
+        module_locks: set[str] = set()
+        module_safe: set[str] = set()
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    ctor = _ctor_type(node.value)
+                    if ctor in _LOCK_TYPES:
+                        module_locks.add(t.id)
+                    elif ctor in _SAFE_CONTAINER_TYPES:
+                        module_safe.add(t.id)
+        # global X writes per function, with lock/ctx info
+        writes: dict[str, list] = {}
+        readers: dict[str, set] = {}
+        for fi in module_functions(module):
+            ctxs = contexts.of(func_key(fi))
+            if fi.is_async:
+                ctxs = ctxs | {CTX_LOOP}
+            declared: set[str] = set()
+            for node in walk_excluding_nested(fi.node):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            if not declared and not ctxs:
+                continue
+            for node, withs in _walk_with_stack(fi.node):
+                held = _lock_names_under(withs) & module_locks
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Name) and t.id in declared:
+                            if t.id in module_safe:
+                                continue
+                            for ctx in ctxs:
+                                writes.setdefault(t.id, []).append(
+                                    (
+                                        ctx,
+                                        fi,
+                                        node.lineno,
+                                        bool(held),
+                                        node.value is not None
+                                        and _is_constant_write(node.value)
+                                        and not isinstance(node, ast.AugAssign),
+                                    )
+                                )
+                elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    for ctx in ctxs:
+                        readers.setdefault(node.id, set()).add(ctx)
+        for name, ws in sorted(writes.items()):
+            write_ctxs = {w[0] for w in ws}
+            all_ctxs = write_ctxs | readers.get(name, set())
+            if len(all_ctxs) <= 1:
+                continue
+            if all(w[3] for w in ws):  # double-checked-locking memo: OK
+                continue
+            if all(w[4] for w in ws):
+                continue
+            w = next(w for w in ws if not w[3])
+            ctx, fi, lineno, _, _ = w
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=module.rel,
+                    line=lineno,
+                    symbol=name,
+                    message=(
+                        f"module global {name} rebound on the {ctx} thread in "
+                        f"{fi.qualname}() without holding a module lock, but "
+                        f"reachable from {', '.join(sorted(all_ctxs - {ctx}))} "
+                        "contexts — use the double-checked-locking memo "
+                        "pattern (write under a module Lock) or a ContextVar"
+                    ),
+                )
+            )
+        return findings
+
+    # ---------------------------------------------------------- suppressions
+
+    def _check_suppression_rationale(self, project: Project):
+        """A suppression of THIS rule must say why: ``# graftlint:
+        disable=thread-shared-state — <rationale>`` (any trailing text
+        after the rule list)."""
+        findings: list[Finding] = []
+        for module in project.modules:
+            for lineno, raw in module.suppression_comments:
+                if "disable=" not in raw:
+                    continue
+                rules_part = raw.split("disable=", 1)[1]
+                spec = rules_part.split()[0] if rules_part.split() else ""
+                if "thread-shared-state" not in spec.split(","):
+                    continue
+                rationale = rules_part[len(spec):].strip(" \t-—–:")
+                if not rationale:
+                    findings.append(
+                        Finding(
+                            rule=self.name,
+                            path=module.rel,
+                            line=lineno,
+                            symbol="<suppression>",
+                            message=(
+                                "thread-shared-state suppression without a "
+                                "written rationale — state why the access is "
+                                "safe after the rule list"
+                            ),
+                            unsuppressable=True,
+                        )
+                    )
+        return findings
